@@ -1,0 +1,55 @@
+"""BasicLogging equivalent — per-stage structured telemetry.
+
+Reference: ``core/src/main/scala/com/microsoft/ml/spark/logging/
+BasicLogging.scala:25-70``: every ctor/fit/transform/predict emits JSON
+``{uid, className, method, buildVersion}``; errors are logged with the verb.
+Here the transport is the stdlib ``logging`` module under the
+``mmlspark_tpu.telemetry`` logger; a ring buffer keeps recent events for tests.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import time
+from collections import deque
+from typing import Any, Dict
+
+logger = logging.getLogger("mmlspark_tpu.telemetry")
+
+_RECENT: deque = deque(maxlen=512)
+
+
+def build_version() -> str:
+    from mmlspark_tpu import __version__
+    return __version__
+
+
+def log_event(payload: Dict[str, Any]) -> None:
+    _RECENT.append(payload)
+    logger.debug(json.dumps(payload, default=str))
+
+
+def recent_events():
+    return list(_RECENT)
+
+
+@contextlib.contextmanager
+def log_verb(stage, method: str):
+    """Wrap a verb (fit/transform/...) with telemetry incl. errors + wall time."""
+    payload = {
+        "uid": getattr(stage, "uid", "?"),
+        "className": type(stage).__name__,
+        "method": method,
+        "buildVersion": build_version(),
+    }
+    t0 = time.perf_counter()
+    try:
+        yield
+        payload["seconds"] = round(time.perf_counter() - t0, 6)
+        log_event(payload)
+    except Exception as e:
+        payload["seconds"] = round(time.perf_counter() - t0, 6)
+        payload["error"] = f"{type(e).__name__}: {e}"
+        log_event(payload)
+        raise

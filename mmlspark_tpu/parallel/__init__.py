@@ -1,0 +1,16 @@
+from .mesh import (AXIS_DATA, AXIS_MODEL, AXIS_SEQ, AXIS_PIPE, AXIS_EXPERT,
+                   make_mesh, data_parallel_mesh, get_active_mesh,
+                   set_active_mesh, active_mesh, initialize_distributed)
+from .sharding import (named_sharding, replicated, batch_sharded, shard_batch,
+                       replicate, pad_to_multiple)
+from .collectives import (psum, pmean, pmax, all_gather, ppermute, ring_perm,
+                          axis_index, shard_mapped)
+
+__all__ = [
+    "AXIS_DATA", "AXIS_MODEL", "AXIS_SEQ", "AXIS_PIPE", "AXIS_EXPERT",
+    "make_mesh", "data_parallel_mesh", "get_active_mesh", "set_active_mesh",
+    "active_mesh", "initialize_distributed", "named_sharding", "replicated",
+    "batch_sharded", "shard_batch", "replicate", "pad_to_multiple", "psum",
+    "pmean", "pmax", "all_gather", "ppermute", "ring_perm", "axis_index",
+    "shard_mapped",
+]

@@ -1,0 +1,61 @@
+"""XLA collectives — the allreduce backend.
+
+Replaces the reference's three native comm channels (SURVEY.md §2.12):
+LightGBM's TCP-ring ``LGBM_NetworkInit`` allreduce, VW's spanning-tree
+allreduce, and the driver rendezvous.  Inside ``shard_map`` these lower to
+ICI/DCN collectives; helpers below also provide host-level one-shot reductions
+for driver-side aggregation.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Sequence
+
+from .mesh import AXIS_DATA, get_active_mesh
+
+
+def psum(x, axis: str = AXIS_DATA):
+    import jax
+    return jax.lax.psum(x, axis_name=axis)
+
+
+def pmean(x, axis: str = AXIS_DATA):
+    import jax
+    return jax.lax.pmean(x, axis_name=axis)
+
+
+def pmax(x, axis: str = AXIS_DATA):
+    import jax
+    return jax.lax.pmax(x, axis_name=axis)
+
+
+def all_gather(x, axis: str = AXIS_DATA, tiled: bool = True):
+    import jax
+    return jax.lax.all_gather(x, axis_name=axis, tiled=tiled)
+
+
+def ppermute(x, perm, axis: str = AXIS_DATA):
+    import jax
+    return jax.lax.ppermute(x, axis_name=axis, perm=perm)
+
+
+def ring_perm(n: int, shift: int = 1):
+    """Neighbour permutation for ring pipelines (ring attention etc.)."""
+    return [(i, (i + shift) % n) for i in range(n)]
+
+
+def axis_index(axis: str = AXIS_DATA):
+    import jax
+    return jax.lax.axis_index(axis)
+
+
+def shard_mapped(fn: Callable, mesh=None, in_specs=None, out_specs=None,
+                 check_vma: bool = False):
+    """Wrap fn with shard_map on the active mesh (SPMD entry point)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    mesh = mesh or get_active_mesh()
+    in_specs = in_specs if in_specs is not None else P(AXIS_DATA)
+    out_specs = out_specs if out_specs is not None else P()
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                         check_vma=check_vma)

@@ -1,0 +1,116 @@
+"""Device-mesh bootstrap — the rendezvous replacement.
+
+The reference bootstraps its distributed rings through three bespoke socket
+channels (SURVEY.md §2.12): a driver TCP rendezvous collecting ``host:port``
+from every task (``LightGBMBase.createDriverNodesThread:392-430``), LightGBM's
+C++ socket allreduce ring, and VW's spanning-tree server.  TPU-native, all
+three collapse into: form a ``jax.sharding.Mesh`` once (multi-host via
+``jax.distributed.initialize`` with the driver as coordinator) and let XLA
+collectives ride ICI/DCN.  This module owns mesh formation and the axis-name
+conventions used across the framework:
+
+- ``data``  — data parallelism (batch sharding; gradient/histogram psum)
+- ``model`` — tensor parallelism (weight sharding)
+- ``seq``   — sequence/context parallelism (ring attention)
+- ``pipe``  — pipeline parallelism stages
+- ``expert``— expert parallelism (MoE)
+"""
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+AXIS_SEQ = "seq"
+AXIS_PIPE = "pipe"
+AXIS_EXPERT = "expert"
+
+_ACTIVE_MESH = None
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Multi-host bootstrap: the Spark driver's only remaining distributed
+    role (SURVEY.md §2.12) — distribute the coordinator address, then each
+    executor (one per TPU host) calls this before any collective."""
+    import jax
+    kwargs = {}
+    if coordinator_address:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+
+
+def make_mesh(axes: Optional[Dict[str, int]] = None,
+              devices: Optional[Sequence] = None):
+    """Build a Mesh whose axis sizes multiply to the device count.
+
+    ``axes`` maps axis name -> size; a single ``-1`` size is inferred.  With
+    no axes, returns a 1-d data-parallel mesh over all devices.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices) if devices is not None else jax.devices()
+    n = len(devices)
+    if not axes:
+        axes = {AXIS_DATA: n}
+    axes = dict(axes)
+    sizes = list(axes.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis size may be -1")
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        if n % known:
+            raise ValueError(f"cannot infer axis: {n} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = n // known
+        axes = dict(zip(axes.keys(), sizes))
+    total = int(np.prod(list(axes.values())))
+    if total != n:
+        raise ValueError(f"mesh axes {axes} require {total} devices, have {n}")
+    dev_array = np.asarray(devices).reshape(*axes.values())
+    return Mesh(dev_array, tuple(axes.keys()))
+
+
+def data_parallel_mesh(num_devices: Optional[int] = None):
+    import jax
+    devices = jax.devices()[: num_devices or None]
+    return make_mesh({AXIS_DATA: len(devices)}, devices)
+
+
+def get_active_mesh():
+    """The framework-wide default mesh (set once at executor startup)."""
+    global _ACTIVE_MESH
+    if _ACTIVE_MESH is None:
+        _ACTIVE_MESH = data_parallel_mesh()
+    return _ACTIVE_MESH
+
+
+def set_active_mesh(mesh) -> None:
+    global _ACTIVE_MESH
+    _ACTIVE_MESH = mesh
+
+
+@contextmanager
+def active_mesh(mesh):
+    global _ACTIVE_MESH
+    prev = _ACTIVE_MESH
+    _ACTIVE_MESH = mesh
+    try:
+        yield mesh
+    finally:
+        _ACTIVE_MESH = prev
+
+
+def host_device_count_flag(n: int) -> str:
+    """XLA flag forcing n virtual CPU devices — the test-time 'cluster in a
+    box' (SURVEY.md §4 implications)."""
+    return f"--xla_force_host_platform_device_count={n}"

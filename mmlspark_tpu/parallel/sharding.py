@@ -1,0 +1,68 @@
+"""Sharding helpers — put arrays where the mesh wants them.
+
+Thin layer over ``jax.sharding.NamedSharding`` / ``PartitionSpec`` so stages
+can say "shard this batch over the data axis" or "replicate these weights"
+without repeating boilerplate.  Follows the scaling-book recipe: pick a mesh,
+annotate shardings, let XLA insert the collectives.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from .mesh import AXIS_DATA, get_active_mesh
+
+
+def named_sharding(mesh=None, *spec):
+    from jax.sharding import NamedSharding, PartitionSpec
+    mesh = mesh or get_active_mesh()
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def replicated(mesh=None):
+    return named_sharding(mesh)
+
+
+def batch_sharded(mesh=None, axis: str = AXIS_DATA):
+    """Leading dim sharded over the data axis; rest replicated."""
+    return named_sharding(mesh, axis)
+
+
+def shard_batch(x, mesh=None, axis: str = AXIS_DATA):
+    """Device_put a host array with its leading dim split over `axis`.
+    Pads the batch up to a multiple of the axis size (padding rows are
+    repeated last rows; callers mask via the returned valid-count)."""
+    import jax
+    mesh = mesh or get_active_mesh()
+    n_shards = mesh.shape[axis]
+    x = np.asarray(x)
+    n = x.shape[0]
+    rem = (-n) % n_shards
+    if rem:
+        pad = np.repeat(x[-1:], rem, axis=0)
+        x = np.concatenate([x, pad], axis=0)
+    return jax.device_put(x, batch_sharded(mesh, axis)), n
+
+
+def replicate(x, mesh=None):
+    import jax
+    return jax.device_put(x, replicated(mesh or get_active_mesh()))
+
+
+def pad_to_multiple(x: np.ndarray, multiple: int, axis: int = 0,
+                    fill: Optional[float] = None):
+    """Pad along `axis` to a multiple; returns (padded, original_length)."""
+    n = x.shape[axis]
+    rem = (-n) % multiple
+    if not rem:
+        return x, n
+    pad_shape = list(x.shape)
+    pad_shape[axis] = rem
+    if fill is None:
+        idx = [slice(None)] * x.ndim
+        idx[axis] = slice(n - 1, n)
+        pad = np.repeat(x[tuple(idx)], rem, axis=axis)
+    else:
+        pad = np.full(pad_shape, fill, dtype=x.dtype)
+    return np.concatenate([x, pad], axis=axis), n

@@ -1,0 +1,6 @@
+from .cluster import ClusterUtil
+from .stopwatch import StopWatch
+from .fault import retry_with_timeout, with_retries
+from .streams import using
+
+__all__ = ["ClusterUtil", "StopWatch", "retry_with_timeout", "with_retries", "using"]

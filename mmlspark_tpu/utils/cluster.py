@@ -1,0 +1,77 @@
+"""ClusterUtil — device/cluster topology oracle.
+
+Reference: ``core/utils/ClusterUtil.scala:20-145`` derives executor count,
+tasks-per-executor and driver host so LightGBM/VW can size their allreduce
+rings.  TPU-native, the topology is the JAX device set: one process per host,
+N local chips, global mesh over ICI/DCN.  This module answers the same
+questions (how many workers, who is the coordinator) in device terms and is
+consumed by the trainers and the mesh bootstrap (``parallel.mesh``).
+"""
+from __future__ import annotations
+
+import os
+import socket
+from dataclasses import dataclass
+from typing import List, Optional
+
+
+@dataclass(frozen=True)
+class Topology:
+    num_devices: int          # global chip count (ring size equivalent)
+    num_local_devices: int    # chips on this host (tasks-per-executor analogue)
+    num_hosts: int            # executor count analogue
+    host_index: int           # this executor's index
+    platform: str             # 'tpu' | 'cpu' | ...
+    coordinator: str          # driver host:port analogue
+
+
+class ClusterUtil:
+    """Static topology queries (mirrors reference ClusterUtil's static API)."""
+
+    _override: Optional[Topology] = None
+
+    @staticmethod
+    def get_topology() -> Topology:
+        if ClusterUtil._override is not None:
+            return ClusterUtil._override
+        import jax
+        devices = jax.devices()
+        return Topology(
+            num_devices=len(devices),
+            num_local_devices=len(jax.local_devices()),
+            num_hosts=jax.process_count(),
+            host_index=jax.process_index(),
+            platform=devices[0].platform if devices else "cpu",
+            coordinator=os.environ.get("MMLSPARK_TPU_COORDINATOR",
+                                       f"{socket.gethostname()}:0"),
+        )
+
+    @staticmethod
+    def set_topology_override(topo: Optional[Topology]) -> None:
+        """Tests inject synthetic topologies (reference tests spoof executor
+        counts through local[*] task settings)."""
+        ClusterUtil._override = topo
+
+    @staticmethod
+    def get_num_devices() -> int:
+        return ClusterUtil.get_topology().num_devices
+
+    @staticmethod
+    def get_num_hosts() -> int:
+        return ClusterUtil.get_topology().num_hosts
+
+    @staticmethod
+    def get_num_tasks_per_executor() -> int:
+        return ClusterUtil.get_topology().num_local_devices
+
+    @staticmethod
+    def get_driver_host() -> str:
+        return ClusterUtil.get_topology().coordinator.split(":")[0]
+
+    @staticmethod
+    def default_parallelism(df_partitions: int, requested: Optional[int] = None) -> int:
+        """How many data shards to train over: min(partitions, devices) unless
+        the caller pins a count (reference prepareDataframe repartition logic,
+        ``LightGBMBase.scala:110-145``)."""
+        n = requested or ClusterUtil.get_num_devices()
+        return max(1, min(n, df_partitions if df_partitions > 0 else n))

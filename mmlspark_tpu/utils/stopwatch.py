@@ -1,0 +1,38 @@
+"""StopWatch — nested wall-time decomposition.
+
+Reference: ``core/utils/StopWatch.scala`` as used by VW diagnostics
+(``VowpalWabbitBase.scala:294-329``) to split training time into
+ingest/learn/multipass percentages.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict
+
+
+class StopWatch:
+    def __init__(self):
+        self._totals: Dict[str, float] = {}
+        self._t0 = time.perf_counter()
+
+    @contextlib.contextmanager
+    def measure(self, name: str):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._totals[name] = self._totals.get(name, 0.0) + (time.perf_counter() - start)
+
+    def elapsed(self, name: str) -> float:
+        return self._totals.get(name, 0.0)
+
+    def total_elapsed(self) -> float:
+        return time.perf_counter() - self._t0
+
+    def percentages(self) -> Dict[str, float]:
+        total = self.total_elapsed()
+        return {k: 100.0 * v / total for k, v in self._totals.items()} if total > 0 else {}
+
+    def as_dict(self) -> Dict[str, float]:
+        return dict(self._totals)

@@ -1,0 +1,34 @@
+"""Test harness bootstrap.
+
+Reference test strategy (SURVEY.md §4): local[*] Spark with multiple tasks is
+the "cluster in a box".  Here the analogue is a virtual 8-device CPU platform
+(``--xla_force_host_platform_device_count=8``) so mesh/collective paths run
+in-process without TPU hardware; bench.py separately targets the real chip.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: env presets a TPU platform
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# sitecustomize may have imported jax._src before this conftest ran, freezing
+# config defaults from the original env — override explicitly.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from mmlspark_tpu.parallel import data_parallel_mesh
+    return data_parallel_mesh()
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(42)

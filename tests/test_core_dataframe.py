@@ -1,0 +1,95 @@
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame, ColumnType
+
+
+def make_df(n=10, parts=2):
+    return DataFrame.from_dict({
+        "x": np.arange(n, dtype=np.float64),
+        "y": np.arange(n) % 3,
+        "s": np.array([f"row{i}" for i in range(n)], dtype=object),
+    }, num_partitions=parts)
+
+
+def test_construction_and_counts():
+    df = make_df(10, 3)
+    assert df.count() == 10
+    assert df.num_partitions == 3
+    assert set(df.columns) == {"x", "y", "s"}
+    assert df.schema["x"] == ColumnType.DOUBLE
+    assert df.schema["s"] == ColumnType.STRING
+
+
+def test_select_drop_with_column():
+    df = make_df()
+    assert df.select("x").columns == ["x"]
+    assert "y" not in df.drop("y").columns
+    df2 = df.with_column("z", lambda p: p["x"] * 2)
+    assert np.allclose(df2.collect()["z"], np.arange(10) * 2.0)
+    df3 = df.with_column("c", 7)
+    assert (df3.collect()["c"] == 7).all()
+
+
+def test_filter_and_map_partitions():
+    df = make_df()
+    even = df.filter(lambda p: p["y"] == 0)
+    assert (even.collect()["y"] == 0).all()
+    doubled = df.map_partitions(lambda p: {"x2": p["x"] * 2})
+    assert doubled.columns == ["x2"]
+    assert doubled.count() == 10
+
+
+def test_repartition_coalesce_roundtrip():
+    df = make_df(11, 1).repartition(4)
+    assert df.num_partitions == 4
+    assert df.count() == 11
+    back = df.coalesce(2)
+    assert back.num_partitions == 2
+    assert np.allclose(np.sort(back.collect()["x"]), np.arange(11))
+
+
+def test_union_distinct_sort():
+    df = make_df(4, 1)
+    u = df.union(df)
+    assert u.count() == 8
+    assert u.distinct().count() == 4
+    s = u.sort("x", ascending=False)
+    assert s.collect()["x"][0] == 3
+
+
+def test_group_by_agg():
+    df = make_df(9, 2)
+    agg = df.group_by("y").agg(total=("x", "sum"), n=("x", "count"))
+    got = {int(k): v for k, v in zip(agg.collect()["y"], agg.collect()["total"])}
+    expect = {}
+    for i in range(9):
+        expect[i % 3] = expect.get(i % 3, 0) + float(i)
+    assert got == expect
+
+
+def test_join_inner_left():
+    a = DataFrame.from_dict({"k": np.array([1, 2, 3]), "v": np.array([10., 20., 30.])})
+    b = DataFrame.from_dict({"k": np.array([2, 3, 4]), "w": np.array([200., 300., 400.])})
+    j = a.join(b, on="k")
+    assert sorted(j.collect()["k"].tolist()) == [2, 3]
+    lj = a.join(b, on="k", how="left")
+    assert lj.count() == 3
+    w = lj.sort("k").collect()["w"]
+    assert np.isnan(w[0]) and w[1] == 200.
+
+
+def test_random_split_and_sample():
+    df = make_df(1000, 4)
+    tr, te = df.random_split([0.8, 0.2], seed=7)
+    assert tr.count() + te.count() == 1000
+    assert 100 < te.count() < 320
+
+
+def test_rows_roundtrip():
+    df = make_df(5, 2)
+    rows = list(df.iter_rows())
+    assert rows[0].s == "row0"
+    df2 = DataFrame.from_rows(rows)
+    assert df2.count() == 5
+    assert np.allclose(df2.collect()["x"], df.collect()["x"])

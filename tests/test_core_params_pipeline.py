@@ -1,0 +1,106 @@
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import (DataFrame, Estimator, Model, Param, Params,
+                               Pipeline, PipelineModel, ServiceParam,
+                               ServiceValue, Transformer, HasInputCol,
+                               HasOutputCol, load, save)
+
+
+class AddConst(Transformer, HasInputCol, HasOutputCol):
+    value = Param("value", "constant to add", "float", default=1.0)
+
+    def _transform(self, df):
+        v = self.get("value")
+        return df.with_column(self.get("output_col"), lambda p: p[self.get("input_col")] + v)
+
+
+class MeanShift(Estimator, HasInputCol, HasOutputCol):
+    def _fit(self, df):
+        mean = float(np.mean(df.collect()[self.get("input_col")]))
+        m = MeanShiftModel()
+        m.set("mean", mean).set("input_col", self.get("input_col")) \
+         .set("output_col", self.get("output_col"))
+        return m
+
+
+class MeanShiftModel(Model, HasInputCol, HasOutputCol):
+    mean = Param("mean", "fitted mean", "float")
+
+    def _transform(self, df):
+        mu = self.get("mean")
+        return df.with_column(self.get("output_col"), lambda p: p[self.get("input_col")] - mu)
+
+
+def df10():
+    return DataFrame.from_dict({"input": np.arange(10, dtype=np.float64)}, 2)
+
+
+def test_param_defaults_and_fluent():
+    t = AddConst()
+    assert t.get("value") == 1.0
+    t.set_value(5.0)
+    assert t.get_value == 5.0
+    with pytest.raises(KeyError):
+        t.get("nope")
+
+
+def test_transform_and_fit():
+    out = AddConst().set_value(2.0).transform(df10())
+    assert np.allclose(out.collect()["output"], np.arange(10) + 2.0)
+    model = MeanShift().fit(df10())
+    res = model.transform(df10()).collect()["output"]
+    assert abs(res.mean()) < 1e-9
+
+
+def test_pipeline_fit_transform():
+    pipe = Pipeline([AddConst().set_value(10.0).set_output_col("plus"),
+                     MeanShift().set_input_col("plus").set_output_col("centered")])
+    pm = pipe.fit(df10())
+    assert isinstance(pm, PipelineModel)
+    out = pm.transform(df10()).collect()["centered"]
+    assert abs(out.mean()) < 1e-9
+
+
+def test_save_load_roundtrip(tmp_path):
+    t = AddConst().set_value(3.5)
+    p = str(tmp_path / "stage")
+    save(t, p)
+    t2 = load(p)
+    assert isinstance(t2, AddConst)
+    assert t2.get("value") == 3.5
+    assert t2.uid == t.uid
+    out = t2.transform(df10())
+    assert np.allclose(out.collect()["output"], np.arange(10) + 3.5)
+
+
+def test_save_load_pipeline_model(tmp_path):
+    pipe = Pipeline([AddConst().set_value(1.0).set_output_col("a"),
+                     MeanShift().set_input_col("a").set_output_col("b")])
+    pm = pipe.fit(df10())
+    p = str(tmp_path / "pm")
+    save(pm, p)
+    pm2 = load(p)
+    a = pm.transform(df10()).collect()["b"]
+    b = pm2.transform(df10()).collect()["b"]
+    assert np.allclose(a, b)
+
+
+def test_service_param():
+    class Svc(Params):
+        text = ServiceParam("text", "text or column", required=True)
+
+    s = Svc()
+    s.set("text", "hello")
+    assert s.get("text").resolve({}) == "hello"
+    s.set_col("text", "c")
+    assert s.get("text").resolve({"c": "world"}) == "world"
+
+
+def test_telemetry_logged():
+    from mmlspark_tpu.core.logging import recent_events
+    AddConst().transform(df10())
+    evts = [e for e in recent_events() if e["className"] == "AddConst"]
+    assert evts and evts[-1]["method"] == "transform"

@@ -1,0 +1,45 @@
+import numpy as np
+import pytest
+
+
+def test_mesh_formation(mesh8):
+    assert mesh8.devices.size == 8
+    assert mesh8.axis_names == ("data",)
+
+
+def test_make_mesh_infer():
+    from mmlspark_tpu.parallel import make_mesh
+    m = make_mesh({"data": -1, "model": 2})
+    assert m.shape["data"] == 4 and m.shape["model"] == 2
+
+
+def test_shard_batch_and_psum(mesh8):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from mmlspark_tpu.parallel import shard_batch, shard_mapped, psum, active_mesh
+
+    with active_mesh(mesh8):
+        x, n = shard_batch(np.ones((13, 4), dtype=np.float32))
+        assert n == 13
+        assert x.shape[0] == 16  # padded to multiple of 8
+
+        def local_sum(xs):
+            return psum(jnp.sum(xs), "data")
+
+        total = shard_mapped(local_sum, mesh8, in_specs=P("data"), out_specs=P())(x)
+        assert float(total) == 16 * 4
+
+
+def test_ppermute_ring(mesh8):
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from mmlspark_tpu.parallel import shard_mapped, ppermute, ring_perm, axis_index
+
+    def shift(x):
+        return ppermute(x, ring_perm(8), "data")
+
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+    out = shard_mapped(shift, mesh8, in_specs=P("data"), out_specs=P("data"))(x)
+    expect = np.roll(np.arange(8), 1).reshape(8, 1)
+    assert np.allclose(np.asarray(out), expect)
