@@ -1,0 +1,83 @@
+"""Benchmark: ResNet-50 featurization images/sec/chip (BASELINE.json north star #2).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline context: the reference's CNTKModel/ImageFeaturizer ran per-executor
+CPU/GPU inference; the driver-supplied target is >=8x CPU-executor throughput
+(BASELINE.md).  vs_baseline is measured against this host's own CPU-executor
+throughput for the identical model, so >=8 means target met.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _images_per_sec(device_kind: str, batch: int = 32, steps: int = 20,
+                    hw: int = 224) -> float:
+    import jax
+    import jax.numpy as jnp
+    from mmlspark_tpu.models import resnet50
+    from mmlspark_tpu.ops import image as image_ops
+
+    module = resnet50(num_classes=1000, dtype=jnp.bfloat16)
+    x = jax.random.uniform(jax.random.PRNGKey(0), (batch, hw, hw, 3),
+                           jnp.float32, 0, 255)
+    variables = module.init(jax.random.PRNGKey(1), x)
+
+    @jax.jit
+    def featurize(variables, batch):
+        return module.apply(variables, image_ops.normalize(batch), features=True)
+
+    featurize(variables, x).block_until_ready()  # compile
+    # distinct pre-staged inputs each step + per-step sync: rules out
+    # result caching and async-dispatch undercounting
+    xs = [jax.random.uniform(jax.random.PRNGKey(i + 2), (batch, hw, hw, 3),
+                             jnp.float32, 0, 255) for i in range(min(8, steps))]
+    for z in xs:
+        z.block_until_ready()
+    t0 = time.perf_counter()
+    for i in range(steps):
+        out = featurize(variables, xs[i % len(xs)])
+        out.block_until_ready()
+    dt = time.perf_counter() - t0
+    return batch * steps / dt
+
+
+def main() -> None:
+    import jax
+    tpu_ips = _images_per_sec(jax.devices()[0].platform)
+
+    # CPU-executor baseline: same model on host CPU, smaller workload scaled up.
+    cpu_ips = None
+    try:
+        import subprocess, sys, os
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        code = (
+            "import os\n"
+            "import jax\n"
+            "jax.config.update('jax_platforms','cpu')\n"
+            "import bench\n"
+            "print('CPU_IPS', bench._images_per_sec('cpu', batch=8, steps=3))\n"
+        )
+        out = subprocess.run([sys.executable, "-c", code], env=env, cwd=os.path.dirname(
+            os.path.abspath(__file__)), capture_output=True, text=True, timeout=900)
+        for line in out.stdout.splitlines():
+            if line.startswith("CPU_IPS"):
+                cpu_ips = float(line.split()[1])
+    except Exception:
+        pass
+
+    vs = round(tpu_ips / cpu_ips, 3) if cpu_ips else None
+    print(json.dumps({
+        "metric": "resnet50_featurize_images_per_sec_per_chip",
+        "value": round(tpu_ips, 2),
+        "unit": "images/sec",
+        "vs_baseline": vs,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -17,8 +17,9 @@ from __future__ import annotations
 import importlib
 import json
 import os
-import pickle
 import shutil
+
+from ..utils import pickling as pickle
 import numpy as np
 from typing import Any, Dict
 

@@ -1,0 +1,6 @@
+from .jax_model import JaxModel, FlaxModelPayload
+from .image_featurizer import ImageFeaturizer
+from .model_downloader import ModelDownloader, ModelRepo, ModelSchema
+
+__all__ = ["JaxModel", "FlaxModelPayload", "ImageFeaturizer", "ModelDownloader",
+           "ModelRepo", "ModelSchema"]

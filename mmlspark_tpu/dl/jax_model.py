@@ -1,0 +1,189 @@
+"""JaxModel — the CNTKModel equivalent: broadcast graph, minibatched on-device inference.
+
+Reference: ``deep-learning/.../cntk/CNTKModel.scala`` — a SparkML Model that
+broadcasts a serialized CNTK graph, coerces dtypes, runs minibatched
+``model.evaluate`` per partition via JNI, and unbatches (``applyCNTKFunction``
+:34-73, ``applyModel`` :88-140, ``transform`` :500-545).
+
+TPU-native redesign:
+
+- the "graph" is a flax module (or any ``apply(variables, batch) -> array``
+  callable) plus its variables pytree — pickled/NPZ-serialized instead of
+  CNTK protobuf bytes;
+- minibatches are padded to fixed bucket shapes so ``jit`` compiles once per
+  bucket instead of once per batch shape (XLA static-shape semantics);
+- per-partition inference becomes one jitted call per minibatch on the
+  executor's local chip; with a multi-device mesh the batch dim is sharded
+  over ``data`` and params replicated (inference DP, SURVEY.md §2.11);
+- dtype coercion (reference ``coerceDFAndFeedDict`` :450-466) maps numeric /
+  vector / image columns onto the model's input dtype.
+"""
+from __future__ import annotations
+
+import os
+
+from ..utils import pickling as pickle
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from ..core import (ComplexParam, DataFrame, HasInputCol, HasOutputCol, Model,
+                    Param, Saveable)
+from ..core.schema import ColumnType, stack_vector_column
+from ..parallel import get_active_mesh, batch_sharded, replicated
+
+
+class FlaxModelPayload(Saveable):
+    """Serializable (module, variables, method kwargs) bundle.
+
+    The analogue of the reference's ``SerializableFunction`` wrapper around
+    CNTK JNI graphs (``com/microsoft/CNTK/SerializableFunction.scala``).
+    """
+
+    def __init__(self, module=None, variables=None, apply_fn: Optional[Callable] = None,
+                 apply_kwargs: Optional[Dict[str, Any]] = None):
+        if module is None and apply_fn is None:
+            raise ValueError("need a flax module or an apply_fn")
+        self.module = module
+        self.variables = variables
+        self.apply_fn = apply_fn
+        self.apply_kwargs = dict(apply_kwargs or {})
+
+    def apply(self, batch):
+        return self.pure_apply(self.variables, batch)
+
+    @property
+    def pure_apply(self) -> Callable:
+        """(variables, batch) -> output — the jit-compilable form."""
+        if self.apply_fn is not None:
+            return self.apply_fn
+        module, kw = self.module, self.apply_kwargs
+        def fn(variables, batch):
+            return module.apply(variables, batch, **kw)
+        return fn
+
+    # ------------------------------------------------------------ persistence
+    def save(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        import jax
+        from flax import traverse_util, core as flax_core
+        with open(os.path.join(path, "module.pkl"), "wb") as f:
+            pickle.dump({"module": self.module, "apply_fn": self.apply_fn,
+                         "apply_kwargs": self.apply_kwargs}, f)
+        if self.variables is not None:
+            var_dict = self.variables
+            if isinstance(var_dict, flax_core.FrozenDict):
+                var_dict = var_dict.unfreeze()
+            flat = traverse_util.flatten_dict(var_dict, sep="/")
+            np.savez(os.path.join(path, "variables.npz"),
+                     **{k: np.asarray(v) for k, v in flat.items()})
+
+    @classmethod
+    def load(cls, path: str) -> "FlaxModelPayload":
+        from flax import traverse_util
+        with open(os.path.join(path, "module.pkl"), "rb") as f:
+            meta = pickle.load(f)
+        variables = None
+        vpath = os.path.join(path, "variables.npz")
+        if os.path.exists(vpath):
+            with np.load(vpath, allow_pickle=False) as z:
+                flat = {k: z[k] for k in z.files}
+            variables = traverse_util.unflatten_dict(flat, sep="/")
+        return cls(module=meta["module"], variables=variables,
+                   apply_fn=meta["apply_fn"], apply_kwargs=meta["apply_kwargs"])
+
+
+class JaxModel(Model, HasInputCol, HasOutputCol):
+    """Minibatched on-device inference over a column of vectors/arrays."""
+
+    model = ComplexParam("model", "FlaxModelPayload to evaluate")
+    batch_size = Param("batch_size", "rows per device minibatch", "int", default=64,
+                       validator=lambda v: v > 0)
+    input_shape = Param("input_shape", "per-row input shape (list), e.g. [32,32,3]; "
+                                       "1-d vectors inferred if unset", "list")
+    input_dtype = Param("input_dtype", "numpy dtype name for model input", "string",
+                        default="float32")
+    output_mode = Param("output_mode", "'vector' (object column of arrays) or "
+                                       "'dense' (2-d float column)", "string",
+                        default="vector")
+
+    def __init__(self, uid: Optional[str] = None, **kwargs):
+        super().__init__(uid)
+        self._jit_cache: Dict[Any, Callable] = {}
+        if kwargs:
+            self.set_params(**kwargs)
+
+    def _post_load(self):
+        self._jit_cache = {}
+
+    # ------------------------------------------------------------ helpers
+    def set_model(self, module=None, variables=None, apply_fn=None, apply_kwargs=None):
+        self.set("model", FlaxModelPayload(module, variables, apply_fn, apply_kwargs))
+        return self
+
+    def _jitted(self, payload: FlaxModelPayload, padded_n: int, feat_shape):
+        key = (padded_n, tuple(feat_shape))
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            import jax
+            mesh = get_active_mesh()
+            n_dev = mesh.devices.size
+            pure = payload.pure_apply
+            if n_dev > 1 and padded_n % n_dev == 0:
+                fn = jax.jit(pure,
+                             in_shardings=(replicated(mesh), batch_sharded(mesh)),
+                             out_shardings=replicated(mesh))
+            else:
+                fn = jax.jit(pure)
+            self._jit_cache[key] = fn
+        return fn
+
+    def _stack_input(self, col: np.ndarray) -> np.ndarray:
+        shape = self.get("input_shape")
+        dtype = np.dtype(self.get("input_dtype"))
+        if col.dtype == object:
+            x = np.stack([np.asarray(v) for v in col])
+        else:
+            x = np.asarray(col)
+        if x.ndim == 1:
+            x = x[:, None]
+        if shape:
+            x = x.reshape((x.shape[0], *shape))
+        return x.astype(dtype, copy=False)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        payload: FlaxModelPayload = self.get_or_fail("model")
+        bs = self.get("batch_size")
+        in_col, out_col = self.get_or_fail("input_col"), self.get_or_fail("output_col")
+
+        def per_part(p):
+            col = p[in_col]
+            n = len(col)
+            if n == 0:
+                return {**p, out_col: np.empty(0, dtype=object)}
+            x = self._stack_input(col)
+            outs = []
+            variables = payload.variables
+            for start in range(0, n, bs):
+                chunk = x[start:start + bs]
+                m = chunk.shape[0]
+                if m < bs:  # pad to the bucket so jit reuses the compiled fn
+                    pad = np.repeat(chunk[-1:], bs - m, axis=0)
+                    chunk = np.concatenate([chunk, pad], axis=0)
+                fn = self._jitted(payload, bs, chunk.shape[1:])
+                y = np.asarray(fn(variables, chunk))[:m]
+                outs.append(y)
+            y = np.concatenate(outs, axis=0)
+            if self.get("output_mode") == "dense" and y.ndim == 2:
+                out_val = y
+            else:
+                out_val = np.empty(n, dtype=object)
+                for i in range(n):
+                    out_val[i] = y[i]
+            return {**p, out_col: out_val}
+
+        return df.map_partitions(per_part)
+
+    def transform_schema(self, schema):
+        schema.require(self.get_or_fail("input_col"))
+        return schema.add(self.get_or_fail("output_col"), ColumnType.VECTOR)

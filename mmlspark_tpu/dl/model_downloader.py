@@ -1,0 +1,121 @@
+"""ModelDownloader — local repository of named model checkpoints.
+
+Reference: ``deep-learning/.../downloader/ModelDownloader.scala:26-112`` — a
+``Repository`` of pretrained models with JSON ``ModelSchema`` metadata,
+fetched from remote/HDFS into a local cache.  This environment is zero-egress,
+so the repository is local-filesystem only: models are registered (name ->
+flax module factory + optional checkpoint dir) and materialised on demand with
+random init when no checkpoint exists.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .jax_model import FlaxModelPayload
+
+
+@dataclasses.dataclass
+class ModelSchema:
+    """Reference ``downloader/Schema.scala`` ModelSchema analogue."""
+    name: str
+    dataset: str = ""
+    model_type: str = "classification"
+    input_shape: Optional[List[int]] = None
+    num_outputs: int = 1000
+    uri: str = ""          # local checkpoint dir, if materialised
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @staticmethod
+    def from_json(s: str) -> "ModelSchema":
+        return ModelSchema(**json.loads(s))
+
+
+def _zoo() -> Dict[str, Callable[..., Any]]:
+    from ..models import resnet, bilstm
+    return {
+        "ResNet18": lambda **kw: resnet.resnet18(**kw),
+        "ResNet34": lambda **kw: resnet.resnet34(**kw),
+        "ResNet50": lambda **kw: resnet.resnet50(**kw),
+        "ResNet101": lambda **kw: resnet.resnet101(**kw),
+        "BiLSTM": lambda **kw: bilstm.BiLSTMTagger(
+            vocab_size=kw.pop("vocab_size", 32768), num_tags=kw.pop("num_tags", 32), **kw),
+    }
+
+
+_DEFAULT_SHAPES: Dict[str, List[int]] = {
+    "ResNet18": [224, 224, 3], "ResNet34": [224, 224, 3],
+    "ResNet50": [224, 224, 3], "ResNet101": [224, 224, 3],
+}
+
+
+class ModelRepo:
+    """Filesystem model repository (HDFSRepo/DefaultModelRepo analogue)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def list_models(self) -> List[ModelSchema]:
+        out = []
+        for name in sorted(os.listdir(self.root)):
+            meta = os.path.join(self.root, name, "schema.json")
+            if os.path.exists(meta):
+                with open(meta) as f:
+                    out.append(ModelSchema.from_json(f.read()))
+        return out
+
+    def save_model(self, schema: ModelSchema, payload: FlaxModelPayload) -> str:
+        path = os.path.join(self.root, schema.name)
+        payload.save(os.path.join(path, "checkpoint"))
+        schema.uri = os.path.join(path, "checkpoint")
+        with open(os.path.join(path, "schema.json"), "w") as f:
+            f.write(schema.to_json())
+        return path
+
+    def load_model(self, name: str) -> FlaxModelPayload:
+        path = os.path.join(self.root, name, "checkpoint")
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"model '{name}' not in repo {self.root}")
+        return FlaxModelPayload.load(path)
+
+
+class ModelDownloader:
+    """Materialise named models: from the local repo when present, otherwise
+    random-init from the in-tree zoo (the zero-egress stand-in for the
+    reference's remote fetch)."""
+
+    def __init__(self, local_cache: Optional[str] = None):
+        self.repo = ModelRepo(local_cache) if local_cache else None
+
+    def download_by_name(self, name: str, seed: int = 0, **model_kwargs) -> FlaxModelPayload:
+        if self.repo is not None:
+            try:
+                return self.repo.load_model(name)
+            except FileNotFoundError:
+                pass
+        zoo = _zoo()
+        if name not in zoo:
+            raise KeyError(f"unknown model '{name}'; zoo has {sorted(zoo)}")
+        import jax
+        import jax.numpy as jnp
+        module = zoo[name](**model_kwargs)
+        shape = _DEFAULT_SHAPES.get(name)
+        if shape is not None:
+            dummy = jnp.zeros((1, *shape), jnp.float32)
+        else:  # sequence models take int tokens
+            dummy = jnp.zeros((1, 16), jnp.int32)
+        variables = module.init(jax.random.PRNGKey(seed), dummy)
+        payload = FlaxModelPayload(module=module, variables=variables,
+                                   apply_kwargs={})
+        if self.repo is not None:
+            schema = ModelSchema(name=name, input_shape=shape,
+                                 model_type="classification")
+            self.repo.save_model(schema, payload)
+        return payload

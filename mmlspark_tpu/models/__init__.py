@@ -1,0 +1,5 @@
+from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101
+from .bilstm import BiLSTMTagger, LSTMLayer
+
+__all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
+           "BiLSTMTagger", "LSTMLayer"]

@@ -1,0 +1,51 @@
+"""BiLSTM sequence tagger — the medical-entity-extraction model family.
+
+Reference capability: ``notebooks/DeepLearning - BiLSTM Medical Entity
+Extraction.ipynb`` evaluates a pretrained CNTK BiLSTM per row.  Here it is a
+flax module whose recurrence is a ``lax.scan``-based LSTM (compiler-friendly
+control flow, static shapes); long sequences can additionally be sharded over
+the ``seq`` mesh axis via ``parallel.ring_attention`` blockwise primitives.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class LSTMLayer(nn.Module):
+    """One directional LSTM over (batch, time, feat) via flax's scan-based RNN."""
+    hidden: int
+    reverse: bool = False
+
+    @nn.compact
+    def __call__(self, xs):
+        rnn = nn.RNN(nn.OptimizedLSTMCell(self.hidden),
+                     reverse=self.reverse, keep_order=True)
+        return rnn(xs)
+
+
+class BiLSTMTagger(nn.Module):
+    """Embedding -> stacked BiLSTM -> per-token classification head."""
+
+    vocab_size: int
+    num_tags: int
+    embed_dim: int = 128
+    hidden: int = 256
+    num_layers: int = 2
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False, features: bool = False):
+        # tokens: (batch, time) int32
+        x = nn.Embed(self.vocab_size, self.embed_dim, dtype=self.dtype)(tokens)
+        for i in range(self.num_layers):
+            fwd = LSTMLayer(self.hidden, reverse=False, name=f"fwd_{i}")(x)
+            bwd = LSTMLayer(self.hidden, reverse=True, name=f"bwd_{i}")(x)
+            x = jnp.concatenate([fwd, bwd], axis=-1)
+        if features:
+            return x.astype(jnp.float32)
+        logits = nn.Dense(self.num_tags, dtype=self.dtype, name="head")(x)
+        return logits.astype(jnp.float32)

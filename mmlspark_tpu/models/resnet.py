@@ -1,0 +1,115 @@
+"""ResNet family in flax — the ImageFeaturizer backbone.
+
+Reference capability: ``deep-learning/.../ImageFeaturizer.scala`` featurizes
+images with a pretrained CNN whose head is truncated (``cutOutputLayers``).
+The reference evaluates CNTK graphs; here the models are native flax modules
+jit-compiled onto the TPU's MXU (NHWC layout, bf16-friendly), and "layer
+cutting" is expressed by requesting intermediate outputs.
+
+No pretrained weights ship in this environment (zero egress); weights are
+randomly initialised or loaded from a local checkpoint via
+``mmlspark_tpu.dl.ModelDownloader``.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1), use_bias=False)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), self.strides, use_bias=False)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1), use_bias=False)(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), self.strides,
+                                 use_bias=False, name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides, use_bias=False)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), use_bias=False)(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), self.strides,
+                                 use_bias=False, name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """NHWC ResNet.  ``__call__`` returns logits; ``features=True`` returns the
+    pooled penultimate embedding (the featurizer path, = cutOutputLayers=1)."""
+
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, features: bool = False):
+        conv = partial(nn.Conv, dtype=self.dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                 use_bias=False, name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(self.num_filters * 2 ** i, strides=strides,
+                                   conv=conv, norm=norm)(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool -> (N, C)
+        if features:
+            return x.astype(jnp.float32)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def resnet18(num_classes: int = 1000, dtype=jnp.float32) -> ResNet:
+    return ResNet([2, 2, 2, 2], BasicBlock, num_classes, dtype=dtype)
+
+
+def resnet34(num_classes: int = 1000, dtype=jnp.float32) -> ResNet:
+    return ResNet([3, 4, 6, 3], BasicBlock, num_classes, dtype=dtype)
+
+
+def resnet50(num_classes: int = 1000, dtype=jnp.float32) -> ResNet:
+    return ResNet([3, 4, 6, 3], BottleneckBlock, num_classes, dtype=dtype)
+
+
+def resnet101(num_classes: int = 1000, dtype=jnp.float32) -> ResNet:
+    return ResNet([3, 4, 23, 3], BottleneckBlock, num_classes, dtype=dtype)

@@ -1,0 +1,93 @@
+"""Device-side image ops — the OpenCV replacement for the compute path.
+
+Reference: ``opencv/.../ImageTransformer.scala:42-220`` applies per-row JNI
+``Mat`` ops (resize/crop/flip/blur/threshold/color).  TPU-first these are
+batched jitted array ops: NHWC uint8/float batches in, XLA fuses the chain.
+Decode (png/jpg bytes -> array) stays host-side in ``io.image``.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def resize(images: jnp.ndarray, height: int, width: int,
+           method: str = "linear") -> jnp.ndarray:
+    """Batched resize, NHWC."""
+    n, _, _, c = images.shape
+    return jax.image.resize(images.astype(jnp.float32),
+                            (n, height, width, c), method=method)
+
+
+def center_crop(images: jnp.ndarray, height: int, width: int) -> jnp.ndarray:
+    _, h, w, _ = images.shape
+    top = max(0, (h - height) // 2)
+    left = max(0, (w - width) // 2)
+    return images[:, top:top + height, left:left + width, :]
+
+
+def crop(images: jnp.ndarray, x: int, y: int, height: int, width: int) -> jnp.ndarray:
+    return images[:, y:y + height, x:x + width, :]
+
+
+def flip(images: jnp.ndarray, horizontal: bool = True) -> jnp.ndarray:
+    axis = 2 if horizontal else 1
+    return jnp.flip(images, axis=axis)
+
+
+def normalize(images: jnp.ndarray,
+              mean: Sequence[float] = (0.485, 0.456, 0.406),
+              std: Sequence[float] = (0.229, 0.224, 0.225),
+              scale: float = 1.0 / 255.0) -> jnp.ndarray:
+    x = images.astype(jnp.float32) * scale
+    return (x - jnp.asarray(mean)) / jnp.asarray(std)
+
+
+def gaussian_kernel(size: int, sigma: float) -> jnp.ndarray:
+    ax = jnp.arange(size, dtype=jnp.float32) - (size - 1) / 2.0
+    g = jnp.exp(-(ax ** 2) / (2.0 * sigma ** 2))
+    k = jnp.outer(g, g)
+    return k / jnp.sum(k)
+
+
+def blur(images: jnp.ndarray, kernel_size: int = 5, sigma: float = 1.0) -> jnp.ndarray:
+    """Depthwise gaussian blur via conv (VPU/MXU friendly)."""
+    k = gaussian_kernel(kernel_size, sigma)
+    c = images.shape[-1]
+    kern = jnp.tile(k[:, :, None, None], (1, 1, 1, c))  # HWIO depthwise
+    x = images.astype(jnp.float32)
+    return jax.lax.conv_general_dilated(
+        x, kern, window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"), feature_group_count=c)
+
+
+def threshold(images: jnp.ndarray, thresh: float, max_val: float = 255.0,
+              kind: str = "binary") -> jnp.ndarray:
+    x = images.astype(jnp.float32)
+    if kind == "binary":
+        return jnp.where(x > thresh, max_val, 0.0)
+    if kind == "binary_inv":
+        return jnp.where(x > thresh, 0.0, max_val)
+    if kind == "trunc":
+        return jnp.minimum(x, thresh)
+    if kind == "tozero":
+        return jnp.where(x > thresh, x, 0.0)
+    if kind == "tozero_inv":
+        return jnp.where(x > thresh, 0.0, x)
+    raise ValueError(f"unknown threshold kind {kind!r}")
+
+
+def to_grayscale(images: jnp.ndarray) -> jnp.ndarray:
+    """RGB -> single-channel luminance (color-format op equivalent)."""
+    w = jnp.asarray([0.299, 0.587, 0.114])
+    return jnp.sum(images.astype(jnp.float32) * w, axis=-1, keepdims=True)
+
+
+def unroll(images: jnp.ndarray) -> jnp.ndarray:
+    """(N,H,W,C) -> (N, H*W*C): reference ``UnrollImage`` (image/)."""
+    n = images.shape[0]
+    return images.reshape(n, -1)

@@ -1,0 +1,176 @@
+"""Distributed training step builder — dp×tp sharded train steps via jit.
+
+The reference's training parallelism is data-parallel tasks + native allreduce
+(SURVEY.md §2.11).  TPU-native we go further: a 2-d ``data × model`` mesh
+where the batch is sharded over ``data`` and large Dense/Conv kernels are
+sharded over ``model`` (tensor parallelism).  XLA inserts the gradient psums
+and weight all-gathers from the sharding annotations alone (scaling-book
+recipe) — there is no hand-written allreduce anywhere.
+
+``shard_params_by_rule`` implements the annotation policy; ``Trainer`` builds
+a jitted ``train_step`` with donated state so optimizer updates happen
+in-place in HBM.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from .mesh import AXIS_DATA, AXIS_MODEL, get_active_mesh
+
+
+@dataclasses.dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: Any
+    batch_stats: Any = None
+
+
+def _register_trainstate():
+    import jax
+    jax.tree_util.register_dataclass(
+        TrainState, data_fields=["params", "opt_state", "step", "batch_stats"],
+        meta_fields=[])
+
+
+_register_trainstate()
+
+
+def param_spec(leaf, model_axis_size: int, min_size: int = 2 ** 16):
+    """Sharding rule: shard the last axis of big >=2-d kernels over `model`;
+    replicate everything else.  Keeps small params replicated (cheap) and the
+    MXU-heavy matmuls tensor-parallel."""
+    from jax.sharding import PartitionSpec as P
+    shape = getattr(leaf, "shape", ())
+    if len(shape) >= 2 and np.prod(shape) >= min_size and shape[-1] % model_axis_size == 0 \
+            and model_axis_size > 1:
+        return P(*([None] * (len(shape) - 1) + [AXIS_MODEL]))
+    return P()
+
+
+def shard_params_by_rule(params, mesh, min_size: int = 2 ** 16):
+    import jax
+    from jax.sharding import NamedSharding
+    model_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get(AXIS_MODEL, 1)
+    return jax.tree.map(
+        lambda leaf: NamedSharding(mesh, param_spec(leaf, model_size, min_size)), params)
+
+
+class Trainer:
+    """Builds sharded, jitted train/eval steps for a flax module.
+
+    loss_fn(logits, batch) -> scalar; the module is applied to
+    ``batch["x"]``.  BatchNorm modules (mutable batch_stats) are supported.
+    """
+
+    def __init__(self, module, optimizer, loss_fn: Callable,
+                 mesh=None, has_batch_stats: bool = False,
+                 apply_kwargs: Optional[Dict[str, Any]] = None,
+                 min_shard_size: int = 2 ** 16):
+        self.module = module
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.mesh = mesh or get_active_mesh()
+        self.has_batch_stats = has_batch_stats
+        self.apply_kwargs = dict(apply_kwargs or {})
+        self.min_shard_size = min_shard_size
+        self._train_step = None
+        self._state_shardings = None
+
+    # ------------------------------------------------------------------ init
+    def init_state(self, rng, example_batch) -> TrainState:
+        import jax
+        import jax.numpy as jnp
+        variables = self.module.init(rng, example_batch["x"], **self.apply_kwargs)
+        params = variables["params"]
+        batch_stats = variables.get("batch_stats") if self.has_batch_stats else None
+        opt_state = self.optimizer.init(params)
+        state = TrainState(params=params, opt_state=opt_state,
+                           step=jnp.zeros((), jnp.int32), batch_stats=batch_stats)
+        return self.shard_state(state)
+
+    def shard_state(self, state: TrainState) -> TrainState:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = self.mesh
+        p_shard = shard_params_by_rule(state.params, mesh, self.min_shard_size)
+        rep = NamedSharding(mesh, P())
+        opt_shard = jax.tree.map(lambda _: rep, state.opt_state)
+        bs_shard = None if state.batch_stats is None else \
+            jax.tree.map(lambda _: rep, state.batch_stats)
+        self._state_shardings = TrainState(params=p_shard, opt_state=opt_shard,
+                                           step=rep, batch_stats=bs_shard)
+        put = lambda x, s: jax.device_put(x, s)
+        return TrainState(
+            params=jax.tree.map(put, state.params, p_shard),
+            opt_state=jax.tree.map(put, state.opt_state, opt_shard),
+            step=jax.device_put(state.step, rep),
+            batch_stats=None if state.batch_stats is None else
+            jax.tree.map(put, state.batch_stats, bs_shard))
+
+    # ------------------------------------------------------------------ steps
+    def _build_train_step(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = self.mesh
+        batch_sh = NamedSharding(mesh, P(AXIS_DATA))
+        rep = NamedSharding(mesh, P())
+        module, loss_fn, opt = self.module, self.loss_fn, self.optimizer
+        has_bs, kw = self.has_batch_stats, self.apply_kwargs
+
+        def step_fn(state: TrainState, batch):
+            def loss(params):
+                variables = {"params": params}
+                if has_bs:
+                    variables["batch_stats"] = state.batch_stats
+                    out, updates = module.apply(variables, batch["x"], train=True,
+                                                mutable=["batch_stats"], **kw)
+                    return loss_fn(out, batch), updates["batch_stats"]
+                out = module.apply(variables, batch["x"], train=True, **kw) \
+                    if _accepts_train(module) else module.apply(variables, batch["x"], **kw)
+                return loss_fn(out, batch), None
+
+            (l, new_bs), grads = jax.value_and_grad(loss, has_aux=True)(state.params)
+            updates, new_opt = opt.update(grads, state.opt_state, state.params)
+            import optax
+            new_params = optax.apply_updates(state.params, updates)
+            return TrainState(params=new_params, opt_state=new_opt,
+                              step=state.step + 1,
+                              batch_stats=new_bs if has_bs else None), l
+
+        sh = self._state_shardings
+        state_in = TrainState(params=sh.params, opt_state=sh.opt_state,
+                              step=sh.step, batch_stats=sh.batch_stats)
+        return jax.jit(
+            step_fn,
+            in_shardings=(state_in, {"x": batch_sh, "y": batch_sh}),
+            out_shardings=(state_in, rep),
+            donate_argnums=(0,))
+
+    def train_step(self, state: TrainState, batch) -> Tuple[TrainState, Any]:
+        if self._train_step is None:
+            if self._state_shardings is None:
+                raise RuntimeError("call init_state/shard_state before train_step")
+            self._train_step = self._build_train_step()
+        return self._train_step(state, batch)
+
+
+def _accepts_train(module) -> bool:
+    import inspect
+    try:
+        return "train" in inspect.signature(module.__call__).parameters
+    except (TypeError, ValueError):
+        return False
+
+
+def softmax_cross_entropy(logits, batch):
+    import jax.numpy as jnp
+    import optax
+    labels = batch["y"]
+    if labels.ndim == logits.ndim:  # one-hot
+        return optax.softmax_cross_entropy(logits, labels).mean()
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()

@@ -1,0 +1,5 @@
+from .minibatch import (FixedMiniBatchTransformer, DynamicMiniBatchTransformer,
+                        TimeIntervalMiniBatchTransformer, FlattenBatch)
+
+__all__ = ["FixedMiniBatchTransformer", "DynamicMiniBatchTransformer",
+           "TimeIntervalMiniBatchTransformer", "FlattenBatch"]
