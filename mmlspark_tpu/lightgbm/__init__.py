@@ -1,0 +1,10 @@
+from .binning import BinMapper
+from .core import GBDTParams, train, TrainResult
+from .estimators import (LightGBMClassifier, LightGBMClassificationModel,
+                         LightGBMRegressor, LightGBMRegressionModel,
+                         LightGBMRanker, LightGBMRankerModel)
+
+__all__ = ["BinMapper", "GBDTParams", "train", "TrainResult",
+           "LightGBMClassifier", "LightGBMClassificationModel",
+           "LightGBMRegressor", "LightGBMRegressionModel",
+           "LightGBMRanker", "LightGBMRankerModel"]

@@ -1,0 +1,603 @@
+"""GBDT training core — level-wise tree growth as a jitted XLA program.
+
+Reference hot path: ``TrainUtils.trainCore`` (``TrainUtils.scala:92-159``)
+calls ``LGBM_BoosterUpdateOneIter`` per iteration — native histogram build +
+socket allreduce + split finding.  TPU-native, one boosting iteration is a
+single jitted function:
+
+  histograms  = one fused segment-sum scatter   (ops.histogram)       [VPU]
+  split find  = cumsum + argmax over (node, feature, bin)             [VPU]
+  routing     = gather of each row's split decision                   [VPU]
+  ... repeated depth-wise (python loop over static depth => unrolled XLA)
+
+Across data shards the histogram tensors are psum'd over the mesh's ``data``
+axis (GSPMD inserts the collective from sharding annotations) — this replaces
+LightGBM's ``data_parallel`` TCP-ring allreduce.  ``voting_parallel``'s top-K
+trick is unnecessary on ICI (histogram psum is bandwidth-cheap relative to
+HBM traffic) but the param is accepted for API parity.
+
+Supports the reference's boosting modes (``boosting_type`` gbdt/rf/dart/goss,
+``params/TrainParams.scala``), objectives, bagging, feature_fraction, L1/L2,
+min_data_in_leaf, early stopping, and warm start from an existing booster.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..models.gbdt import GBDTBooster
+from ..ops.histogram import build_histograms
+from .binning import BinMapper
+
+
+@dataclasses.dataclass
+class GBDTParams:
+    num_iterations: int = 100
+    learning_rate: float = 0.1
+    max_depth: int = 5               # 2^5 = 32 leaves ~ LightGBM num_leaves=31
+    num_leaves: Optional[int] = None  # accepted for parity; sets max_depth
+    max_bin: int = 255
+    objective: str = "binary"
+    num_class: int = 1
+    boosting_type: str = "gbdt"      # gbdt | rf | dart | goss
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    min_gain_to_split: float = 0.0
+    bagging_fraction: float = 1.0
+    bagging_freq: int = 0
+    feature_fraction: float = 1.0
+    # goss
+    top_rate: float = 0.2
+    other_rate: float = 0.1
+    # dart
+    drop_rate: float = 0.1
+    max_drop: int = 50
+    skip_drop: float = 0.5
+    # misc
+    max_delta_step: float = 0.0
+    sigmoid: float = 1.0
+    alpha: float = 0.9               # huber / quantile
+    early_stopping_round: int = 0
+    metric: str = ""
+    seed: int = 0
+    verbosity: int = -1
+
+    def resolve(self) -> "GBDTParams":
+        p = dataclasses.replace(self)
+        if p.num_leaves:
+            p.max_depth = max(1, int(math.ceil(math.log2(max(2, p.num_leaves)))))
+        if p.boosting_type == "rf" and p.bagging_freq == 0:
+            p.bagging_freq, p.bagging_fraction = 1, min(p.bagging_fraction, 0.632)
+        return p
+
+
+# ---------------------------------------------------------------------------
+# objectives: (scores, y, w) -> grad, hess     [all jitted, (n,K) scores]
+# ---------------------------------------------------------------------------
+
+def make_objective(params: GBDTParams) -> Callable:
+    import jax.numpy as jnp
+    obj, K = params.objective, params.num_class
+    sig, alpha = params.sigmoid, params.alpha
+
+    def binary(scores, y, w):
+        p = 1.0 / (1.0 + jnp.exp(-sig * scores[:, 0]))
+        g = sig * (p - y)
+        h = jnp.maximum(sig * sig * p * (1.0 - p), 1e-16)
+        return (g * w)[:, None], (h * w)[:, None]
+
+    def multiclass(scores, y, w):
+        z = scores - scores.max(axis=1, keepdims=True)
+        e = jnp.exp(z)
+        p = e / e.sum(axis=1, keepdims=True)
+        onehot = (y[:, None] == jnp.arange(K)[None, :]).astype(p.dtype)
+        g = p - onehot
+        h = jnp.maximum(2.0 * p * (1.0 - p), 1e-16)
+        return g * w[:, None], h * w[:, None]
+
+    def l2(scores, y, w):
+        g = scores[:, 0] - y
+        return (g * w)[:, None], (w * jnp.ones_like(g))[:, None]
+
+    def l1(scores, y, w):
+        g = jnp.sign(scores[:, 0] - y)
+        return (g * w)[:, None], (w * jnp.ones_like(g))[:, None]
+
+    def huber(scores, y, w):
+        d = scores[:, 0] - y
+        g = jnp.clip(d, -alpha, alpha)
+        return (g * w)[:, None], (w * jnp.ones_like(g))[:, None]
+
+    def quantile(scores, y, w):
+        d = scores[:, 0] - y
+        g = jnp.where(d >= 0, 1.0 - alpha, -alpha)
+        return (g * w)[:, None], (w * jnp.ones_like(g))[:, None]
+
+    table = {"binary": binary, "multiclass": multiclass, "regression": l2,
+             "regression_l1": l1, "huber": huber, "quantile": quantile}
+    if obj not in table and obj != "lambdarank":
+        raise ValueError(f"unknown objective {obj!r}")
+    return table.get(obj)
+
+
+def lambdarank_grads(scores: np.ndarray, y: np.ndarray, group_ptr: np.ndarray,
+                     sigmoid: float = 1.0, trunc: int = 30) -> Tuple[np.ndarray, np.ndarray]:
+    """LambdaRank gradients with |ΔNDCG| weighting, per query group.
+
+    Padded-group tensorization: groups packed to (Q, Gmax) so the pairwise
+    (Q, Gmax, Gmax) lambda computation is one jitted einsum-like pass —
+    the XLA-friendly reshape of the reference's per-query C++ loops.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n = scores.shape[0]
+    q = len(group_ptr) - 1
+    gmax = int(max(group_ptr[i + 1] - group_ptr[i] for i in range(q)))
+    S = np.zeros((q, gmax), np.float32)
+    Y = np.zeros((q, gmax), np.float32)
+    M = np.zeros((q, gmax), np.float32)
+    for i in range(q):
+        a, b = group_ptr[i], group_ptr[i + 1]
+        S[i, : b - a] = scores[a:b, 0]
+        Y[i, : b - a] = y[a:b]
+        M[i, : b - a] = 1.0
+
+    @jax.jit
+    def lam(S, Y, M):
+        gain = (2.0 ** Y - 1.0) * M
+        order = jnp.argsort(-jnp.where(M > 0, S, -jnp.inf), axis=1)
+        ranks = jnp.argsort(order, axis=1).astype(jnp.float32)  # 0-based rank
+        disc = 1.0 / jnp.log2(ranks + 2.0)
+        ideal_gain = -jnp.sort(-gain, axis=1)
+        ideal_disc = 1.0 / jnp.log2(jnp.arange(gmax, dtype=jnp.float32) + 2.0)
+        idcg = jnp.sum(ideal_gain * ideal_disc, axis=1, keepdims=True)
+        idcg = jnp.maximum(idcg, 1e-9)
+        sdiff = S[:, :, None] - S[:, None, :]
+        rho = 1.0 / (1.0 + jnp.exp(sigmoid * sdiff))      # P(j beats i)
+        better = (Y[:, :, None] > Y[:, None, :]) & (M[:, :, None] > 0) & (M[:, None, :] > 0)
+        delta_ndcg = jnp.abs(
+            (gain[:, :, None] - gain[:, None, :]) *
+            (disc[:, :, None] - disc[:, None, :])) / idcg[:, :, None]
+        lam_ij = jnp.where(better, -sigmoid * rho * delta_ndcg, 0.0)
+        hess_ij = jnp.where(better, sigmoid * sigmoid * rho * (1 - rho) * delta_ndcg, 0.0)
+        g = jnp.sum(lam_ij, axis=2) - jnp.sum(lam_ij, axis=1)
+        h = jnp.sum(hess_ij, axis=2) + jnp.sum(hess_ij, axis=1)
+        return g, jnp.maximum(h, 1e-16)
+
+    G, H = lam(jnp.asarray(S), jnp.asarray(Y), jnp.asarray(M))
+    G, H = np.asarray(G), np.asarray(H)
+    g = np.zeros((n, 1), np.float32)
+    h = np.zeros((n, 1), np.float32)
+    for i in range(q):
+        a, b = group_ptr[i], group_ptr[i + 1]
+        g[a:b, 0] = G[i, : b - a]
+        h[a:b, 0] = H[i, : b - a]
+    return g, h
+
+
+# ---------------------------------------------------------------------------
+# tree grower
+# ---------------------------------------------------------------------------
+
+def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
+                     params: GBDTParams):
+    """Returns jitted grow(binned, grad, hess, hist_mask, feat_mask, edges)
+    -> (tree arrays..., leaf_of_row)."""
+    import jax
+    import jax.numpy as jnp
+
+    D, F, B = max_depth, num_features, num_bins
+    I = 2 ** D - 1     # internal nodes
+    L = 2 ** D         # leaves
+    l1, l2 = params.lambda_l1, params.lambda_l2
+    min_data = float(params.min_data_in_leaf)
+    min_hess = params.min_sum_hessian_in_leaf
+    min_gain = params.min_gain_to_split
+    max_delta = params.max_delta_step
+
+    def thresh(G):
+        return jnp.sign(G) * jnp.maximum(jnp.abs(G) - l1, 0.0)
+
+    def leaf_score(G, H):
+        return thresh(G) ** 2 / (H + l2)
+
+    def leaf_output(G, H):
+        v = -thresh(G) / (H + l2)
+        if max_delta > 0:
+            v = jnp.clip(v, -max_delta, max_delta)
+        return v
+
+    @jax.jit
+    def grow(binned, grad, hess, hist_mask, feat_mask, edges):
+        n = binned.shape[0]
+        node = jnp.zeros((n,), jnp.int32)          # level-local node, all rows
+        split_feature = jnp.full((I,), -1, jnp.int32)
+        threshold_bin = jnp.zeros((I,), jnp.int32)
+        threshold = jnp.zeros((I,), jnp.float32)
+        split_gain = jnp.zeros((I,), jnp.float32)
+        internal_value = jnp.zeros((I,), jnp.float32)
+        internal_count = jnp.zeros((I,), jnp.float32)
+
+        for d in range(D):
+            nodes_d = 2 ** d
+            off = nodes_d - 1                       # BFS offset of this level
+            hist_node = jnp.where(hist_mask, node, -1)
+            hist = build_histograms(binned, grad, hess, hist_node, nodes_d, B)
+            # (nodes, F, B, 3) -> cumulative over bins
+            cum = jnp.cumsum(hist, axis=2)
+            tot = cum[:, :1, -1, :]                 # (nodes,1,3) totals (feature 0 = any)
+            GL, HL, CL = cum[..., 0], cum[..., 1], cum[..., 2]
+            Gp, Hp, Cp = tot[..., 0], tot[..., 1], tot[..., 2]
+            GR, HR, CR = Gp[:, :, None] - GL, Hp[:, :, None] - HL, Cp[:, :, None] - CL
+            gain = (leaf_score(GL, HL) + leaf_score(GR, HR)
+                    - leaf_score(Gp, Hp)[:, :, None])
+            # split at bin t => left: bins<=t, right: bins>t; needs a finite
+            # edge (last bin and inf-padded pseudo-bins can't split)
+            edge_finite = jnp.concatenate(
+                [jnp.isfinite(edges), jnp.zeros((F, 1), bool)], axis=1)[None, :, :]
+            valid = ((CL >= min_data) & (CR >= min_data)
+                     & (HL >= min_hess) & (HR >= min_hess)
+                     & feat_mask[None, :, None] & edge_finite)
+            gain = jnp.where(valid, gain, -jnp.inf)
+            flat = gain.reshape(nodes_d, F * B)
+            best = jnp.argmax(flat, axis=1)
+            best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+            bf = (best // B).astype(jnp.int32)
+            bb = (best % B).astype(jnp.int32)
+            do_split = best_gain > min_gain
+
+            idx = off + jnp.arange(nodes_d)
+            split_feature = split_feature.at[idx].set(jnp.where(do_split, bf, -1))
+            threshold_bin = threshold_bin.at[idx].set(bb)
+            threshold = threshold.at[idx].set(edges[bf, jnp.clip(bb, 0, B - 2)])
+            split_gain = split_gain.at[idx].set(jnp.where(do_split, best_gain, 0.0))
+            internal_value = internal_value.at[idx].set(leaf_output(Gp[:, 0], Hp[:, 0]))
+            internal_count = internal_count.at[idx].set(Cp[:, 0])
+
+            # route all rows (bagged-out rows too: they need leaf ids for scores)
+            f_of_row = bf[node]
+            t_of_row = bb[node]
+            s_of_row = do_split[node]
+            row_bin = binned[jnp.arange(n), jnp.maximum(f_of_row, 0)].astype(jnp.int32)
+            go_right = s_of_row & (row_bin > t_of_row)
+            node = 2 * node + go_right.astype(jnp.int32)
+
+        # leaf stats from one more masked pass
+        leaf_hist = build_histograms(
+            binned[:, :1] * 0, grad, hess, jnp.where(hist_mask, node, -1), L, 1)
+        Gl, Hl, Cl = leaf_hist[:, 0, 0, 0], leaf_hist[:, 0, 0, 1], leaf_hist[:, 0, 0, 2]
+        leaf_value = jnp.where(Cl > 0, leaf_output(Gl, Hl), 0.0)
+        return (split_feature, threshold, threshold_bin, split_gain,
+                internal_value, internal_count, leaf_value, Cl, node)
+
+    return grow
+
+# ---------------------------------------------------------------------------
+# binned tree walk (for incremental valid scoring / DART drop replay)
+# ---------------------------------------------------------------------------
+
+def make_binned_walker(max_depth: int):
+    import jax
+    import jax.numpy as jnp
+    D = max_depth
+
+    @jax.jit
+    def walk(binned, split_feature, threshold_bin):
+        n = binned.shape[0]
+        node = jnp.zeros((n,), jnp.int32)
+        for _ in range(D):
+            f = split_feature[node]
+            t = threshold_bin[node]
+            row_bin = binned[jnp.arange(n), jnp.maximum(f, 0)].astype(jnp.int32)
+            go_right = (f >= 0) & (row_bin > t)
+            node = 2 * node + 1 + go_right.astype(jnp.int32)
+        return node - (2 ** D - 1)
+
+    return walk
+
+
+# walk() above uses BFS-global node ids; the grower uses level-local ids.
+# Convert level-local internal arrays (length I in BFS order already) -> OK:
+# the grower writes BFS order, so walker and booster share indexing.
+
+
+# ---------------------------------------------------------------------------
+# metrics (reference: core/metrics/MetricConstants.scala registry)
+# ---------------------------------------------------------------------------
+
+def _metric_binary_logloss(y, raw, w=None):
+    p = 1.0 / (1.0 + np.exp(-raw[:, 0]))
+    p = np.clip(p, 1e-15, 1 - 1e-15)
+    ll = -(y * np.log(p) + (1 - y) * np.log(1 - p))
+    return float(np.average(ll, weights=w))
+
+
+def _metric_auc(y, raw, w=None):
+    s = raw[:, 0]
+    order = np.argsort(s)
+    y_s = y[order]
+    w_s = np.ones_like(y_s, dtype=np.float64) if w is None else np.asarray(w)[order]
+    pos = (y_s > 0).astype(np.float64) * w_s
+    neg = (1.0 - (y_s > 0)) * w_s
+    cum_neg = np.cumsum(neg)
+    auc = float(np.sum(pos * (cum_neg - 0.5 * neg)) /
+                max(1e-12, np.sum(pos) * np.sum(neg)))
+    return auc
+
+
+def _metric_multi_logloss(y, raw, w=None):
+    z = raw - raw.max(axis=1, keepdims=True)
+    p = np.exp(z)
+    p /= p.sum(axis=1, keepdims=True)
+    p = np.clip(p[np.arange(len(y)), y.astype(int)], 1e-15, None)
+    return float(np.average(-np.log(p), weights=w))
+
+
+def _metric_l2(y, raw, w=None):
+    return float(np.average((raw[:, 0] - y) ** 2, weights=w))
+
+
+def _metric_rmse(y, raw, w=None):
+    return math.sqrt(_metric_l2(y, raw, w))
+
+
+def _metric_l1(y, raw, w=None):
+    return float(np.average(np.abs(raw[:, 0] - y), weights=w))
+
+
+METRICS = {"binary_logloss": (_metric_binary_logloss, False),
+           "auc": (_metric_auc, True),
+           "multi_logloss": (_metric_multi_logloss, False),
+           "l2": (_metric_l2, False), "mse": (_metric_l2, False),
+           "rmse": (_metric_rmse, False), "l1": (_metric_l1, False),
+           "mae": (_metric_l1, False)}
+
+
+def default_metric(objective: str) -> str:
+    return {"binary": "binary_logloss", "multiclass": "multi_logloss",
+            "regression": "l2", "regression_l1": "l1", "huber": "l2",
+            "quantile": "l2", "lambdarank": "l2"}.get(objective, "l2")
+
+
+# ---------------------------------------------------------------------------
+# training driver
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class TrainResult:
+    booster: GBDTBooster
+    evals: List[Dict[str, float]]
+    bin_mapper: BinMapper
+
+
+def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
+          sample_weight: Optional[np.ndarray] = None,
+          valid: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+          group_ptr: Optional[np.ndarray] = None,
+          init_booster: Optional[GBDTBooster] = None,
+          feature_names: Optional[List[str]] = None,
+          callbacks: Optional[List[Callable]] = None,
+          shard_rows: bool = False) -> TrainResult:
+    """Boosting loop.  Host python drives iterations; each tree is one jitted
+    XLA program (reference: driver drives ``updateOneIteration`` per iter,
+    ``TrainUtils.scala:67``).  ``shard_rows`` puts the binned matrix/gradients
+    row-sharded over the active mesh's data axis (GSPMD psums histograms over
+    ICI — the allreduce-ring replacement)."""
+    import jax
+    import jax.numpy as jnp
+
+    p = params.resolve()
+    rng = np.random.default_rng(p.seed)
+    X = np.asarray(X, np.float32)
+    y = np.asarray(y, np.float32)
+    n, F = X.shape
+    K = p.num_class if p.objective == "multiclass" else 1
+    w = np.ones(n, np.float32) if sample_weight is None else np.asarray(sample_weight, np.float32)
+
+    mapper = BinMapper(p.max_bin).fit(X)
+    binned_np = mapper.transform(X)
+    edges = jnp.asarray(mapper.edges)
+    B = mapper.num_bins
+
+    if shard_rows:
+        from ..parallel import get_active_mesh, batch_sharded
+        from ..parallel.sharding import pad_to_multiple
+        mesh = get_active_mesh()
+        nd = mesh.devices.size
+        binned_np, n_valid_rows = pad_to_multiple(binned_np, nd)
+        y_pad, _ = pad_to_multiple(y, nd)
+        w_pad, _ = pad_to_multiple(w, nd)
+        w_pad[n_valid_rows:] = 0.0  # padded rows carry zero weight everywhere
+        y, w = y_pad, w_pad
+        n = binned_np.shape[0]
+        sharding = batch_sharded(mesh)
+        binned = jax.device_put(binned_np, sharding)
+    else:
+        binned = jnp.asarray(binned_np)
+
+    grower = make_tree_grower(p.max_depth, F, B, p)
+    objective = make_objective(p)
+    D = p.max_depth
+    I, L = 2 ** D - 1, 2 ** D
+
+    # init score (BoostFromAverage analogue)
+    init_score = 0.0
+    if p.objective == "binary":
+        pbar = float(np.clip(np.average(y, weights=w), 1e-6, 1 - 1e-6))
+        init_score = math.log(pbar / (1 - pbar)) / p.sigmoid
+    elif p.objective in ("regression", "huber"):
+        init_score = float(np.average(y, weights=w))
+    elif p.objective == "regression_l1":
+        init_score = float(np.median(y))
+
+    scores = jnp.full((n, K), init_score, jnp.float32)
+    y_dev = jnp.asarray(y)
+    w_dev = jnp.asarray(w)
+
+    # warm start: replay existing booster on binned data
+    trees: Dict[str, List[np.ndarray]] = {k: [] for k in
+                                          ("split_feature", "threshold", "threshold_bin",
+                                           "split_gain", "internal_value", "internal_count",
+                                           "leaf_value", "leaf_count")}
+    tree_weights: List[float] = []
+    walker = make_binned_walker(D)
+    if init_booster is not None:
+        assert init_booster.max_depth == D and init_booster.num_features == F
+        for t in range(init_booster.num_trees):
+            for k in trees:
+                trees[k].append(getattr(init_booster, {"leaf_value": "leaf_value",
+                                                       "leaf_count": "leaf_count"}.get(k, k))[t])
+            tree_weights.append(float(init_booster.tree_weight[t]))
+            leaf = walker(binned, jnp.asarray(init_booster.split_feature[t]),
+                          jnp.asarray(init_booster.threshold_bin[t]))
+            contrib = jnp.asarray(init_booster.leaf_value[t])[leaf] * init_booster.tree_weight[t]
+            scores = scores.at[:, t % K].add(contrib)
+        init_score = init_booster.init_score
+        scores = scores + (init_booster.init_score - init_score)
+
+    metric_name = p.metric or default_metric(p.objective)
+    metric_fn, larger_better = METRICS.get(metric_name, METRICS[default_metric(p.objective)])
+    evals: List[Dict[str, float]] = []
+    has_valid = valid is not None
+    if has_valid:
+        Xv = np.asarray(valid[0], np.float32)
+        yv = np.asarray(valid[1], np.float32)
+        binned_v = jnp.asarray(mapper.transform(Xv))
+        scores_v = jnp.full((Xv.shape[0], K), init_score, jnp.float32)
+    best_metric = -np.inf if larger_better else np.inf
+    best_iter = -1
+    rounds_no_improve = 0
+
+    feat_mask_full = jnp.ones((F,), bool)
+    hist_mask_full = jnp.ones((n,), bool) if not shard_rows else jnp.asarray(w > 0)
+
+    start_iter = len(tree_weights) // K
+    for it in range(start_iter, start_iter + p.num_iterations):
+        # ---- gradients
+        if p.objective == "lambdarank":
+            if group_ptr is None:
+                raise ValueError("lambdarank requires group_ptr")
+            g_np, h_np = lambdarank_grads(np.asarray(scores), y, group_ptr, p.sigmoid)
+            g, h = jnp.asarray(g_np), jnp.asarray(h_np)
+        else:
+            score_for_grad = scores
+            if p.boosting_type == "rf" and tree_weights:
+                score_for_grad = scores / max(1, len(tree_weights) // K)
+            g, h = objective(score_for_grad, y_dev, w_dev)
+
+        # ---- dart drop
+        dropped: List[int] = []
+        if p.boosting_type == "dart" and tree_weights and rng.random() >= p.skip_drop:
+            k_drop = min(p.max_drop, max(1, int(round(p.drop_rate * len(tree_weights)))))
+            dropped = sorted(rng.choice(len(tree_weights), size=min(k_drop, len(tree_weights)),
+                                        replace=False).tolist())
+            drop_delta = jnp.zeros_like(scores)
+            for t in dropped:
+                leaf = walker(binned, jnp.asarray(trees["split_feature"][t]),
+                              jnp.asarray(trees["threshold_bin"][t]))
+                drop_delta = drop_delta.at[:, t % K].add(
+                    jnp.asarray(trees["leaf_value"][t])[leaf] * tree_weights[t])
+            g, h = objective(scores - drop_delta, y_dev, w_dev)
+
+        # ---- bagging / goss masks
+        hist_mask = hist_mask_full
+        g_eff, h_eff = g, h
+        if p.boosting_type == "goss":
+            absg = jnp.abs(g).sum(axis=1)
+            a_n = int(p.top_rate * n)
+            b_n = int(p.other_rate * n)
+            order = jnp.argsort(-absg)
+            top_idx = order[:a_n]
+            rest = np.asarray(order[a_n:])
+            pick = rng.choice(len(rest), size=min(b_n, len(rest)), replace=False) if len(rest) else []
+            small_idx = jnp.asarray(rest[pick] if len(rest) else np.empty(0, np.int64))
+            mask = jnp.zeros((n,), bool).at[top_idx].set(True).at[small_idx].set(True)
+            amp = (1.0 - p.top_rate) / max(p.other_rate, 1e-12)
+            wamp = jnp.ones((n,)).at[small_idx].set(amp)
+            hist_mask = hist_mask_full & mask
+            g_eff, h_eff = g * wamp[:, None], h * wamp[:, None]
+        elif p.bagging_freq > 0 and p.bagging_fraction < 1.0:
+            if it % p.bagging_freq == 0:
+                bag = rng.random(n) < p.bagging_fraction
+                bag_mask = jnp.asarray(bag)
+            hist_mask = hist_mask_full & bag_mask
+
+        # ---- feature fraction
+        feat_mask = feat_mask_full
+        if p.feature_fraction < 1.0:
+            keep = max(1, int(round(p.feature_fraction * F)))
+            sel = rng.choice(F, size=keep, replace=False)
+            feat_mask = jnp.zeros((F,), bool).at[jnp.asarray(sel)].set(True)
+
+        # ---- grow one tree per class
+        new_w = 1.0
+        if p.boosting_type == "dart" and dropped:
+            new_w = 1.0 / (1.0 + len(dropped))
+        shrink = 1.0 if p.boosting_type == "rf" else p.learning_rate
+        for c in range(K):
+            (sf, th, tb, sg, iv, ic, lv, lc, leaf_of_row) = grower(
+                binned, g_eff[:, c], h_eff[:, c], hist_mask, feat_mask, edges)
+            trees["split_feature"].append(np.asarray(sf))
+            trees["threshold"].append(np.asarray(th))
+            trees["threshold_bin"].append(np.asarray(tb))
+            trees["split_gain"].append(np.asarray(sg))
+            trees["internal_value"].append(np.asarray(iv))
+            trees["internal_count"].append(np.asarray(ic))
+            lv_shrunk = np.asarray(lv) * shrink
+            trees["leaf_value"].append(lv_shrunk)
+            trees["leaf_count"].append(np.asarray(lc))
+            tree_weights.append(new_w)
+            scores = scores.at[:, c].add(jnp.asarray(lv_shrunk)[leaf_of_row] * new_w)
+            if has_valid:
+                leaf_v = walker(binned_v, sf, tb)
+                scores_v = scores_v.at[:, c].add(jnp.asarray(lv_shrunk)[leaf_v] * new_w)
+
+        # ---- dart renormalize dropped trees
+        if p.boosting_type == "dart" and dropped:
+            factor = len(dropped) / (1.0 + len(dropped))
+            for t in dropped:
+                # subtract the shrunken part from train/valid scores
+                leaf = walker(binned, jnp.asarray(trees["split_feature"][t]),
+                              jnp.asarray(trees["threshold_bin"][t]))
+                delta = jnp.asarray(trees["leaf_value"][t])[leaf] * tree_weights[t] * (factor - 1.0)
+                scores = scores.at[:, t % K].add(delta)
+                if has_valid:
+                    leaf_v = walker(binned_v, jnp.asarray(trees["split_feature"][t]),
+                                    jnp.asarray(trees["threshold_bin"][t]))
+                    delta_v = jnp.asarray(trees["leaf_value"][t])[leaf_v] * tree_weights[t] * (factor - 1.0)
+                    scores_v = scores_v.at[:, t % K].add(delta_v)
+                tree_weights[t] *= factor
+
+        # ---- eval / early stopping
+        if has_valid:
+            raw_v = np.asarray(scores_v, np.float64)
+            m = metric_fn(yv, raw_v)
+            evals.append({metric_name: m, "iteration": it})
+            improved = m > best_metric if larger_better else m < best_metric
+            if improved:
+                best_metric, best_iter, rounds_no_improve = m, it, 0
+            else:
+                rounds_no_improve += 1
+            if p.early_stopping_round > 0 and rounds_no_improve >= p.early_stopping_round:
+                break
+        if callbacks:
+            for cb in callbacks:
+                cb(it, evals[-1] if evals else None)
+
+    booster = GBDTBooster(
+        np.stack(trees["split_feature"]), np.stack(trees["threshold"]),
+        np.stack(trees["threshold_bin"]), np.stack(trees["split_gain"]),
+        np.stack(trees["internal_value"]), np.stack(trees["internal_count"]),
+        np.stack(trees["leaf_value"]), np.stack(trees["leaf_count"]),
+        np.asarray(tree_weights, np.float32),
+        max_depth=D, num_features=F, objective=p.objective, num_class=K,
+        init_score=init_score, average_output=(p.boosting_type == "rf"),
+        feature_names=feature_names, best_iteration=best_iter, sigmoid=p.sigmoid)
+    return TrainResult(booster=booster, evals=evals, bin_mapper=mapper)

@@ -1,0 +1,165 @@
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame, save, load
+
+
+def make_classification(n=600, f=10, seed=0, classes=2):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    if classes == 2:
+        logit = X[:, 0] * 2 - X[:, 1] + 0.5 * X[:, 2] * X[:, 3]
+        y = (logit + rng.normal(scale=0.5, size=n) > 0).astype(float)
+    else:
+        score = X[:, :classes] + rng.normal(scale=0.3, size=(n, classes))
+        y = score.argmax(axis=1).astype(float)
+    return X, y
+
+
+def frame_of(X, y, parts=2, **extra):
+    from mmlspark_tpu.core.schema import vector_column
+    cols = {"features": vector_column(list(X)), "label": y}
+    cols.update(extra)
+    return DataFrame.from_dict(cols, num_partitions=parts)
+
+
+def accuracy(model, X, y):
+    df = frame_of(X, y, 1)
+    out = model.transform(df).collect()
+    return float((out["prediction"] == y).mean())
+
+
+def test_binary_classifier_learns():
+    from mmlspark_tpu.lightgbm import LightGBMClassifier
+    X, y = make_classification(800, 10)
+    clf = LightGBMClassifier().set_params(num_iterations=40, learning_rate=0.15,
+                                          min_data_in_leaf=5)
+    model = clf.fit(frame_of(X, y))
+    acc = accuracy(model, X, y)
+    assert acc > 0.92, f"train accuracy {acc}"
+    out = model.transform(frame_of(X, y, 1)).collect()
+    prob = out["probability"][0]
+    assert len(prob) == 2 and abs(prob.sum() - 1) < 1e-6
+
+
+def test_multiclass_classifier():
+    from mmlspark_tpu.lightgbm import LightGBMClassifier
+    X, y = make_classification(900, 8, classes=3)
+    clf = LightGBMClassifier().set_params(num_iterations=30, min_data_in_leaf=5)
+    model = clf.fit(frame_of(X, y))
+    acc = accuracy(model, X, y)
+    assert acc > 0.85, f"train accuracy {acc}"
+    prob = model.transform(frame_of(X, y, 1)).collect()["probability"][0]
+    assert len(prob) == 3
+
+
+def test_regressor_modes():
+    from mmlspark_tpu.lightgbm import LightGBMRegressor
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(500, 6))
+    y = 3 * X[:, 0] - 2 * X[:, 1] + X[:, 2] ** 2 + rng.normal(scale=0.1, size=500)
+    base_mse = float(np.var(y))
+    for boosting in ("gbdt", "goss", "dart", "rf"):
+        reg = LightGBMRegressor().set_params(num_iterations=30, min_data_in_leaf=5,
+                                             boosting_type=boosting, seed=1)
+        model = reg.fit(frame_of(X, y))
+        pred = model.transform(frame_of(X, y, 1)).collect()["prediction"]
+        mse = float(np.mean((pred - y) ** 2))
+        assert mse < base_mse * 0.5, f"{boosting}: mse {mse} vs var {base_mse}"
+
+
+def test_model_string_roundtrip_and_warm_start():
+    from mmlspark_tpu.lightgbm import LightGBMClassifier, LightGBMRegressor
+    from mmlspark_tpu.models.gbdt import GBDTBooster
+    X, y = make_classification(400, 6)
+    m1 = LightGBMClassifier().set_params(num_iterations=10, min_data_in_leaf=5) \
+        .fit(frame_of(X, y))
+    s = m1.get_model_string()
+    b2 = GBDTBooster.from_string(s)
+    p1 = m1.booster.predict(X)
+    assert np.allclose(p1, b2.predict(X), atol=1e-6)
+    # warm start continues training
+    m2 = LightGBMClassifier().set_params(num_iterations=10, min_data_in_leaf=5,
+                                         model_string=s).fit(frame_of(X, y))
+    assert m2.booster.num_trees == 20
+
+
+def test_save_load_model(tmp_path):
+    from mmlspark_tpu.lightgbm import LightGBMRegressor
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(300, 5))
+    y = X[:, 0] + 0.5 * X[:, 1]
+    model = LightGBMRegressor().set_params(num_iterations=15, min_data_in_leaf=5) \
+        .fit(frame_of(X, y))
+    p = str(tmp_path / "lgbm")
+    save(model, p)
+    model2 = load(p)
+    a = model.transform(frame_of(X, y, 1)).collect()["prediction"]
+    b = model2.transform(frame_of(X, y, 1)).collect()["prediction"]
+    assert np.allclose(a, b)
+
+
+def test_early_stopping_and_validation():
+    from mmlspark_tpu.lightgbm import LightGBMClassifier
+    X, y = make_classification(600, 8, seed=3)
+    vmask = np.zeros(600, bool)
+    vmask[::5] = True
+    clf = LightGBMClassifier().set_params(num_iterations=200, learning_rate=0.3,
+                                          min_data_in_leaf=5,
+                                          early_stopping_round=5,
+                                          validation_indicator_col="is_valid")
+    model = clf.fit(frame_of(X, y, 2, is_valid=vmask))
+    assert model.booster.num_trees < 200  # stopped early
+
+
+def test_feature_importance_and_leaf_contrib():
+    from mmlspark_tpu.lightgbm import LightGBMRegressor
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(400, 5))
+    y = 5 * X[:, 2] + rng.normal(scale=0.1, size=400)
+    model = LightGBMRegressor().set_params(num_iterations=20, min_data_in_leaf=5) \
+        .fit(frame_of(X, y))
+    imp = model.get_feature_importances("split")
+    assert imp.argmax() == 2
+    gains = model.get_feature_importances("gain")
+    assert gains.argmax() == 2
+    # leaf predictions + contribs
+    df = frame_of(X[:20], y[:20], 1)
+    leaves = model.predict_leaf(df).collect()["leaf_prediction"]
+    assert len(leaves[0]) == model.booster.num_trees
+    contrib = model.predict_contrib(df).collect()["features_shap"]
+    raw = model.booster.raw_scores(X[:20])[:, 0]
+    assert np.allclose([c.sum() for c in contrib], raw, atol=1e-4)
+
+
+def test_ranker_improves_ndcg():
+    from mmlspark_tpu.lightgbm import LightGBMRanker
+    rng = np.random.default_rng(5)
+    n_q, per_q = 40, 10
+    X = rng.normal(size=(n_q * per_q, 6))
+    rel = np.clip((X[:, 0] * 2 + rng.normal(scale=0.3, size=n_q * per_q)), 0, None)
+    y = np.digitize(rel, [0.5, 1.5, 2.5]).astype(float)
+    groups = np.repeat(np.arange(n_q), per_q)
+    df = frame_of(X, y, 2, group=groups)
+    rk = LightGBMRanker().set_params(num_iterations=30, min_data_in_leaf=3)
+    model = rk.fit(df)
+    pred = model.transform(frame_of(X, y, 1, group=groups)).collect()["prediction"]
+    # spearman-ish check: predictions correlate with relevance
+    corr = np.corrcoef(pred, y)[0, 1]
+    assert corr > 0.5, corr
+
+
+def test_sharded_training_matches(mesh8):
+    from mmlspark_tpu.lightgbm import LightGBMRegressor
+    from mmlspark_tpu.parallel import active_mesh
+    rng = np.random.default_rng(6)
+    X = rng.normal(size=(320, 5))
+    y = 2 * X[:, 0] - X[:, 3]
+    with active_mesh(mesh8):
+        m_sharded = LightGBMRegressor().set_params(num_iterations=10, min_data_in_leaf=5,
+                                                   shard_rows=True).fit(frame_of(X, y))
+    m_local = LightGBMRegressor().set_params(num_iterations=10, min_data_in_leaf=5) \
+        .fit(frame_of(X, y))
+    a = m_sharded.booster.predict(X)
+    b = m_local.booster.predict(X)
+    assert np.allclose(a, b, atol=1e-4), np.abs(a - b).max()
