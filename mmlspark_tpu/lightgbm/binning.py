@@ -65,11 +65,17 @@ class BinMapper:
             raise RuntimeError("BinMapper not fitted")
         X = np.asarray(X, np.float32)
         if device:
+            import jax
             import jax.numpy as jnp
-            from ..ops.histogram import bin_matrix
+            # binary search (log B steps) instead of the (n, F, B) broadcast
+            # compare — 30x less work at max_bin=255
+            @jax.jit
+            def digitize(xt, edges):
+                return jax.vmap(lambda col, e: jnp.searchsorted(e, col, side="left"))(
+                    xt, edges).astype(jnp.uint8)
             Xn = np.nan_to_num(X, nan=-np.inf)
-            return np.asarray(bin_matrix(jnp.asarray(Xn), jnp.asarray(self.edges),
-                                         self.max_bin))
+            out = digitize(jnp.asarray(Xn.T), jnp.asarray(self.edges))
+            return np.asarray(out).T
         out = np.empty(X.shape, np.uint8)
         for f in range(X.shape[1]):
             finite_edges = self.edges[f][np.isfinite(self.edges[f])]

@@ -187,11 +187,23 @@ def lambdarank_grads(scores: np.ndarray, y: np.ndarray, group_ptr: np.ndarray,
 # ---------------------------------------------------------------------------
 
 def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
-                     params: GBDTParams):
-    """Returns jitted grow(binned, grad, hess, hist_mask, feat_mask, edges)
-    -> (tree arrays..., leaf_of_row)."""
+                     params: GBDTParams, axis_name: str = None,
+                     backend: str = "auto"):
+    """Returns grow(binned, grad, hess, hist_mask, feat_mask, edges)
+    -> (tree arrays..., leaf_of_row).  With `axis_name`, the function is
+    meant to run inside shard_map over row shards: local histograms are
+    psum'd over that mesh axis (the LGBM_NetworkInit ring replacement) and
+    all split decisions replicate deterministically across shards."""
     import jax
     import jax.numpy as jnp
+    from ..ops import histogram as hist_ops
+
+    def hist(binned, g, h, node, num_nodes):
+        out = hist_ops.build(binned, g, h, node, num_nodes, num_bins,
+                             backend=backend)
+        if axis_name is not None:
+            out = jax.lax.psum(out, axis_name)
+        return out
 
     D, F, B = max_depth, num_features, num_bins
     I = 2 ** D - 1     # internal nodes
@@ -214,7 +226,6 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
             v = jnp.clip(v, -max_delta, max_delta)
         return v
 
-    @jax.jit
     def grow(binned, grad, hess, hist_mask, feat_mask, edges):
         n = binned.shape[0]
         node = jnp.zeros((n,), jnp.int32)          # level-local node, all rows
@@ -225,13 +236,28 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
         internal_value = jnp.zeros((I,), jnp.float32)
         internal_count = jnp.zeros((I,), jnp.float32)
 
+        edge_finite = jnp.concatenate(
+            [jnp.isfinite(edges), jnp.zeros((F, 1), bool)], axis=1)[None, :, :]
+        prev_hist = None
+        best_stats = None
         for d in range(D):
             nodes_d = 2 ** d
             off = nodes_d - 1                       # BFS offset of this level
-            hist_node = jnp.where(hist_mask, node, -1)
-            hist = build_histograms(binned, grad, hess, hist_node, nodes_d, B)
+            if d == 0:
+                hist_d = hist(binned, grad, hess,
+                              jnp.where(hist_mask, node, -1), 1)
+            else:
+                # sibling-subtraction (LightGBM's histogram halving): scatter
+                # only rows in LEFT children, derive right = parent - left
+                left_node = jnp.where(hist_mask & (node % 2 == 0), node // 2, -1)
+                hist_left = hist(binned, grad, hess, left_node, nodes_d // 2)
+                hist_right = prev_hist - hist_left
+                hist_d = jnp.stack([hist_left, hist_right], axis=1) \
+                    .reshape(nodes_d, F, B, 3)
+            prev_hist = hist_d
+
             # (nodes, F, B, 3) -> cumulative over bins
-            cum = jnp.cumsum(hist, axis=2)
+            cum = jnp.cumsum(hist_d, axis=2)
             tot = cum[:, :1, -1, :]                 # (nodes,1,3) totals (feature 0 = any)
             GL, HL, CL = cum[..., 0], cum[..., 1], cum[..., 2]
             Gp, Hp, Cp = tot[..., 0], tot[..., 1], tot[..., 2]
@@ -240,8 +266,6 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
                     - leaf_score(Gp, Hp)[:, :, None])
             # split at bin t => left: bins<=t, right: bins>t; needs a finite
             # edge (last bin and inf-padded pseudo-bins can't split)
-            edge_finite = jnp.concatenate(
-                [jnp.isfinite(edges), jnp.zeros((F, 1), bool)], axis=1)[None, :, :]
             valid = ((CL >= min_data) & (CR >= min_data)
                      & (HL >= min_hess) & (HR >= min_hess)
                      & feat_mask[None, :, None] & edge_finite)
@@ -261,6 +285,15 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
             internal_value = internal_value.at[idx].set(leaf_output(Gp[:, 0], Hp[:, 0]))
             internal_count = internal_count.at[idx].set(Cp[:, 0])
 
+            # left/right child stats at the chosen split -> leaf values at the
+            # last level come straight from here (no extra leaf pass)
+            pick = jnp.stack([GL, HL, CL], axis=-1)          # (nodes,F,B,3)
+            bsel = pick[jnp.arange(nodes_d), bf, bb, :]      # (nodes,3) left stats
+            tot3 = jnp.stack([Gp[:, 0], Hp[:, 0], Cp[:, 0]], axis=-1)
+            left_stats = jnp.where(do_split[:, None], bsel, tot3)
+            right_stats = tot3 - left_stats
+            best_stats = (left_stats, right_stats, do_split, tot3)
+
             # route all rows (bagged-out rows too: they need leaf ids for scores)
             f_of_row = bf[node]
             t_of_row = bb[node]
@@ -269,13 +302,15 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
             go_right = s_of_row & (row_bin > t_of_row)
             node = 2 * node + go_right.astype(jnp.int32)
 
-        # leaf stats from one more masked pass
-        leaf_hist = build_histograms(
-            binned[:, :1] * 0, grad, hess, jnp.where(hist_mask, node, -1), L, 1)
-        Gl, Hl, Cl = leaf_hist[:, 0, 0, 0], leaf_hist[:, 0, 0, 1], leaf_hist[:, 0, 0, 2]
-        leaf_value = jnp.where(Cl > 0, leaf_output(Gl, Hl), 0.0)
+        # leaves: children of the last level's nodes
+        left_stats, right_stats, do_split, tot3 = best_stats
+        lv = jnp.stack([leaf_output(left_stats[:, 0], left_stats[:, 1]),
+                        leaf_output(right_stats[:, 0], right_stats[:, 1])],
+                       axis=1).reshape(L)
+        lc = jnp.stack([left_stats[:, 2], right_stats[:, 2]], axis=1).reshape(L)
+        leaf_value = jnp.where(lc > 0, lv, 0.0)
         return (split_feature, threshold, threshold_bin, split_gain,
-                internal_value, internal_count, leaf_value, Cl, node)
+                internal_value, internal_count, leaf_value, lc, node)
 
     return grow
 
@@ -407,10 +442,12 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
     B = mapper.num_bins
 
     if shard_rows:
+        from jax.sharding import PartitionSpec as P
         from ..parallel import get_active_mesh, batch_sharded
+        from ..parallel.mesh import AXIS_DATA
         from ..parallel.sharding import pad_to_multiple
         mesh = get_active_mesh()
-        nd = mesh.devices.size
+        nd = mesh.shape[AXIS_DATA]
         binned_np, n_valid_rows = pad_to_multiple(binned_np, nd)
         y_pad, _ = pad_to_multiple(y, nd)
         w_pad, _ = pad_to_multiple(w, nd)
@@ -419,10 +456,16 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
         n = binned_np.shape[0]
         sharding = batch_sharded(mesh)
         binned = jax.device_put(binned_np, sharding)
+        # explicit SPMD: each shard builds local histograms, psum over ICI
+        grow_raw = make_tree_grower(p.max_depth, F, B, p, axis_name=AXIS_DATA)
+        grower = jax.jit(jax.shard_map(
+            grow_raw, mesh=mesh,
+            in_specs=(P(AXIS_DATA), P(AXIS_DATA), P(AXIS_DATA), P(AXIS_DATA),
+                      P(), P()),
+            out_specs=(P(),) * 8 + (P(AXIS_DATA),), check_vma=False))
     else:
         binned = jnp.asarray(binned_np)
-
-    grower = make_tree_grower(p.max_depth, F, B, p)
+        grower = jax.jit(make_tree_grower(p.max_depth, F, B, p))
     objective = make_objective(p)
     D = p.max_depth
     I, L = 2 ** D - 1, 2 ** D
@@ -478,100 +521,138 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
     feat_mask_full = jnp.ones((F,), bool)
     hist_mask_full = jnp.ones((n,), bool) if not shard_rows else jnp.asarray(w > 0)
 
-    start_iter = len(tree_weights) // K
-    for it in range(start_iter, start_iter + p.num_iterations):
-        # ---- gradients
-        if p.objective == "lambdarank":
-            if group_ptr is None:
-                raise ValueError("lambdarank requires group_ptr")
-            g_np, h_np = lambdarank_grads(np.asarray(scores), y, group_ptr, p.sigmoid)
-            g, h = jnp.asarray(g_np), jnp.asarray(h_np)
+    # Fused per-iteration step (single-program path): objective + GOSS + K
+    # tree grows + score updates in ONE jitted XLA program — eager per-op
+    # dispatch through the device relay costs ~10-100 ms per op, which
+    # dominated the loop before fusion.
+    grow_fn = None if shard_rows else make_tree_grower(p.max_depth, F, B, p)
+    shrink_const = 1.0 if p.boosting_type == "rf" else p.learning_rate
+    is_goss = p.boosting_type == "goss"
+    a_n = int(p.top_rate * n) if is_goss else 0
+    b_n = int(p.other_rate * n) if is_goss else 0
+
+    def _iter_body(scores, y_d, w_d, binned_d, base_mask, feat_mask_d, edges_d,
+                   grad_scale, new_w, key, g_pre, h_pre, use_pre):
+        if use_pre:
+            g, h = g_pre, h_pre
         else:
-            score_for_grad = scores
-            if p.boosting_type == "rf" and tree_weights:
-                score_for_grad = scores / max(1, len(tree_weights) // K)
-            g, h = objective(score_for_grad, y_dev, w_dev)
-
-        # ---- dart drop
-        dropped: List[int] = []
-        if p.boosting_type == "dart" and tree_weights and rng.random() >= p.skip_drop:
-            k_drop = min(p.max_drop, max(1, int(round(p.drop_rate * len(tree_weights)))))
-            dropped = sorted(rng.choice(len(tree_weights), size=min(k_drop, len(tree_weights)),
-                                        replace=False).tolist())
-            drop_delta = jnp.zeros_like(scores)
-            for t in dropped:
-                leaf = walker(binned, jnp.asarray(trees["split_feature"][t]),
-                              jnp.asarray(trees["threshold_bin"][t]))
-                drop_delta = drop_delta.at[:, t % K].add(
-                    jnp.asarray(trees["leaf_value"][t])[leaf] * tree_weights[t])
-            g, h = objective(scores - drop_delta, y_dev, w_dev)
-
-        # ---- bagging / goss masks
-        hist_mask = hist_mask_full
-        g_eff, h_eff = g, h
-        if p.boosting_type == "goss":
+            g, h = objective(scores / grad_scale, y_d, w_d)
+        hist_mask = base_mask
+        if is_goss and not use_pre:
             absg = jnp.abs(g).sum(axis=1)
-            a_n = int(p.top_rate * n)
-            b_n = int(p.other_rate * n)
             order = jnp.argsort(-absg)
             top_idx = order[:a_n]
-            rest = np.asarray(order[a_n:])
-            pick = rng.choice(len(rest), size=min(b_n, len(rest)), replace=False) if len(rest) else []
-            small_idx = jnp.asarray(rest[pick] if len(rest) else np.empty(0, np.int64))
+            rest = order[a_n:]
+            perm = jax.random.permutation(key, rest.shape[0])
+            small_idx = rest[perm[:b_n]]
             mask = jnp.zeros((n,), bool).at[top_idx].set(True).at[small_idx].set(True)
             amp = (1.0 - p.top_rate) / max(p.other_rate, 1e-12)
             wamp = jnp.ones((n,)).at[small_idx].set(amp)
-            hist_mask = hist_mask_full & mask
-            g_eff, h_eff = g * wamp[:, None], h * wamp[:, None]
-        elif p.bagging_freq > 0 and p.bagging_fraction < 1.0:
-            if it % p.bagging_freq == 0:
-                bag = rng.random(n) < p.bagging_fraction
-                bag_mask = jnp.asarray(bag)
-            hist_mask = hist_mask_full & bag_mask
+            hist_mask = hist_mask & mask
+            g, h = g * wamp[:, None], h * wamp[:, None]
+        tree_out = []
+        for c in range(K):
+            sf, th, tb, sg, iv, ic, lv, lc, leaf = grow_fn(
+                binned_d, g[:, c], h[:, c], hist_mask, feat_mask_d, edges_d)
+            lv_s = lv * shrink_const
+            scores = scores.at[:, c].add(lv_s[leaf] * new_w)
+            tree_out.append((sf, th, tb, sg, iv, ic, lv_s, lc))
+        return scores, tree_out
 
-        # ---- feature fraction
+    _iter_jit = {} if shard_rows else {
+        False: jax.jit(partial(_iter_body, use_pre=False),
+                       static_argnames=()),
+        True: jax.jit(partial(_iter_body, use_pre=True))}
+
+    import jax.random as jrandom
+    jit_objective = jax.jit(objective) if objective is not None else None
+    start_iter = len(tree_weights) // K
+    for it in range(start_iter, start_iter + p.num_iterations):
+        # ---- host-side per-iteration randomness
         feat_mask = feat_mask_full
         if p.feature_fraction < 1.0:
             keep = max(1, int(round(p.feature_fraction * F)))
             sel = rng.choice(F, size=keep, replace=False)
             feat_mask = jnp.zeros((F,), bool).at[jnp.asarray(sel)].set(True)
+        base_mask = hist_mask_full
+        if p.boosting_type != "goss" and p.bagging_freq > 0 and p.bagging_fraction < 1.0:
+            if it % p.bagging_freq == 0:
+                bag_mask = jnp.asarray(rng.random(n) < p.bagging_fraction)
+            base_mask = hist_mask_full & bag_mask
 
-        # ---- grow one tree per class
-        new_w = 1.0
-        if p.boosting_type == "dart" and dropped:
-            new_w = 1.0 / (1.0 + len(dropped))
-        shrink = 1.0 if p.boosting_type == "rf" else p.learning_rate
-        for c in range(K):
-            (sf, th, tb, sg, iv, ic, lv, lc, leaf_of_row) = grower(
-                binned, g_eff[:, c], h_eff[:, c], hist_mask, feat_mask, edges)
-            trees["split_feature"].append(np.asarray(sf))
-            trees["threshold"].append(np.asarray(th))
-            trees["threshold_bin"].append(np.asarray(tb))
-            trees["split_gain"].append(np.asarray(sg))
-            trees["internal_value"].append(np.asarray(iv))
-            trees["internal_count"].append(np.asarray(ic))
-            lv_shrunk = np.asarray(lv) * shrink
-            trees["leaf_value"].append(lv_shrunk)
-            trees["leaf_count"].append(np.asarray(lc))
+        # ---- gradients precomputed for lambdarank / dart
+        g_pre = h_pre = None
+        dropped: List[int] = []
+        if p.objective == "lambdarank":
+            if group_ptr is None:
+                raise ValueError("lambdarank requires group_ptr")
+            g_np, h_np = lambdarank_grads(np.asarray(scores), y, group_ptr, p.sigmoid)
+            g_pre, h_pre = jnp.asarray(g_np), jnp.asarray(h_np)
+        elif p.boosting_type == "dart" and tree_weights and rng.random() >= p.skip_drop:
+            k_drop = min(p.max_drop, max(1, int(round(p.drop_rate * len(tree_weights)))))
+            dropped = sorted(rng.choice(len(tree_weights), size=min(k_drop, len(tree_weights)),
+                                        replace=False).tolist())
+            drop_delta = jnp.zeros_like(scores)
+            for t in dropped:
+                leaf = walker(binned, trees["split_feature"][t],
+                              trees["threshold_bin"][t])
+                drop_delta = drop_delta.at[:, t % K].add(
+                    trees["leaf_value"][t][leaf] * tree_weights[t])
+            g_pre, h_pre = jit_objective(scores - drop_delta, y_dev, w_dev)
+
+        new_w = 1.0 / (1.0 + len(dropped)) if dropped else 1.0
+        grad_scale = float(max(1, len(tree_weights) // K)) \
+            if p.boosting_type == "rf" and tree_weights else 1.0
+        key = jrandom.PRNGKey(p.seed * 1000003 + it)
+
+        if not shard_rows:
+            use_pre = g_pre is not None
+            gp = g_pre if use_pre else scores
+            hp = h_pre if use_pre else scores
+            scores, tree_out = _iter_jit[use_pre](
+                scores, y_dev, w_dev, binned, base_mask, feat_mask, edges,
+                grad_scale, new_w, key, gp, hp)
+        else:
+            # multi-chip path: explicit shard_map grower per class
+            if g_pre is not None:
+                g_eff, h_eff = g_pre, h_pre
+            else:
+                g_eff, h_eff = jit_objective(scores / grad_scale, y_dev, w_dev)
+            shrink = 1.0 if p.boosting_type == "rf" else p.learning_rate
+            tree_out = []
+            for c in range(K):
+                (sf, th, tb, sg, iv, ic, lv, lc, leaf_of_row) = grower(
+                    binned, g_eff[:, c], h_eff[:, c], base_mask, feat_mask, edges)
+                lv_s = lv * shrink
+                scores = scores.at[:, c].add(lv_s[leaf_of_row] * new_w)
+                tree_out.append((sf, th, tb, sg, iv, ic, lv_s, lc))
+
+        for c, (sf, th, tb, sg, iv, ic, lv_s, lc) in enumerate(tree_out):
+            # keep tree arrays on device: every host fetch is a relay
+            # round-trip; one device_get happens after the loop
+            for k_name, v in zip(("split_feature", "threshold", "threshold_bin",
+                                  "split_gain", "internal_value", "internal_count",
+                                  "leaf_value", "leaf_count"),
+                                 (sf, th, tb, sg, iv, ic, lv_s, lc)):
+                trees[k_name].append(v)
             tree_weights.append(new_w)
-            scores = scores.at[:, c].add(jnp.asarray(lv_shrunk)[leaf_of_row] * new_w)
             if has_valid:
                 leaf_v = walker(binned_v, sf, tb)
-                scores_v = scores_v.at[:, c].add(jnp.asarray(lv_shrunk)[leaf_v] * new_w)
+                scores_v = scores_v.at[:, c].add(lv_s[leaf_v] * new_w)
 
         # ---- dart renormalize dropped trees
         if p.boosting_type == "dart" and dropped:
             factor = len(dropped) / (1.0 + len(dropped))
             for t in dropped:
                 # subtract the shrunken part from train/valid scores
-                leaf = walker(binned, jnp.asarray(trees["split_feature"][t]),
-                              jnp.asarray(trees["threshold_bin"][t]))
-                delta = jnp.asarray(trees["leaf_value"][t])[leaf] * tree_weights[t] * (factor - 1.0)
+                leaf = walker(binned, trees["split_feature"][t],
+                              trees["threshold_bin"][t])
+                delta = trees["leaf_value"][t][leaf] * tree_weights[t] * (factor - 1.0)
                 scores = scores.at[:, t % K].add(delta)
                 if has_valid:
-                    leaf_v = walker(binned_v, jnp.asarray(trees["split_feature"][t]),
-                                    jnp.asarray(trees["threshold_bin"][t]))
-                    delta_v = jnp.asarray(trees["leaf_value"][t])[leaf_v] * tree_weights[t] * (factor - 1.0)
+                    leaf_v = walker(binned_v, trees["split_feature"][t],
+                                    trees["threshold_bin"][t])
+                    delta_v = trees["leaf_value"][t][leaf_v] * tree_weights[t] * (factor - 1.0)
                     scores_v = scores_v.at[:, t % K].add(delta_v)
                 tree_weights[t] *= factor
 
@@ -591,11 +672,12 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
             for cb in callbacks:
                 cb(it, evals[-1] if evals else None)
 
+    trees_np = jax.device_get({k: v for k, v in trees.items()})  # one transfer
     booster = GBDTBooster(
-        np.stack(trees["split_feature"]), np.stack(trees["threshold"]),
-        np.stack(trees["threshold_bin"]), np.stack(trees["split_gain"]),
-        np.stack(trees["internal_value"]), np.stack(trees["internal_count"]),
-        np.stack(trees["leaf_value"]), np.stack(trees["leaf_count"]),
+        np.stack(trees_np["split_feature"]), np.stack(trees_np["threshold"]),
+        np.stack(trees_np["threshold_bin"]), np.stack(trees_np["split_gain"]),
+        np.stack(trees_np["internal_value"]), np.stack(trees_np["internal_count"]),
+        np.stack(trees_np["leaf_value"]), np.stack(trees_np["leaf_count"]),
         np.asarray(tree_weights, np.float32),
         max_depth=D, num_features=F, objective=p.objective, num_class=K,
         init_score=init_score, average_output=(p.boosting_type == "rf"),

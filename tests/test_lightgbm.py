@@ -163,3 +163,19 @@ def test_sharded_training_matches(mesh8):
     a = m_sharded.booster.predict(X)
     b = m_local.booster.predict(X)
     assert np.allclose(a, b, atol=1e-4), np.abs(a - b).max()
+
+
+def test_histogram_backends_agree():
+    import jax.numpy as jnp
+    from mmlspark_tpu.ops.histogram import build_histograms, build_histograms_matmul
+    rng = np.random.default_rng(7)
+    n, f, b, p = 3000, 9, 255, 4
+    binned = jnp.asarray(rng.integers(0, b, (n, f)).astype(np.uint8))
+    g = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.1, 1, n).astype(np.float32))
+    node = jnp.asarray(rng.integers(-1, p, n).astype(np.int32))
+    a = build_histograms(binned, g, h, node, p, b)
+    m = build_histograms_matmul(binned, g, h, node, p, b, block_rows=256)
+    assert float(jnp.max(jnp.abs(a - m))) < 1e-3
+    # count channel must be exactly integral
+    assert float(jnp.max(jnp.abs(m[..., 2] - jnp.round(m[..., 2])))) == 0.0
