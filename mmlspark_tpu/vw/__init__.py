@@ -1,0 +1,14 @@
+from .murmur import murmur3_bytes, murmur3_ints, StringHashCache
+from .featurizer import VowpalWabbitFeaturizer, VowpalWabbitInteractions
+from .learners import (VowpalWabbitClassifier, VowpalWabbitClassificationModel,
+                       VowpalWabbitRegressor, VowpalWabbitRegressionModel,
+                       VowpalWabbitContextualBandit,
+                       VowpalWabbitContextualBanditModel, TrainingStats,
+                       pack_sparse_column)
+
+__all__ = ["murmur3_bytes", "murmur3_ints", "StringHashCache",
+           "VowpalWabbitFeaturizer", "VowpalWabbitInteractions",
+           "VowpalWabbitClassifier", "VowpalWabbitClassificationModel",
+           "VowpalWabbitRegressor", "VowpalWabbitRegressionModel",
+           "VowpalWabbitContextualBandit", "VowpalWabbitContextualBanditModel",
+           "TrainingStats", "pack_sparse_column"]

@@ -1,0 +1,148 @@
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame, save, load
+
+
+def test_murmur_reference_vectors():
+    from mmlspark_tpu.vw import murmur3_bytes
+    # canonical MurmurHash3_x86_32 test vectors
+    assert murmur3_bytes(b"", 0) == 0
+    assert murmur3_bytes(b"", 1) == 0x514E28B7
+    assert murmur3_bytes(b"hello", 0) == 0x248BFA47
+    assert murmur3_bytes(b"hello, world", 0) == 0x149BBB7F
+    assert murmur3_bytes(b"The quick brown fox jumps over the lazy dog", 0) == 0x2E4FF723
+
+
+def test_featurizer_types_and_merge():
+    from mmlspark_tpu.vw import VowpalWabbitFeaturizer
+    df = DataFrame.from_dict({
+        "num": np.array([1.5, 2.0, 0.0]),
+        "cat": np.array(["a", "b", "a"], dtype=object),
+        "txt": np.array(["red green", "green", ""], dtype=object),
+        "vec": np.array([[1.0, 0.0, 2.0], [0.0, 0.0, 0.0], [3.0, 1.0, 0.0]]),
+    })
+    feat = VowpalWabbitFeaturizer(input_cols=["num", "cat", "txt", "vec"],
+                                  output_col="features", num_bits=16,
+                                  string_split_cols=["txt"])
+    out = feat.transform(df).collect()["features"]
+    # row 0: 1 numeric + 1 categorical + 2 tokens + 2 nonzero vec = 6 entries
+    assert len(out[0]["indices"]) == 6
+    assert (out[0]["indices"] < 2 ** 16).all()
+    assert np.argsort(out[0]["indices"]).tolist() == list(range(6))
+    # same categorical value -> same hash
+    a0 = set(out[0]["indices"]) - set(out[1]["indices"])
+    assert len(out[2]["indices"]) == 4  # num=0 still hashed, "" -> no tokens
+
+
+def test_interactions_quadratic():
+    from mmlspark_tpu.vw import VowpalWabbitFeaturizer, VowpalWabbitInteractions
+    df = DataFrame.from_dict({
+        "a": np.array([1.0, 2.0]),
+        "b": np.array(["x", "y"], dtype=object),
+    })
+    f1 = VowpalWabbitFeaturizer(input_cols=["a"], output_col="fa", num_bits=15)
+    f2 = VowpalWabbitFeaturizer(input_cols=["b"], output_col="fb", num_bits=15)
+    inter = VowpalWabbitInteractions(input_cols=["fa", "fb"], output_col="fq",
+                                     num_bits=15)
+    out = inter.transform(f2.transform(f1.transform(df))).collect()["fq"]
+    assert len(out[0]["indices"]) == 1  # 1x1 cross
+    assert out[0]["values"][0] == 1.0
+
+
+def _sparse_frame(n=800, d=30, seed=0, classify=True, parts=2):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d))
+    w_true = rng.normal(size=d)
+    logit = X @ w_true
+    y = (logit > 0).astype(float) if classify else logit + rng.normal(scale=0.1, size=n)
+    col = np.empty(n, dtype=object)
+    for i in range(n):
+        nz = np.nonzero(X[i])[0]
+        col[i] = {"indices": nz.astype(np.int32), "values": X[i, nz].astype(np.float32)}
+    return DataFrame.from_dict({"features": col, "label": y}, parts), X, y
+
+
+def test_vw_classifier_learns():
+    from mmlspark_tpu.vw import VowpalWabbitClassifier
+    df, X, y = _sparse_frame(800, 30)
+    clf = VowpalWabbitClassifier().set_params(num_bits=10, num_passes=5,
+                                              learning_rate=0.5)
+    model = clf.fit(df)
+    out = model.transform(df).collect()
+    acc = (out["prediction"] == y).mean()
+    assert acc > 0.85, acc
+    stats = model.get_performance_statistics().collect()
+    assert stats["rows"].sum() == 800
+
+
+def test_vw_regressor_learns_and_bytes_roundtrip():
+    from mmlspark_tpu.vw import VowpalWabbitRegressor, VowpalWabbitClassifier
+    df, X, y = _sparse_frame(600, 20, classify=False)
+    reg = VowpalWabbitRegressor().set_params(num_bits=10, num_passes=8)
+    model = reg.fit(df)
+    pred = model.transform(df).collect()["prediction"]
+    mse = float(np.mean((pred - y) ** 2))
+    assert mse < np.var(y) * 0.3, mse
+    # warm start from model bytes
+    reg2 = VowpalWabbitRegressor().set_params(num_bits=10, num_passes=1,
+                                              initial_model=model.model_bytes())
+    m2 = reg2.fit(df)
+    pred2 = m2.transform(df).collect()["prediction"]
+    assert float(np.mean((pred2 - y) ** 2)) < np.var(y) * 0.3
+
+
+def test_vw_args_string():
+    from mmlspark_tpu.vw import VowpalWabbitRegressor
+    reg = VowpalWabbitRegressor().set_params(args="-b 12 -l 0.1 --passes 3")
+    reg._parse_args()
+    assert reg.get("num_bits") == 12
+    assert reg.get("learning_rate") == 0.1
+    assert reg.get("num_passes") == 3
+    with pytest.raises(NotImplementedError):
+        VowpalWabbitRegressor().set_params(args="--bfgs")._parse_args()
+
+
+def test_vw_save_load(tmp_path):
+    from mmlspark_tpu.vw import VowpalWabbitClassifier
+    df, X, y = _sparse_frame(300, 10)
+    model = VowpalWabbitClassifier().set_params(num_bits=10, num_passes=3).fit(df)
+    p = str(tmp_path / "vw")
+    save(model, p)
+    m2 = load(p)
+    a = model.transform(df).collect()["prediction"]
+    b = m2.transform(df).collect()["prediction"]
+    assert np.array_equal(a, b)
+
+
+def test_contextual_bandit():
+    from mmlspark_tpu.vw import VowpalWabbitContextualBandit
+    rng = np.random.default_rng(3)
+    n, n_actions, d = 400, 3, 8
+    act_col = np.empty(n, dtype=object)
+    chosen = np.zeros(n)
+    cost = np.zeros(n)
+    prob = np.full(n, 1.0 / n_actions)
+    best = np.zeros(n, dtype=int)
+    for i in range(n):
+        acts = []
+        costs_i = []
+        for a in range(n_actions):
+            x = rng.normal(size=d).astype(np.float32)
+            acts.append({"indices": np.arange(d, dtype=np.int32) + a * d,
+                         "values": x})
+            costs_i.append(float(x[0]))  # cost driven by feature 0
+        act_col[i] = acts
+        best[i] = int(np.argmin(costs_i))
+        c = rng.integers(0, n_actions)
+        chosen[i] = c + 1
+        cost[i] = costs_i[c]
+    df = DataFrame.from_dict({"action_features": act_col, "chosen_action": chosen,
+                              "cost": cost, "probability": prob, "label": cost}, 2)
+    cb = VowpalWabbitContextualBandit().set_params(num_bits=12, num_passes=10,
+                                                   learning_rate=0.5)
+    model = cb.fit(df)
+    scores = model.transform(df).collect()["prediction"]
+    picked = np.asarray([np.argmin(s) for s in scores])
+    regret_match = (picked == best).mean()
+    assert regret_match > 0.6, regret_match
