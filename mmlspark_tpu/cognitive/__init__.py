@@ -1,0 +1,21 @@
+from .base import CognitiveServicesBase
+from .services import (TextSentiment, LanguageDetector, EntityDetector, NER,
+                       PII, KeyPhraseExtractor, OCR, AnalyzeImage,
+                       DescribeImage, TagImage, RecognizeText,
+                       GenerateThumbnails, DetectFace, VerifyFaces,
+                       GroupFaces, IdentifyFaces, FindSimilarFace,
+                       DetectLastAnomaly, DetectAnomalies, Translate,
+                       Transliterate, BreakSentence, Detect, AnalyzeLayout,
+                       AnalyzeReceipts, AnalyzeBusinessCards, AnalyzeInvoices,
+                       AnalyzeIDDocuments, SpeechToText, BingImageSearch)
+from .search import AzureSearchWriter
+
+__all__ = ["CognitiveServicesBase", "TextSentiment", "LanguageDetector",
+           "EntityDetector", "NER", "PII", "KeyPhraseExtractor", "OCR",
+           "AnalyzeImage", "DescribeImage", "TagImage", "RecognizeText",
+           "GenerateThumbnails", "DetectFace", "VerifyFaces", "GroupFaces",
+           "IdentifyFaces", "FindSimilarFace", "DetectLastAnomaly",
+           "DetectAnomalies", "Translate", "Transliterate", "BreakSentence",
+           "Detect", "AnalyzeLayout", "AnalyzeReceipts",
+           "AnalyzeBusinessCards", "AnalyzeInvoices", "AnalyzeIDDocuments",
+           "SpeechToText", "BingImageSearch", "AzureSearchWriter"]

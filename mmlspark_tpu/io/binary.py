@@ -1,0 +1,46 @@
+"""Binary file IO — directories of arbitrary files as frames.
+
+Reference: ``core/.../io/binary/BinaryFileFormat.scala`` (Spark DataSource
+over binary files with recursive parallel listing) and ``BinaryFileReader``.
+Columns: path (string), bytes (binary).
+"""
+from __future__ import annotations
+
+import fnmatch
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from ..core import DataFrame
+
+
+def list_files(path: str, pattern: Optional[str] = None,
+               recursive: bool = True) -> List[str]:
+    out: List[str] = []
+    if os.path.isfile(path):
+        return [path]
+    for root, dirs, files in os.walk(path):
+        for f in sorted(files):
+            if pattern is None or fnmatch.fnmatch(f, pattern):
+                out.append(os.path.join(root, f))
+        if not recursive:
+            break
+    return sorted(out)
+
+
+def read_binary_files(path: str, pattern: Optional[str] = None,
+                      recursive: bool = True, num_partitions: int = 1,
+                      with_bytes: bool = True) -> DataFrame:
+    files = list_files(path, pattern, recursive)
+    paths = np.empty(len(files), dtype=object)
+    blobs = np.empty(len(files), dtype=object)
+    for i, f in enumerate(files):
+        paths[i] = f
+        if with_bytes:
+            with open(f, "rb") as fh:
+                blobs[i] = fh.read()
+    cols = {"path": paths}
+    if with_bytes:
+        cols["bytes"] = blobs
+    return DataFrame.from_dict(cols, num_partitions=max(1, min(num_partitions, len(files) or 1)))

@@ -1,0 +1,3 @@
+from .server import (PipelineServer, DistributedPipelineServer, ServingStats)
+
+__all__ = ["PipelineServer", "DistributedPipelineServer", "ServingStats"]
