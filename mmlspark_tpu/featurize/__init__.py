@@ -1,0 +1,11 @@
+from .featurize import (Featurize, CleanMissingData, CleanMissingDataModel,
+                        ValueIndexer, ValueIndexerModel, IndexToValue,
+                        CountSelector, CountSelectorModel, DataConversion,
+                        assemble_vector_column)
+from .text import TextFeaturizer, TextFeaturizerModel, MultiNGram, PageSplitter
+
+__all__ = ["Featurize", "CleanMissingData", "CleanMissingDataModel",
+           "ValueIndexer", "ValueIndexerModel", "IndexToValue",
+           "CountSelector", "CountSelectorModel", "DataConversion",
+           "assemble_vector_column", "TextFeaturizer", "TextFeaturizerModel",
+           "MultiNGram", "PageSplitter"]

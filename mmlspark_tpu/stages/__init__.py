@@ -1,5 +1,16 @@
 from .minibatch import (FixedMiniBatchTransformer, DynamicMiniBatchTransformer,
                         TimeIntervalMiniBatchTransformer, FlattenBatch)
+from .transforms import (Lambda, UDFTransformer, Timer, Cacher, DropColumns,
+                         SelectColumns, RenameColumn, Repartition,
+                         PartitionConsolidator, Explode, EnsembleByKey,
+                         ClassBalancer, ClassBalancerModel,
+                         StratifiedRepartition, TextPreprocessor,
+                         UnicodeNormalize, SummarizeData)
 
 __all__ = ["FixedMiniBatchTransformer", "DynamicMiniBatchTransformer",
-           "TimeIntervalMiniBatchTransformer", "FlattenBatch"]
+           "TimeIntervalMiniBatchTransformer", "FlattenBatch", "Lambda",
+           "UDFTransformer", "Timer", "Cacher", "DropColumns", "SelectColumns",
+           "RenameColumn", "Repartition", "PartitionConsolidator", "Explode",
+           "EnsembleByKey", "ClassBalancer", "ClassBalancerModel",
+           "StratifiedRepartition", "TextPreprocessor", "UnicodeNormalize",
+           "SummarizeData"]

@@ -1,0 +1,10 @@
+from .trainers import (TrainClassifier, TrainedClassifierModel, TrainRegressor,
+                       TrainedRegressorModel)
+from .metrics import (ComputeModelStatistics, ComputePerInstanceStatistics,
+                      MetricConstants, classification_metrics,
+                      regression_metrics)
+
+__all__ = ["TrainClassifier", "TrainedClassifierModel", "TrainRegressor",
+           "TrainedRegressorModel", "ComputeModelStatistics",
+           "ComputePerInstanceStatistics", "MetricConstants",
+           "classification_metrics", "regression_metrics"]

@@ -1,0 +1,140 @@
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame, Pipeline, save, load
+
+
+def test_lambda_udf_timer():
+    from mmlspark_tpu.stages import Lambda, UDFTransformer, Timer
+    df = DataFrame.from_dict({"x": np.arange(4.0)})
+    lam = Lambda(lambda d: d.with_column("y", lambda p: p["x"] + 1))
+    assert np.allclose(lam.transform(df).collect()["y"], [1, 2, 3, 4])
+    udf = UDFTransformer(input_col="x", output_col="z", udf=lambda v: v * 10)
+    assert np.allclose(udf.transform(df).collect()["z"].astype(float), [0, 10, 20, 30])
+    t = Timer(udf)
+    t.transform(df)
+    assert t.last_seconds is not None
+
+
+def test_explode_and_ensemble():
+    from mmlspark_tpu.stages import Explode, EnsembleByKey
+    col = np.empty(2, dtype=object)
+    col[0], col[1] = [1, 2], [3]
+    df = DataFrame.from_dict({"k": np.array(["a", "b"], dtype=object), "v": col})
+    ex = Explode().set_params(input_col="v").transform(df)
+    assert ex.count() == 3
+    df2 = DataFrame.from_dict({"k": np.array(["a", "a", "b"], dtype=object),
+                               "s": np.array([1.0, 3.0, 5.0])})
+    ens = EnsembleByKey().set_params(keys=["k"], cols=["s"]).transform(df2)
+    got = dict(zip(ens.collect()["k"], ens.collect()["mean(s)"]))
+    assert got["a"] == 2.0 and got["b"] == 5.0
+
+
+def test_class_balancer_and_stratified():
+    from mmlspark_tpu.stages import ClassBalancer, StratifiedRepartition
+    y = np.array([0, 0, 0, 1] * 4, dtype=float)
+    df = DataFrame.from_dict({"label": y}, 2)
+    model = ClassBalancer().set_params(input_col="label", output_col="w").fit(df)
+    out = model.transform(df).collect()
+    assert out["w"][np.asarray(out["label"]) == 1][0] == 3.0
+    sr = StratifiedRepartition().set_params(label_col="label").transform(df)
+    for part in sr.partitions:
+        assert len(np.unique(part["label"])) == 2  # every part sees all classes
+
+
+def test_summarize_data():
+    from mmlspark_tpu.stages import SummarizeData
+    df = DataFrame.from_dict({"a": np.array([1.0, 2.0, 3.0, np.nan]),
+                              "s": np.array(["x", "y", "x", "z"], dtype=object)})
+    out = SummarizeData().transform(df).to_pandas().set_index("Feature")
+    assert out.loc["a", "Missing Value Count"] == 1
+    assert out.loc["a", "Min"] == 1.0
+    assert out.loc["s", "Unique Value Count"] == 3
+
+
+def test_text_featurizer_and_pagesplitter():
+    from mmlspark_tpu.featurize import TextFeaturizer, PageSplitter, MultiNGram
+    df = DataFrame.from_dict({"text": np.array(
+        ["the cat sat on the mat", "dogs chase cats", "the mat is flat"], dtype=object)})
+    model = TextFeaturizer().set_params(input_col="text", output_col="f",
+                                        num_features=512,
+                                        use_stop_words_remover=True).fit(df)
+    out = model.transform(df).collect()["f"]
+    assert all(len(v["indices"]) > 0 for v in out)
+    ps = PageSplitter().set_params(input_col="text", output_col="pages",
+                                   maximum_page_length=10, minimum_page_length=5)
+    pages = ps.transform(df).collect()["pages"][0]
+    assert "".join(pages) == "the cat sat on the mat"
+    toks = np.empty(1, dtype=object)
+    toks[0] = ["a", "b", "c"]
+    ng = MultiNGram().set_params(input_col="t", output_col="g", lengths=[1, 2]) \
+        .transform(DataFrame.from_dict({"t": toks})).collect()["g"][0]
+    assert "a b" in ng and "c" in ng
+
+
+def test_clean_missing_value_indexer_roundtrip():
+    from mmlspark_tpu.featurize import CleanMissingData, ValueIndexer, IndexToValue
+    df = DataFrame.from_dict({"x": np.array([1.0, np.nan, 3.0]),
+                              "c": np.array(["b", "a", "b"], dtype=object)})
+    cm = CleanMissingData().set_params(input_cols=["x"]).fit(df)
+    assert np.allclose(cm.transform(df).collect()["x"], [1.0, 2.0, 3.0])
+    vi = ValueIndexer().set_params(input_col="c", output_col="ci").fit(df)
+    idx = vi.transform(df).collect()["ci"]
+    assert idx.tolist() == [1.0, 0.0, 1.0]
+    back = IndexToValue().set_params(input_col="ci", output_col="c2",
+                                     levels=vi.get("levels")) \
+        .transform(vi.transform(df)).collect()["c2"]
+    assert back.tolist() == ["b", "a", "b"]
+
+
+def test_train_classifier_end_to_end():
+    from mmlspark_tpu.train import TrainClassifier, ComputeModelStatistics
+    from mmlspark_tpu.lightgbm import LightGBMClassifier
+    rng = np.random.default_rng(0)
+    n = 300
+    df = DataFrame.from_dict({
+        "age": rng.uniform(20, 60, n),
+        "income": rng.normal(50, 10, n),
+        "city": np.array(rng.choice(["nyc", "sf", "chi"], n), dtype=object),
+        "label": np.array(rng.choice(["yes", "no"], n), dtype=object),
+    })
+    # make label learnable
+    lab = (np.asarray(df.collect()["age"]) > 40).astype(int)
+    df = df.with_column("label", np.array(["yes" if v else "no" for v in lab], dtype=object))
+    tc = TrainClassifier(LightGBMClassifier().set_params(num_iterations=10,
+                                                         min_data_in_leaf=5))
+    tc.set("label_col", "label")
+    model = tc.fit(df)
+    out = model.transform(df)
+    pred = out.collect()["predicted_label"]
+    assert (np.asarray(pred) == df.collect()["label"]).mean() > 0.9
+    # metrics
+    scored = out.with_column("label_num", lambda p: (np.asarray(
+        [v == "yes" for v in p["label"]], dtype=float)))
+    stats = ComputeModelStatistics().set_params(
+        label_col="label_num", scores_col="prediction",
+        evaluation_metric="classification").transform(scored)
+    m = stats.collect()
+    assert m["accuracy"][0] > 0.9
+
+
+def test_train_regressor_end_to_end():
+    from mmlspark_tpu.train import TrainRegressor, ComputeModelStatistics, \
+        ComputePerInstanceStatistics
+    from mmlspark_tpu.lightgbm import LightGBMRegressor
+    rng = np.random.default_rng(1)
+    n = 300
+    x1 = rng.normal(size=n)
+    df = DataFrame.from_dict({"x1": x1, "cat": np.array(
+        rng.choice(["a", "b"], n), dtype=object), "label": 3 * x1 + 1})
+    tr = TrainRegressor(LightGBMRegressor().set_params(num_iterations=20,
+                                                       min_data_in_leaf=5))
+    tr.set("label_col", "label")
+    model = tr.fit(df)
+    scored = model.transform(df)
+    stats = ComputeModelStatistics().set_params(
+        label_col="label", evaluation_metric="regression").transform(scored).collect()
+    assert stats["R^2"][0] > 0.8
+    per = ComputePerInstanceStatistics().set_params(label_col="label") \
+        .transform(scored).collect()
+    assert "L2_loss" in per
