@@ -1,0 +1,9 @@
+from .tune import (TuneHyperparameters, TuneHyperparametersModel,
+                   HyperparamBuilder, GridSpace, RandomSpace, RangeHyperParam,
+                   DiscreteHyperParam, DefaultHyperparams)
+from .best import FindBestModel, BestModel
+
+__all__ = ["TuneHyperparameters", "TuneHyperparametersModel",
+           "HyperparamBuilder", "GridSpace", "RandomSpace", "RangeHyperParam",
+           "DiscreteHyperParam", "DefaultHyperparams", "FindBestModel",
+           "BestModel"]

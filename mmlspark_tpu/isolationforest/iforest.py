@@ -1,0 +1,148 @@
+"""Isolation forest anomaly detection.
+
+Reference: ``isolationforest/IsolationForest.scala:16-65`` — a thin wrapper
+over LinkedIn's isolation-forest library with params (numEstimators,
+maxSamples, contamination, maxFeatures, scoreCol, predictedLabelCol).  Here
+the forest is in-tree: isolation trees are grown host-side (they're tiny —
+256-sample subsamples), and scoring walks all trees vectorised per batch.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import numpy as np
+
+from ..core import (ComplexParam, DataFrame, Estimator, HasFeaturesCol,
+                    HasPredictionCol, Model, Param)
+from ..core.schema import ColumnType, stack_vector_column
+from ..core.serialize import Saveable
+
+
+def _c(n: float) -> float:
+    """Average BST unsuccessful-search path length (iForest normalizer)."""
+    if n <= 1:
+        return 0.0
+    return 2.0 * (math.log(n - 1) + 0.5772156649) - 2.0 * (n - 1) / n
+
+
+class _ITree:
+    __slots__ = ("feature", "threshold", "left", "right", "size")
+
+    def __init__(self, feature=-1, threshold=0.0, left=None, right=None, size=0):
+        self.feature, self.threshold = feature, threshold
+        self.left, self.right, self.size = left, right, size
+
+    def path_length(self, X: np.ndarray, depth: int = 0) -> np.ndarray:
+        if self.feature < 0 or self.left is None:
+            return np.full(len(X), depth + _c(self.size))
+        mask = X[:, self.feature] < self.threshold
+        out = np.empty(len(X))
+        if mask.any():
+            out[mask] = self.left.path_length(X[mask], depth + 1)
+        if (~mask).any():
+            out[~mask] = self.right.path_length(X[~mask], depth + 1)
+        return out
+
+
+class _Forest(Saveable):
+    def __init__(self, trees: List[_ITree], sub_size: int):
+        self.trees = trees
+        self.sub_size = sub_size
+
+    def scores(self, X: np.ndarray) -> np.ndarray:
+        depths = np.mean([t.path_length(X) for t in self.trees], axis=0)
+        return 2.0 ** (-depths / _c(self.sub_size))
+
+    def save(self, path: str) -> None:
+        import os, pickle
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "forest.pkl"), "wb") as f:
+            pickle.dump(self, f)
+
+    @classmethod
+    def load(cls, path: str):
+        import os, pickle
+        with open(os.path.join(path, "forest.pkl"), "rb") as f:
+            return pickle.load(f)
+
+
+def _grow(X: np.ndarray, depth: int, max_depth: int, rng) -> _ITree:
+    n = len(X)
+    if depth >= max_depth or n <= 1:
+        return _ITree(size=n)
+    f = int(rng.integers(0, X.shape[1]))
+    lo, hi = X[:, f].min(), X[:, f].max()
+    if lo == hi:
+        return _ITree(size=n)
+    thr = float(rng.uniform(lo, hi))
+    mask = X[:, f] < thr
+    return _ITree(f, thr, _grow(X[mask], depth + 1, max_depth, rng),
+                  _grow(X[~mask], depth + 1, max_depth, rng), n)
+
+
+class IsolationForest(Estimator, HasFeaturesCol, HasPredictionCol):
+    num_estimators = Param("num_estimators", "number of trees", "int", default=100)
+    max_samples = Param("max_samples", "subsample per tree", "int", default=256)
+    max_features = Param("max_features", "feature fraction per tree", "float", default=1.0)
+    contamination = Param("contamination", "expected outlier fraction (sets "
+                          "the predicted-label threshold)", "float", default=0.0)
+    score_col = Param("score_col", "anomaly score output", "string", default="outlier_score")
+    predicted_label_col = Param("predicted_label_col", "0/1 outlier label",
+                                "string", default="predicted_label")
+    random_seed = Param("random_seed", "seed", "int", default=1)
+
+    def _fit(self, df: DataFrame) -> "IsolationForestModel":
+        X = stack_vector_column(df.collect()[self.get_or_fail("features_col")])
+        rng = np.random.default_rng(self.get("random_seed"))
+        sub = min(self.get("max_samples"), len(X))
+        max_depth = int(math.ceil(math.log2(max(sub, 2))))
+        trees = []
+        for _ in range(self.get("num_estimators")):
+            idx = rng.choice(len(X), sub, replace=False)
+            Xs = X[idx]
+            f_frac = self.get("max_features")
+            if f_frac < 1.0:
+                keep = rng.choice(X.shape[1], max(1, int(f_frac * X.shape[1])),
+                                  replace=False)
+                proj = np.zeros_like(Xs)
+                proj[:, keep] = Xs[:, keep]
+                Xs = proj
+            trees.append(_grow(Xs, 0, max_depth, rng))
+        forest = _Forest(trees, sub)
+        threshold = 0.5
+        cont = self.get("contamination")
+        if cont and cont > 0:
+            threshold = float(np.quantile(forest.scores(X), 1.0 - cont))
+        m = IsolationForestModel()
+        m.set("forest", forest)
+        m.set("threshold", threshold)
+        for pcol in ("features_col", "score_col", "predicted_label_col"):
+            m.set(pcol, self.get(pcol))
+        return m
+
+
+class IsolationForestModel(Model, HasFeaturesCol):
+    forest = ComplexParam("forest", "fitted isolation forest")
+    threshold = Param("threshold", "outlier score threshold", "float", default=0.5)
+    score_col = Param("score_col", "score output", "string", default="outlier_score")
+    predicted_label_col = Param("predicted_label_col", "label output", "string",
+                                default="predicted_label")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        forest: _Forest = self.get_or_fail("forest")
+        thr = self.get("threshold")
+        fc = self.get_or_fail("features_col")
+
+        def per_part(p):
+            X = stack_vector_column(p[fc])
+            s = forest.scores(X)
+            return {**p, self.get("score_col"): s,
+                    self.get("predicted_label_col"): (s >= thr).astype(np.float64)}
+
+        return df.map_partitions(per_part)
+
+    def transform_schema(self, schema):
+        schema.require(self.get_or_fail("features_col"))
+        return schema.add(self.get("score_col"), ColumnType.DOUBLE) \
+            .add(self.get("predicted_label_col"), ColumnType.DOUBLE)

@@ -1,0 +1,5 @@
+from .balltree import BallTree, ConditionalBallTree
+from .knn import KNN, KNNModel, ConditionalKNN, ConditionalKNNModel
+
+__all__ = ["BallTree", "ConditionalBallTree", "KNN", "KNNModel",
+           "ConditionalKNN", "ConditionalKNNModel"]

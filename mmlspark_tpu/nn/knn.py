@@ -1,0 +1,150 @@
+"""KNN / ConditionalKNN estimators.
+
+Reference: ``nn/ConditionalKNN.scala:31`` — fit broadcasts a (Conditional)
+BallTree; transform queries it per row (``KNNFuncHolder.queryFunc:64``).
+
+TPU-first: the default query path is brute-force MIPS on the MXU —
+``scores = Q @ X^T`` then ``lax.top_k`` — batched over query rows.  For the
+reference's dataset sizes this saturates the systolic array and beats tree
+traversal outright; the ball tree remains available (``use_ball_tree``) for
+host-only/serving queries and is what gets serialized either way.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Set
+
+import numpy as np
+
+from ..core import (ComplexParam, DataFrame, Estimator, HasFeaturesCol,
+                    HasOutputCol, Model, Param)
+from ..core.schema import ColumnType, stack_vector_column
+from .balltree import BallTree, ConditionalBallTree
+
+
+def _device_topk(data: np.ndarray, queries: np.ndarray, k: int,
+                 batch: int = 1024):
+    """(scores, indices) per query via jitted matmul + top_k."""
+    import jax
+    import jax.numpy as jnp
+
+    X = jnp.asarray(data, jnp.float32)
+
+    @jax.jit
+    def search(Q):
+        scores = Q @ X.T                       # (bq, n) on the MXU
+        return jax.lax.top_k(scores, k)
+
+    out_scores, out_idx = [], []
+    n = len(queries)
+    for s in range(0, n, batch):
+        chunk = np.asarray(queries[s:s + batch], np.float32)
+        m = len(chunk)
+        if m < batch and n > batch:
+            chunk = np.concatenate([chunk, np.repeat(chunk[-1:], batch - m, 0)])
+        sc, ix = search(jnp.asarray(chunk))
+        out_scores.append(np.asarray(sc)[:m])
+        out_idx.append(np.asarray(ix)[:m])
+    return np.concatenate(out_scores), np.concatenate(out_idx)
+
+
+class KNN(Estimator, HasFeaturesCol, HasOutputCol):
+    values_col = Param("values_col", "payload column returned with matches", "string",
+                       default="values")
+    k = Param("k", "neighbours per query", "int", default=5)
+    leaf_size = Param("leaf_size", "ball tree leaf size", "int", default=50)
+
+    def _fit(self, df: DataFrame) -> "KNNModel":
+        data = df.collect()
+        X = stack_vector_column(data[self.get_or_fail("features_col")])
+        vc = self.get("values_col")
+        values = list(data[vc]) if vc in data else list(range(len(X)))
+        tree = BallTree(X, values, self.get("leaf_size"))
+        m = KNNModel()
+        m.set("ball_tree", tree)
+        m.set("k", self.get("k"))
+        m.set("features_col", self.get("features_col"))
+        m.set("output_col", self.get("output_col"))
+        return m
+
+
+class KNNModel(Model, HasFeaturesCol, HasOutputCol):
+    ball_tree = ComplexParam("ball_tree", "fitted BallTree")
+    k = Param("k", "neighbours per query", "int", default=5)
+    use_ball_tree = Param("use_ball_tree", "query via tree instead of device "
+                                           "matmul", "bool", default=False)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        tree: BallTree = self.get_or_fail("ball_tree")
+        k = self.get("k")
+        fc, oc = self.get_or_fail("features_col"), self.get_or_fail("output_col")
+
+        def per_part(p):
+            Q = stack_vector_column(p[fc])
+            out = np.empty(len(Q), dtype=object)
+            if self.get("use_ball_tree") or len(tree.data) < 32:
+                for i in range(len(Q)):
+                    matches = tree.find_maximum_inner_products(Q[i], k)
+                    out[i] = [{"value": tree.values[j], "distance": ip}
+                              for j, ip in matches]
+            else:
+                scores, idx = _device_topk(tree.data, Q, min(k, len(tree.data)))
+                for i in range(len(Q)):
+                    out[i] = [{"value": tree.values[j], "distance": float(s)}
+                              for j, s in zip(idx[i], scores[i])]
+            return {**p, oc: out}
+
+        return df.map_partitions(per_part)
+
+    def transform_schema(self, schema):
+        schema.require(self.get_or_fail("features_col"))
+        return schema.add(self.get_or_fail("output_col"), ColumnType.ARRAY)
+
+
+class ConditionalKNN(Estimator, HasFeaturesCol, HasOutputCol):
+    values_col = Param("values_col", "payload column", "string", default="values")
+    label_col = Param("label_col", "conditioning label column", "string", default="labels")
+    k = Param("k", "neighbours per query", "int", default=5)
+    leaf_size = Param("leaf_size", "ball tree leaf size", "int", default=50)
+
+    def _fit(self, df: DataFrame) -> "ConditionalKNNModel":
+        data = df.collect()
+        X = stack_vector_column(data[self.get_or_fail("features_col")])
+        values = list(data[self.get("values_col")]) if self.get("values_col") in data \
+            else list(range(len(X)))
+        labels = list(data[self.get_or_fail("label_col")])
+        tree = ConditionalBallTree(X, values, labels, self.get("leaf_size"))
+        m = ConditionalKNNModel()
+        m.set("ball_tree", tree)
+        m.set("k", self.get("k"))
+        m.set("features_col", self.get("features_col"))
+        m.set("output_col", self.get("output_col"))
+        return m
+
+
+class ConditionalKNNModel(Model, HasFeaturesCol, HasOutputCol):
+    ball_tree = ComplexParam("ball_tree", "fitted ConditionalBallTree")
+    k = Param("k", "neighbours per query", "int", default=5)
+    conditioner_col = Param("conditioner_col", "column holding allowed label sets",
+                            "string", default="conditioner")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        tree: ConditionalBallTree = self.get_or_fail("ball_tree")
+        k = self.get("k")
+        fc, oc = self.get_or_fail("features_col"), self.get_or_fail("output_col")
+        cc = self.get("conditioner_col")
+
+        def per_part(p):
+            Q = stack_vector_column(p[fc])
+            out = np.empty(len(Q), dtype=object)
+            for i in range(len(Q)):
+                cond = set(p[cc][i]) if cc in p else None
+                matches = tree.find_maximum_inner_products(Q[i], k, cond)
+                out[i] = [{"value": tree.values[j], "label": tree.labels_arr[j],
+                           "distance": ip} for j, ip in matches]
+            return {**p, oc: out}
+
+        return df.map_partitions(per_part)
+
+    def transform_schema(self, schema):
+        schema.require(self.get_or_fail("features_col"))
+        return schema.add(self.get_or_fail("output_col"), ColumnType.ARRAY)

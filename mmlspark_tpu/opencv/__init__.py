@@ -1,0 +1,3 @@
+from .image_transformer import ImageTransformer, ImageSetAugmenter
+
+__all__ = ["ImageTransformer", "ImageSetAugmenter"]

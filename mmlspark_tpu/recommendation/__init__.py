@@ -1,0 +1,9 @@
+from .sar import SAR, SARModel
+from .indexer import RecommendationIndexer, RecommendationIndexerModel
+from .ranking import (RankingAdapter, RankingAdapterModel, RankingEvaluator,
+                      RankingTrainValidationSplit)
+
+__all__ = ["SAR", "SARModel", "RecommendationIndexer",
+           "RecommendationIndexerModel", "RankingAdapter",
+           "RankingAdapterModel", "RankingEvaluator",
+           "RankingTrainValidationSplit"]

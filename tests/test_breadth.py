@@ -1,0 +1,149 @@
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame, save, load
+from mmlspark_tpu.core.schema import vector_column
+
+
+def test_balltree_exact_vs_bruteforce(rng):
+    from mmlspark_tpu.nn import BallTree
+    X = rng.normal(size=(500, 16))
+    tree = BallTree(X, leaf_size=20)
+    q = rng.normal(size=16)
+    got = tree.find_maximum_inner_products(q, k=5)
+    brute = np.argsort(-(X @ q))[:5]
+    assert [i for i, _ in got] == brute.tolist()
+
+
+def test_conditional_balltree(rng):
+    from mmlspark_tpu.nn import ConditionalBallTree
+    X = rng.normal(size=(300, 8))
+    labels = ["even" if i % 2 == 0 else "odd" for i in range(300)]
+    tree = ConditionalBallTree(X, list(range(300)), labels, leaf_size=10)
+    q = rng.normal(size=8)
+    got = tree.find_maximum_inner_products(q, k=3, conditioner={"even"})
+    ips = X @ q
+    brute = [i for i in np.argsort(-ips) if i % 2 == 0][:3]
+    assert [i for i, _ in got] == brute
+
+
+def test_knn_estimator_device_path(rng):
+    from mmlspark_tpu.nn import KNN
+    X = rng.normal(size=(200, 8))
+    df = DataFrame.from_dict({"features": vector_column(list(X)),
+                              "values": np.array([f"id{i}" for i in range(200)], dtype=object)})
+    model = KNN().set_params(k=3, output_col="matches").fit(df)
+    q = DataFrame.from_dict({"features": vector_column([X[7]])})
+    out = model.transform(q).collect()["matches"][0]
+    assert out[0]["value"] == "id7"
+    # save/load with ball tree payload
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as d:
+        save(model, os.path.join(d, "knn"))
+        m2 = load(os.path.join(d, "knn"))
+        out2 = m2.transform(q).collect()["matches"][0]
+        assert out2[0]["value"] == "id7"
+
+
+def test_sar_recommendations():
+    from mmlspark_tpu.recommendation import SAR
+    users = ["u1", "u1", "u2", "u2", "u3", "u3", "u3"]
+    items = ["a", "b", "a", "c", "b", "c", "d"]
+    df = DataFrame.from_dict({"user": np.array(users, dtype=object),
+                              "item": np.array(items, dtype=object),
+                              "rating": np.ones(7)})
+    model = SAR().set_params(support_threshold=1,
+                             similarity_function="jaccard").fit(df)
+    recs = model.recommend_for_all_users(2)
+    got = {r["user"]: r["recommendations"] for r in recs.iter_rows()}
+    assert set(got) == {"u1", "u2", "u3"}
+    # u1 saw a,b; c cooccurs with both -> should be recommended
+    assert "c" in got["u1"]
+    scored = model.transform(df)
+    assert (scored.collect()["prediction"] >= 0).all()
+
+
+def test_ranking_adapter_and_evaluator():
+    from mmlspark_tpu.recommendation import (SAR, RankingAdapter,
+                                             RankingEvaluator,
+                                             RankingTrainValidationSplit)
+    rng = np.random.default_rng(0)
+    users, items = [], []
+    for u in range(12):
+        liked = rng.choice(20, 6, replace=False)
+        for it in liked:
+            users.append(f"u{u}")
+            items.append(f"i{it}")
+    df = DataFrame.from_dict({"user": np.array(users, dtype=object),
+                              "item": np.array(items, dtype=object),
+                              "rating": np.ones(len(users))})
+    adapter = RankingAdapter(SAR().set_params(support_threshold=1), k=5)
+    model = adapter.fit(df)
+    out = model.transform(df)
+    ev = RankingEvaluator().set_params(k=5, metric_name="ndcgAt")
+    ndcg = ev.evaluate(out)
+    assert 0.0 <= ndcg <= 1.0
+    split = RankingTrainValidationSplit()
+    split.set("estimator", RankingAdapter(SAR().set_params(support_threshold=1), k=5))
+    split.set("evaluator", ev)
+    split.fit(df)
+    assert len(split.validation_metrics) == 1
+
+
+def test_isolation_forest_detects_outliers(rng):
+    from mmlspark_tpu.isolationforest import IsolationForest
+    X = rng.normal(size=(300, 4))
+    X[:6] += 8.0  # obvious outliers
+    df = DataFrame.from_dict({"features": vector_column(list(X))})
+    model = IsolationForest().set_params(num_estimators=50, contamination=0.02) \
+        .fit(df)
+    out = model.transform(df).collect()
+    scores = out["outlier_score"]
+    assert scores[:6].mean() > scores[6:].mean()
+    assert out["predicted_label"][:6].mean() > 0.5
+
+
+def test_tune_hyperparameters_and_find_best():
+    from mmlspark_tpu.automl import (TuneHyperparameters, HyperparamBuilder,
+                                     DiscreteHyperParam, RangeHyperParam,
+                                     GridSpace, FindBestModel)
+    from mmlspark_tpu.lightgbm import LightGBMClassifier
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(300, 6))
+    y = (X[:, 0] > 0).astype(float)
+    df = DataFrame.from_dict({"features": vector_column(list(X)), "label": y})
+    spaces = HyperparamBuilder() \
+        .add_hyperparam("num_iterations", DiscreteHyperParam([5, 10])) \
+        .add_hyperparam("learning_rate", RangeHyperParam(0.1, 0.3)).build()
+    tuner = TuneHyperparameters()
+    tuner.set("models", LightGBMClassifier().set_params(min_data_in_leaf=5))
+    tuner.set("param_space", GridSpace(spaces, points_per_range=2))
+    tuner.set("parallelism", 1)
+    best = tuner.fit(df)
+    assert best.get("best_metric") > 0.8
+    assert "num_iterations" in best.get("best_params")
+    m1 = LightGBMClassifier().set_params(num_iterations=2, min_data_in_leaf=5).fit(df)
+    m2 = LightGBMClassifier().set_params(num_iterations=20, min_data_in_leaf=5).fit(df)
+    fb = FindBestModel()
+    fb.set("models", [m1, m2])
+    bm = fb.fit(df)
+    assert bm.get("all_model_metrics")[1] >= bm.get("all_model_metrics")[0] - 1e-9
+
+
+def test_image_transformer_chain():
+    from mmlspark_tpu.opencv import ImageTransformer, ImageSetAugmenter
+    rng = np.random.default_rng(2)
+    col = np.empty(3, dtype=object)
+    for i in range(3):
+        col[i] = rng.uniform(0, 255, (12, 10, 3)).astype(np.float32)
+    df = DataFrame.from_dict({"image": col})
+    t = ImageTransformer(input_col="image", output_col="out") \
+        .resize(8, 8).blur(3, 3, 1.0).flip(1).normalize()
+    out = t.transform(df).collect()["out"]
+    assert out[0].shape == (8, 8, 3)
+    # unroll for downstream vector consumers
+    t2 = ImageTransformer(input_col="image", output_col="vec").resize(4, 4).unroll()
+    v = t2.transform(df).collect()["vec"]
+    assert v[0].shape == (48,)
+    aug = ImageSetAugmenter().set_params(input_col="image", output_col="aug")
+    assert aug.transform(df).count() == 6  # original + LR flip
