@@ -39,7 +39,7 @@ def _as_column(values: Any, n: Optional[int] = None) -> np.ndarray:
         # Ragged / nested columns are stored as object arrays unless rectangular numeric.
         try:
             arr = np.asarray(values)
-            if arr.dtype != object and arr.ndim >= 2:
+            if arr.dtype.kind in "fiub" and arr.ndim >= 2:
                 return arr
         except (ValueError, TypeError):
             pass

@@ -1,0 +1,8 @@
+from .anomaly import AccessAnomaly, AccessAnomalyModel, ComplementAccessTransformer
+from .feature import IdIndexer, IdIndexerModel, StandardScalarScaler, \
+    StandardScalarScalerModel, LinearScalarScaler, LinearScalarScalerModel
+
+__all__ = ["AccessAnomaly", "AccessAnomalyModel", "ComplementAccessTransformer",
+           "IdIndexer", "IdIndexerModel", "StandardScalarScaler",
+           "StandardScalarScalerModel", "LinearScalarScaler",
+           "LinearScalarScalerModel"]

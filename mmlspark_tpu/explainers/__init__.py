@@ -1,0 +1,9 @@
+from .local import (LocalExplainer, TabularLIME, TabularSHAP, VectorLIME,
+                    VectorSHAP, TextLIME, TextSHAP, ImageLIME, ImageSHAP)
+from .superpixel import SuperpixelTransformer, slic_superpixels
+from .regression import lasso_regression, weighted_least_squares
+
+__all__ = ["LocalExplainer", "TabularLIME", "TabularSHAP", "VectorLIME",
+           "VectorSHAP", "TextLIME", "TextSHAP", "ImageLIME", "ImageSHAP",
+           "SuperpixelTransformer", "slic_superpixels", "lasso_regression",
+           "weighted_least_squares"]

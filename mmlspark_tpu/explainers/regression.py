@@ -1,0 +1,68 @@
+"""Weighted linear solvers for explainers.
+
+Reference: ``explainers/`` breeze-based ``LassoRegression`` /
+``LeastSquaresRegression``.  Here: closed-form weighted least squares and an
+ISTA lasso, both jitted so the per-row surrogate fits batch onto the device.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def weighted_least_squares(X: np.ndarray, y: np.ndarray, w: np.ndarray,
+                           fit_intercept: bool = True,
+                           ridge: float = 1e-6) -> Tuple[np.ndarray, float]:
+    import jax.numpy as jnp
+    X = jnp.asarray(X, jnp.float64)
+    y = jnp.asarray(y, jnp.float64)
+    w = jnp.asarray(w, jnp.float64)
+    if fit_intercept:
+        X1 = jnp.concatenate([X, jnp.ones((X.shape[0], 1))], axis=1)
+    else:
+        X1 = X
+    WX = X1 * w[:, None]
+    A = X1.T @ WX + ridge * jnp.eye(X1.shape[1])
+    b = WX.T @ y
+    beta = jnp.linalg.solve(A, b)
+    beta = np.asarray(beta)
+    if fit_intercept:
+        return beta[:-1], float(beta[-1])
+    return beta, 0.0
+
+
+def lasso_regression(X: np.ndarray, y: np.ndarray, w: np.ndarray,
+                     alpha: float = 0.01, iters: int = 200,
+                     fit_intercept: bool = True) -> Tuple[np.ndarray, float]:
+    """Weighted lasso via ISTA (proximal gradient); jit-compiled loop."""
+    import jax
+    import jax.numpy as jnp
+
+    Xj = jnp.asarray(X, jnp.float64)
+    yj = jnp.asarray(y, jnp.float64)
+    wj = jnp.asarray(w, jnp.float64)
+    wj = wj / jnp.maximum(wj.sum(), 1e-12)
+    n, d = Xj.shape
+
+    x_mean = (Xj * wj[:, None]).sum(axis=0) if fit_intercept else jnp.zeros(d)
+    y_mean = (yj * wj).sum() if fit_intercept else 0.0
+    Xc = Xj - x_mean
+    yc = yj - y_mean
+
+    A = (Xc * wj[:, None]).T @ Xc
+    b = (Xc * wj[:, None]).T @ yc
+    L = jnp.maximum(jnp.trace(A), 1e-9)  # Lipschitz upper bound
+
+    @jax.jit
+    def solve(A, b, L):
+        def body(_, beta):
+            grad = A @ beta - b
+            z = beta - grad / L
+            return jnp.sign(z) * jnp.maximum(jnp.abs(z) - alpha / L, 0.0)
+        return jax.lax.fori_loop(0, iters, body, jnp.zeros_like(b))
+
+    beta = np.asarray(solve(A, b, L))
+    intercept = float(y_mean - x_mean @ beta) if fit_intercept else 0.0
+    return beta, intercept

@@ -1,0 +1,106 @@
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.core.schema import vector_column
+
+
+def _linear_model(weights):
+    """A transparent model: probability = sigmoid(w . x)."""
+    from mmlspark_tpu.core import Transformer
+
+    class Lin(Transformer):
+        def _transform(self, df):
+            def per_part(p):
+                X = np.stack([np.asarray(v, float) for v in p["features"]])
+                z = X @ weights
+                prob = 1 / (1 + np.exp(-z))
+                col = np.empty(len(z), dtype=object)
+                for i in range(len(z)):
+                    col[i] = np.asarray([1 - prob[i], prob[i]])
+                return {**p, "probability": col}
+            return df.map_partitions(per_part)
+
+    return Lin()
+
+
+def test_vector_lime_finds_important_features():
+    from mmlspark_tpu.explainers import LocalExplainer
+    w = np.asarray([3.0, 0.0, -2.0, 0.0])
+    model = _linear_model(w)
+    X = np.asarray([[1.0, 1.0, 1.0, 1.0]])
+    df = DataFrame.from_dict({"features": vector_column(list(X))})
+    lime = LocalExplainer.LIME.vector(
+        model=model, input_col="features", output_col="weights",
+        target_col="probability", target_classes=[1], num_samples=400,
+        regularization=0.001)
+    out = lime.transform(df).collect()
+    coefs = out["weights"][0]
+    assert abs(coefs[0]) > abs(coefs[1])
+    assert abs(coefs[2]) > abs(coefs[3])
+    assert coefs[0] > 0 > coefs[2]
+    assert out["r2"][0] > 0.5
+
+
+def test_vector_shap_additivity_direction():
+    from mmlspark_tpu.explainers import LocalExplainer
+    w = np.asarray([2.0, -1.0, 0.0])
+    model = _linear_model(w)
+    X = np.asarray([[1.0, 1.0, 1.0]])
+    df = DataFrame.from_dict({"features": vector_column(list(X))})
+    shap = LocalExplainer.KernelSHAP.vector(
+        model=model, input_col="features", output_col="shap",
+        target_col="probability", target_classes=[1], num_samples=256)
+    out = shap.transform(df).collect()["shap"][0]
+    assert out[0] > 0 > out[1]
+    assert abs(out[2]) < 0.05
+
+
+def test_text_lime_token_attribution():
+    from mmlspark_tpu.core import Transformer
+    from mmlspark_tpu.explainers import LocalExplainer
+
+    class KeywordModel(Transformer):
+        def _transform(self, df):
+            def per_part(p):
+                out = np.asarray([1.0 if "good" in str(s) else 0.0
+                                  for s in p["text"]])
+                return {**p, "prediction": out}
+            return df.map_partitions(per_part)
+
+    df = DataFrame.from_dict({"text": np.array(["a good day"], dtype=object)})
+    lime = LocalExplainer.LIME.text(
+        model=KeywordModel(), input_col="text", output_col="weights",
+        target_col="prediction", num_samples=64, regularization=0.001)
+    out = lime.transform(df).collect()
+    weights = out["weights"][0]
+    tokens = out["tokens"][0]
+    assert tokens == ["a", "good", "day"]
+    assert np.argmax(np.abs(weights)) == 1  # 'good' matters most
+
+
+def test_superpixels_and_image_lime():
+    from mmlspark_tpu.explainers import slic_superpixels, LocalExplainer
+    from mmlspark_tpu.core import Transformer
+    rng = np.random.default_rng(0)
+    img = np.zeros((24, 24, 3), np.float64)
+    img[:, 12:] = 255.0  # right half bright
+    segs = slic_superpixels(img, cell_size=8)
+    assert segs.shape == (24, 24)
+    assert segs.max() >= 3
+
+    class BrightModel(Transformer):
+        def _transform(self, df):
+            def per_part(p):
+                out = np.asarray([float(np.asarray(v).mean() > 60) for v in p["image"]])
+                return {**p, "prediction": out}
+            return df.map_partitions(per_part)
+
+    col = np.empty(1, dtype=object)
+    col[0] = img
+    df = DataFrame.from_dict({"image": col})
+    lime = LocalExplainer.LIME.image(
+        model=BrightModel(), input_col="image", output_col="weights",
+        target_col="prediction", num_samples=40, cell_size=8.0)
+    out = lime.transform(df).collect()
+    assert len(out["weights"][0]) == out["superpixels"][0].max() + 1
