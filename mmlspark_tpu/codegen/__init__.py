@@ -1,0 +1,5 @@
+from .registry import all_stage_classes, instantiate_default
+from .codegen import generate_stub_file, generate_docs, generate_all
+
+__all__ = ["all_stage_classes", "instantiate_default", "generate_stub_file",
+           "generate_docs", "generate_all"]

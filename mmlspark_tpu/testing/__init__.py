@@ -1,0 +1,6 @@
+from .fuzzing import TestObject, ExperimentFuzzing, SerializationFuzzing, \
+    assert_frames_equal
+from .benchmarks import Benchmarks, Benchmark
+
+__all__ = ["TestObject", "ExperimentFuzzing", "SerializationFuzzing",
+           "assert_frames_equal", "Benchmarks", "Benchmark"]

@@ -1,0 +1,109 @@
+"""Accuracy-benchmark regression gates.
+
+Reference: ``lightgbm/src/test/resources/benchmarks/benchmarks_Verify
+LightGBMClassifier.csv`` (8 datasets x gbdt/rf/dart/goss accuracies),
+``..._VerifyLightGBMRegressor.csv`` (L2, lower-is-better), and
+``vw/.../benchmarks_VerifyVowpalWabbitRegressor.csv`` — compared with
+per-metric precision via the ``Benchmarks`` trait.
+
+The reference's datasets are downloaded at build time (unavailable offline,
+SURVEY.md §6), so the gates run on deterministic seeded synthetic datasets
+with the same file format, modes and comparison semantics.  Baselines live in
+``tests/resources/benchmarks`` and regenerate with REGEN_BENCHMARKS=1.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.core.schema import vector_column
+from mmlspark_tpu.testing import Benchmarks
+
+RES = os.path.join(os.path.dirname(__file__), "resources", "benchmarks")
+MODES = ["gbdt", "rf", "dart", "goss"]
+
+
+def _datasets_classification():
+    out = {}
+    for name, n, d, seed in [("synth_easy", 400, 8, 11), ("synth_xor", 500, 6, 12),
+                             ("synth_noisy", 600, 10, 13)]:
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(n, d))
+        if name == "synth_xor":
+            y = ((X[:, 0] > 0) ^ (X[:, 1] > 0)).astype(float)
+        else:
+            noise = 0.1 if name == "synth_easy" else 1.0
+            y = (X[:, 0] * 2 - X[:, 1] + rng.normal(scale=noise, size=n) > 0).astype(float)
+        out[name] = (X, y)
+    return out
+
+
+def _datasets_regression():
+    out = {}
+    for name, n, d, seed in [("synth_linear", 400, 6, 21), ("synth_quad", 500, 8, 22)]:
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(n, d))
+        y = 3 * X[:, 0] - X[:, 1] + (X[:, 2] ** 2 if name == "synth_quad" else 0) \
+            + rng.normal(scale=0.2, size=n)
+        out[name] = (X, y)
+    return out
+
+
+def _frame(X, y):
+    return DataFrame.from_dict({"features": vector_column(list(X)), "label": y}, 2)
+
+
+def _run_or_verify(bench: Benchmarks):
+    if os.environ.get("REGEN_BENCHMARKS") or not os.path.exists(bench.baseline_path):
+        bench.write_baseline()
+    bench.verify()
+
+
+def test_lightgbm_classifier_benchmarks():
+    from mmlspark_tpu.lightgbm import LightGBMClassifier
+    bench = Benchmarks(os.path.join(RES, "benchmarks_VerifyLightGBMClassifier.csv"))
+    for ds_name, (X, y) in _datasets_classification().items():
+        for mode in MODES:
+            clf = LightGBMClassifier().set_params(
+                num_iterations=30, min_data_in_leaf=5, boosting_type=mode, seed=42)
+            model = clf.fit(_frame(X, y))
+            pred = model.transform(_frame(X, y)).collect()["prediction"]
+            acc = float((pred == y).mean())
+            bench.add(f"LightGBMClassifier_{ds_name}_{mode}", acc, 0.07, True)
+    _run_or_verify(bench)
+
+
+def test_lightgbm_regressor_benchmarks():
+    from mmlspark_tpu.lightgbm import LightGBMRegressor
+    bench = Benchmarks(os.path.join(RES, "benchmarks_VerifyLightGBMRegressor.csv"))
+    for ds_name, (X, y) in _datasets_regression().items():
+        for mode in MODES:
+            reg = LightGBMRegressor().set_params(
+                num_iterations=30, min_data_in_leaf=5, boosting_type=mode, seed=42)
+            model = reg.fit(_frame(X, y))
+            pred = model.transform(_frame(X, y)).collect()["prediction"]
+            l2 = float(np.mean((pred - y) ** 2))
+            bench.add(f"LightGBMRegressor_{ds_name}_{mode}", l2, 1.0, False)
+    _run_or_verify(bench)
+
+
+def test_vw_regressor_benchmarks():
+    from mmlspark_tpu.vw import VowpalWabbitRegressor
+    bench = Benchmarks(os.path.join(RES, "benchmarks_VerifyVowpalWabbitRegressor.csv"))
+    for ds_name, (X, y) in _datasets_regression().items():
+        for args in ["", "--adaptive off"]:
+            col = np.empty(len(X), dtype=object)
+            for i in range(len(X)):
+                col[i] = {"indices": np.arange(X.shape[1], dtype=np.int32),
+                          "values": X[i].astype(np.float32)}
+            df = DataFrame.from_dict({"features": col, "label": y}, 2)
+            reg = VowpalWabbitRegressor().set_params(num_bits=10, num_passes=10)
+            if args:
+                reg.set("adaptive", False)
+            model = reg.fit(df)
+            pred = model.transform(df).collect()["prediction"]
+            loss = float(np.mean((pred - y) ** 2))
+            tag = "default" if not args else "no_adaptive"
+            bench.add(f"VowpalWabbitRegressor_{ds_name}_{tag}", loss, 1.0, False)
+    _run_or_verify(bench)

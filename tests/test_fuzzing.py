@@ -1,0 +1,166 @@
+"""Global fuzzing sweep — every stage is discovered, hygienic, serializable.
+
+Reference: ``src/test/scala/.../FuzzingTest.scala:18``: reflect over every
+PipelineStage, assert each is fuzzed/wrapped/readable with explicit exemption
+lists (:36-61) so coverage is enforced by construction.
+"""
+import numpy as np
+import pytest
+
+from mmlspark_tpu.codegen import all_stage_classes, instantiate_default
+from mmlspark_tpu.core import DataFrame, Estimator, Transformer
+from mmlspark_tpu.core.serialize import load_stage, save_stage
+from mmlspark_tpu.core.schema import vector_column
+from mmlspark_tpu.testing import (TestObject, ExperimentFuzzing,
+                                  SerializationFuzzing)
+
+# stages whose construction/serialization needs runtime payloads the sweep
+# can't synthesize (reference keeps the same kind of exemption list)
+SERIALIZATION_EXEMPT = {
+    "Lambda", "UDFTransformer", "Timer",  # function payloads set at use site
+    "JaxModel", "ImageFeaturizer",        # model payloads set at use site
+    "Pipeline", "PipelineModel",          # stage-list payloads
+    "TuneHyperparameters", "FindBestModel", "RankingAdapter",
+    "RankingTrainValidationSplit", "TrainClassifier", "TrainRegressor",
+}
+
+
+def test_registry_finds_the_framework():
+    classes = all_stage_classes()
+    names = {c.__qualname__ for c in classes}
+    assert len(classes) >= 80, f"only {len(classes)} stages discovered"
+    for expected in ["LightGBMClassifier", "VowpalWabbitClassifier", "JaxModel",
+                     "ImageFeaturizer", "TextSentiment", "SAR", "KNN",
+                     "IsolationForest", "TabularLIME", "Featurize",
+                     "FixedMiniBatchTransformer", "ImageTransformer"]:
+        assert expected in names, f"{expected} missing from registry"
+
+
+def test_param_hygiene_all_stages():
+    for cls in all_stage_classes():
+        for p in cls.params():
+            assert p.doc and isinstance(p.doc, str), \
+                f"{cls.__qualname__}.{p.name} lacks a doc string"
+            assert p.name.isidentifier(), f"bad param name {p.name}"
+
+
+def test_default_stage_serialization_roundtrip():
+    """Every default-constructible stage saves and loads with identical params
+    (SerializationFuzzing raw-stage half, applied globally)."""
+    import tempfile
+    checked = 0
+    for cls in all_stage_classes():
+        if cls.__qualname__ in SERIALIZATION_EXEMPT:
+            continue
+        stage = instantiate_default(cls)
+        if stage is None:
+            continue
+        with tempfile.TemporaryDirectory() as d:
+            save_stage(stage, f"{d}/s")
+            re = load_stage(f"{d}/s")
+            assert type(re) is type(stage), cls
+            assert re.uid == stage.uid
+            assert re.has_same_params(stage), cls
+        checked += 1
+    assert checked >= 60, f"only {checked} stages roundtripped"
+
+
+def _vec_frame(n=60, d=5, seed=0, label=True):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d))
+    cols = {"features": vector_column(list(X))}
+    if label:
+        cols["label"] = (X[:, 0] > 0).astype(float)
+    return DataFrame.from_dict(cols, 2)
+
+
+def _test_objects():
+    """TestObjects for the flagship estimators/transformers (reference:
+    per-suite ``testObjects()`` declarations)."""
+    from mmlspark_tpu.lightgbm import LightGBMClassifier, LightGBMRegressor
+    from mmlspark_tpu.vw import VowpalWabbitClassifier, VowpalWabbitFeaturizer
+    from mmlspark_tpu.featurize import CleanMissingData, ValueIndexer
+    from mmlspark_tpu.isolationforest import IsolationForest
+    from mmlspark_tpu.nn import KNN
+    from mmlspark_tpu.stages import (FixedMiniBatchTransformer, SummarizeData,
+                                     TextPreprocessor)
+    from mmlspark_tpu.opencv import ImageTransformer
+
+    vec = _vec_frame()
+    sparse = None
+    rng = np.random.default_rng(1)
+    sp_col = np.empty(40, dtype=object)
+    for i in range(40):
+        sp_col[i] = {"indices": np.arange(5, dtype=np.int32),
+                     "values": rng.normal(size=5).astype(np.float32)}
+    sparse = DataFrame.from_dict({"features": sp_col,
+                                  "label": (rng.random(40) > 0.5).astype(float)}, 2)
+    txt = DataFrame.from_dict({"text": np.array(["Hello World", "FOO bar"], dtype=object)})
+    imgs = np.empty(2, dtype=object)
+    for i in range(2):
+        imgs[i] = rng.uniform(0, 255, (8, 8, 3)).astype(np.float32)
+    img_df = DataFrame.from_dict({"image": imgs})
+    nan_df = DataFrame.from_dict({"x": np.array([1.0, np.nan, 5.0])})
+
+    return [
+        TestObject(LightGBMClassifier().set_params(num_iterations=5, min_data_in_leaf=2), vec),
+        TestObject(LightGBMRegressor().set_params(num_iterations=5, min_data_in_leaf=2), vec),
+        TestObject(VowpalWabbitClassifier().set_params(num_bits=8, num_passes=2), sparse),
+        TestObject(VowpalWabbitFeaturizer().set_params(input_cols=["text"], output_col="f"),
+                   transform_df=txt),
+        TestObject(CleanMissingData().set_params(input_cols=["x"]), nan_df),
+        TestObject(ValueIndexer().set_params(input_col="text", output_col="i"), txt),
+        TestObject(IsolationForest().set_params(num_estimators=10), vec.drop("label")),
+        TestObject(KNN().set_params(k=2, output_col="m"), vec.drop("label")),
+        TestObject(FixedMiniBatchTransformer().set_params(batch_size=3),
+                   transform_df=vec),
+        TestObject(SummarizeData(), transform_df=_vec_frame(20, 2, label=False)
+                   .with_column("n", lambda p: np.arange(len(p["features"]), dtype=float))
+                   .drop("features")),
+        TestObject(TextPreprocessor().set_params(input_col="text", output_col="t"),
+                   transform_df=txt),
+        TestObject(ImageTransformer(input_col="image", output_col="o").resize(4, 4),
+                   transform_df=img_df),
+    ]
+
+
+@pytest.mark.parametrize("obj", _test_objects(),
+                         ids=lambda o: type(o.stage).__name__)
+def test_experiment_fuzzing(obj):
+    model, out = ExperimentFuzzing.run(obj)
+    assert out.count() > 0  # batchers legitimately change row counts
+
+
+@pytest.mark.parametrize("obj", _test_objects(),
+                         ids=lambda o: type(o.stage).__name__)
+def test_serialization_fuzzing(obj):
+    SerializationFuzzing.run(obj)
+
+
+def test_codegen_outputs(tmp_path):
+    from mmlspark_tpu.codegen import generate_all
+    generate_all(str(tmp_path))
+    stub = (tmp_path / "mmlspark_tpu.pyi").read_text()
+    assert "def set_num_iterations" in stub
+    api = (tmp_path / "API.md").read_text()
+    assert "LightGBMClassifier" in api and "| num_leaves |" in api
+    import json
+    manifest = json.loads((tmp_path / "params_manifest.json").read_text())
+    assert any("LightGBMClassifier" in k for k in manifest)
+
+
+def test_benchmarks_harness(tmp_path):
+    from mmlspark_tpu.testing import Benchmarks
+    b = Benchmarks(str(tmp_path / "base.csv"))
+    b.add("m1", 0.9, 0.05, True)
+    b.add("m2", 1.2, 0.1, False)
+    b.write_baseline()
+    b2 = Benchmarks(str(tmp_path / "base.csv"))
+    b2.add("m1", 0.87, 0.05, True)   # within precision
+    b2.add("m2", 1.25, 0.1, False)
+    b2.verify()
+    b3 = Benchmarks(str(tmp_path / "base.csv"))
+    b3.add("m1", 0.5, 0.05, True)    # regression
+    b3.add("m2", 1.2, 0.1, False)
+    with pytest.raises(AssertionError):
+        b3.verify()
