@@ -1,5 +1,8 @@
 from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101
 from .bilstm import BiLSTMTagger, LSTMLayer
+from .transformer import TransformerEncoder, EncoderBlock, MultiHeadAttention
+from .gbdt import GBDTBooster
 
 __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
-           "BiLSTMTagger", "LSTMLayer"]
+           "BiLSTMTagger", "LSTMLayer", "TransformerEncoder", "EncoderBlock",
+           "MultiHeadAttention", "GBDTBooster"]

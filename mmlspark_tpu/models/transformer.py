@@ -1,0 +1,116 @@
+"""Transformer encoder — the long-context model family.
+
+Goes beyond the reference (whose only sequence model is a per-row BiLSTM,
+SURVEY.md §5.7): a flax encoder whose attention can run dense, blockwise
+(memory-efficient single device), or as ring attention over the ``seq`` mesh
+axis for sequences longer than one device's HBM
+(``parallel.ring_attention``).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..parallel import ring_attention as ra
+
+
+class MultiHeadAttention(nn.Module):
+    num_heads: int
+    head_dim: int
+    attention_mode: str = "dense"      # dense | blockwise | ring
+    causal: bool = False
+    block_size: int = 512
+    seq_axis: str = "seq"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        B, L, _ = x.shape
+        H, D = self.num_heads, self.head_dim
+        qkv = nn.Dense(3 * H * D, dtype=self.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv.reshape(B, L, 3, H, D).transpose(2, 0, 3, 1, 4), 3)
+        q, k, v = q[0], k[0], v[0]                    # (B, H, L, D)
+        if self.attention_mode == "ring":
+            # inside shard_map the seq axis name is live; outside it falls
+            # back to blockwise
+            try:
+                out = ra.ring_attention(q, k, v, axis_name=self.seq_axis,
+                                        causal=self.causal)
+            except NameError:
+                out = ra.blockwise_attention(q, k, v, self.block_size, self.causal)
+        elif self.attention_mode == "blockwise":
+            out = ra.blockwise_attention(q, k, v, self.block_size, self.causal)
+        else:
+            s = (q @ k.swapaxes(-1, -2)) / jnp.sqrt(D)
+            if self.causal:
+                mask = jnp.tril(jnp.ones((L, L), bool))
+                s = jnp.where(mask, s, -1e30)
+            out = jnp.einsum("bhqk,bhkd->bhqd", nn.softmax(s, axis=-1), v)
+        out = out.transpose(0, 2, 1, 3).reshape(B, L, H * D)
+        return nn.Dense(x.shape[-1], dtype=self.dtype, name="proj")(out)
+
+
+class EncoderBlock(nn.Module):
+    num_heads: int
+    head_dim: int
+    mlp_dim: int
+    attention_mode: str = "dense"
+    causal: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = MultiHeadAttention(self.num_heads, self.head_dim,
+                               self.attention_mode, self.causal,
+                               dtype=self.dtype)(h)
+        x = x + h
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(self.mlp_dim, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(x.shape[-1], dtype=self.dtype)(h)
+        return x + h
+
+
+class TransformerEncoder(nn.Module):
+    """Token transformer; ``features=True`` returns per-token embeddings."""
+
+    vocab_size: int
+    num_classes: int = 2
+    embed_dim: int = 256
+    num_heads: int = 4
+    num_layers: int = 4
+    mlp_dim: int = 512
+    max_len: int = 32768
+    attention_mode: str = "dense"
+    causal: bool = False
+    pool: str = "mean"                 # mean | none (per-token)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False, features: bool = False,
+                 positions=None):
+        B, L = tokens.shape
+        x = nn.Embed(self.vocab_size, self.embed_dim, dtype=self.dtype)(tokens)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, self.max_len, self.embed_dim))
+        if positions is not None:
+            # explicit global positions: required under sequence parallelism,
+            # where the local shard starts at axis_index * L_local
+            x = x + jnp.take(pos[0], positions, axis=0).astype(self.dtype)
+        else:
+            x = x + pos[:, :L].astype(self.dtype)
+        head_dim = self.embed_dim // self.num_heads
+        for i in range(self.num_layers):
+            x = EncoderBlock(self.num_heads, head_dim, self.mlp_dim,
+                             self.attention_mode, self.causal,
+                             dtype=self.dtype, name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        if features:
+            return x.astype(jnp.float32)
+        if self.pool == "mean":
+            x = x.mean(axis=1)
+        logits = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return logits.astype(jnp.float32)  # (B, C) or (B, L, C) for pool="none"

@@ -1,0 +1,147 @@
+"""Ring attention — sequence/context parallelism over the mesh.
+
+The reference has no long-context machinery (SURVEY.md §5.7: its only
+sequence model is a per-row BiLSTM) — this subsystem is the TPU-native
+capability the rebuild adds as first-class: sequences sharded over the
+``seq`` mesh axis, with K/V blocks rotating around the ring via ``ppermute``
+(one ICI hop per step) while each device accumulates its queries' attention
+with a numerically-stable online softmax (blockwise/flash-style).
+
+Memory per device: O(L/P * d) activations; communication: P-1 K/V block
+rotations overlapped with compute — the standard ring-attention recipe.
+
+``blockwise_attention`` is the single-device building block (lax.scan over
+KV chunks, O(block^2) VMEM); ``ring_attention`` runs under ``shard_map``.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+from .mesh import AXIS_SEQ, get_active_mesh
+
+
+def _online_softmax_step(carry, kv, q, scale, mask_value=-1e30, block_mask=None):
+    """One KV block of streaming attention.  carry = (acc, row_max, row_sum)."""
+    import jax.numpy as jnp
+    acc, m_prev, l_prev = carry
+    k, v = kv
+    s = (q @ k.swapaxes(-1, -2)) * scale                 # (..., q_len, kv_len)
+    if block_mask is not None:
+        s = jnp.where(block_mask, s, mask_value)
+    m_new = jnp.maximum(m_prev, s.max(axis=-1))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    l_new = l_prev * alpha + p.sum(axis=-1)
+    acc = acc * alpha[..., None] + p @ v
+    return (acc, m_new, l_new)
+
+
+def blockwise_attention(q, k, v, block_size: int = 512, causal: bool = False,
+                        scale: Optional[float] = None):
+    """Memory-efficient attention via lax.scan over KV blocks.
+
+    q, k, v: (..., seq, head_dim).  Equivalent to softmax(qk^T/sqrt(d))v.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    L = k.shape[-2]
+    Lq = q.shape[-2]
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    nb = max(1, (L + block_size - 1) // block_size)
+    pad = nb * block_size - L
+    if pad:
+        k = jnp.concatenate([k, jnp.zeros((*k.shape[:-2], pad, d), k.dtype)], axis=-2)
+        v = jnp.concatenate([v, jnp.zeros((*v.shape[:-2], pad, d), v.dtype)], axis=-2)
+    # block axis to front for scan: (nb, ..., block, d)
+    kb = jnp.moveaxis(k.reshape(*k.shape[:-2], nb, block_size, d), -3, 0)
+    vb = jnp.moveaxis(v.reshape(*v.shape[:-2], nb, block_size, d), -3, 0)
+
+    q_pos = jnp.arange(Lq)
+    acc0 = jnp.zeros((*q.shape[:-2], Lq, d), jnp.float32)
+    m0 = jnp.full((*q.shape[:-2], Lq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((*q.shape[:-2], Lq), jnp.float32)
+
+    def body(carry, inputs):
+        bi, (kblk, vblk) = inputs
+        kv_pos = bi * block_size + jnp.arange(block_size)
+        mask = kv_pos[None, :] < L
+        if causal:
+            mask = mask & (kv_pos[None, :] <= q_pos[:, None])
+        carry = _online_softmax_step(carry, (kblk, vblk), q.astype(jnp.float32),
+                                     scale, block_mask=mask)
+        return carry, None
+
+    (acc, m, l), _ = jax.lax.scan(body, (acc0, m0, l0),
+                                  (jnp.arange(nb), (kb.astype(jnp.float32),
+                                                    vb.astype(jnp.float32))))
+    return (acc / jnp.maximum(l[..., None], 1e-30)).astype(q.dtype)
+
+
+def ring_attention(q, k, v, axis_name: str = AXIS_SEQ, causal: bool = False,
+                   scale: Optional[float] = None):
+    """Attention with sequence sharded over `axis_name`; call inside shard_map.
+
+    Each device holds local Q/K/V shards (..., L/P, d).  K/V rotate around
+    the ring; online-softmax stats merge partial results so the output equals
+    full attention over the global sequence.  For ``causal=True`` the global
+    positions are recovered from the ring step and the device index.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    P = jax.lax.psum(1, axis_name)
+    me = jax.lax.axis_index(axis_name)
+    Lloc = q.shape[-2]
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    perm = [(i, (i + 1) % P) for i in range(P)]
+
+    q32 = q.astype(jnp.float32)
+    acc = jnp.zeros((*q.shape[:-2], Lloc, d), jnp.float32)
+    m = jnp.full((*q.shape[:-2], Lloc), -jnp.inf, jnp.float32)
+    l = jnp.zeros((*q.shape[:-2], Lloc), jnp.float32)
+    q_pos = (me * Lloc + jnp.arange(Lloc))
+
+    def body(step, carry):
+        acc, m, l, k_cur, v_cur = carry
+        src_dev = (me - step) % P                      # whose KV block this is
+        kv_pos = src_dev * Lloc + jnp.arange(Lloc)
+        if causal:
+            mask = kv_pos[None, :] <= q_pos[:, None]
+        else:
+            mask = None
+        acc, m, l = _online_softmax_step(
+            (acc, m, l), (k_cur.astype(jnp.float32), v_cur.astype(jnp.float32)),
+            q32, scale, block_mask=mask)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (acc, m, l, k_nxt, v_nxt)
+
+    carry = (acc, m, l, k, v)
+    # python loop: P is static under shard_map tracing
+    for step in range(P):
+        carry = body(step, carry)
+    acc, m, l = carry[0], carry[1], carry[2]
+    return (acc / jnp.maximum(l[..., None], 1e-30)).astype(q.dtype)
+
+
+def make_ring_attention_fn(mesh=None, axis_name: str = AXIS_SEQ,
+                           causal: bool = False):
+    """jit-compiled f(q, k, v) with seq dim sharded over `axis_name`.
+    q/k/v: (batch, heads, seq, head_dim) global arrays."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    mesh = mesh or get_active_mesh()
+    spec = P(None, None, axis_name, None)
+
+    fn = jax.shard_map(
+        partial(ring_attention, axis_name=axis_name, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return jax.jit(fn)

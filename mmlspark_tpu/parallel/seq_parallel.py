@@ -1,0 +1,67 @@
+"""Sequence-parallel training step — dp x sp over (data, seq) mesh axes.
+
+Long-context training where each device holds a slice of every sequence:
+tokens shard over both batch (``data``) and sequence (``seq``); attention is
+ring attention over ``seq``; the loss pmean and the gradient psum are the
+only other collectives.  This is the capability the reference lacks entirely
+(SURVEY.md §5.7) and the task brief makes first-class.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Tuple
+
+import numpy as np
+
+from .mesh import AXIS_DATA, AXIS_SEQ, get_active_mesh
+
+
+def make_seq_parallel_train_step(module, learning_rate: float = 1e-3,
+                                 mesh=None):
+    """SGD train step for a per-token classifier (pool='none',
+    attention_mode='ring') with tokens (B, L) sharded (data, seq).
+
+    Returns (init_fn, step_fn):
+      init_fn(rng, tokens, positions) -> replicated params
+      step_fn(params, tokens, positions, labels) -> (params, loss)
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = mesh or get_active_mesh()
+    tok_spec = P(AXIS_DATA, AXIS_SEQ)
+    rep = P()
+
+    def local_step(params, tokens, positions, labels):
+        def loss_fn(p):
+            logits = module.apply({"params": p}, tokens, positions=positions)
+            ll = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+            return jax.lax.pmean(ll.mean(), (AXIS_DATA, AXIS_SEQ))
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        # each shard holds its partial gradient of the pmean'd loss
+        grads = jax.tree.map(lambda g: jax.lax.psum(g, (AXIS_DATA, AXIS_SEQ)),
+                             grads)
+        params = jax.tree.map(lambda w, g: w - learning_rate * g, params, grads)
+        return params, loss
+
+    step_fn = jax.jit(jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(rep, tok_spec, tok_spec, tok_spec),
+        out_specs=(rep, rep), check_vma=False))
+
+    def init_fn(rng, tokens, positions):
+        variables = module.init(rng, tokens[:1, : tokens.shape[1] // mesh.shape[AXIS_SEQ]],
+                                positions=positions[:1, : tokens.shape[1] // mesh.shape[AXIS_SEQ]])
+        params = variables["params"]
+        return jax.device_put(params, NamedSharding(mesh, rep))
+
+    return init_fn, step_fn
+
+
+def global_positions(batch: int, seq_len: int) -> np.ndarray:
+    """(B, L) global position ids to shard alongside tokens."""
+    return np.broadcast_to(np.arange(seq_len, dtype=np.int32)[None, :],
+                           (batch, seq_len)).copy()
