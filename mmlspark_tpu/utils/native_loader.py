@@ -1,0 +1,147 @@
+"""NativeLoader — build/load the C++ data plane, with pure-python fallback.
+
+Reference: ``core/env/NativeLoader.java:28`` extracts packaged ``.so`` files
+and ``System.load``s them in manifest order.  Here the library is built from
+``native/mmlspark_native.cpp`` on first use (g++ is part of the toolchain)
+and loaded via ctypes; every consumer has a numpy fallback so the framework
+stays functional without a compiler.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _native_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "native")
+
+
+def load_native() -> Optional[ctypes.CDLL]:
+    """Returns the loaded library or None (fallback mode)."""
+    global _LIB, _TRIED
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        d = _native_dir()
+        so = os.path.join(d, "libmmlspark_native.so")
+        if not os.path.exists(so):
+            src = os.path.join(d, "mmlspark_native.cpp")
+            if not os.path.exists(src):
+                return None
+            try:
+                subprocess.run(["make", "-C", d], check=True,
+                               capture_output=True, timeout=120)
+            except Exception:  # noqa: BLE001 — no compiler: numpy fallback
+                return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            return None
+        lib.mm_murmur3_32.restype = ctypes.c_uint32
+        lib.mm_murmur3_32.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                      ctypes.c_uint32]
+        lib.mm_murmur3_batch.restype = None
+        lib.mm_csv_parse_f32.restype = ctypes.c_int64
+        lib.mm_csv_shape.restype = None
+        lib.mm_chunked_new.restype = ctypes.c_void_p
+        lib.mm_chunked_size.restype = ctypes.c_int64
+        lib.mm_chunked_add.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                       ctypes.c_int64]
+        lib.mm_chunked_coalesce.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.mm_chunked_free.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+        return _LIB
+
+
+def murmur3_batch_native(strings, seed: int = 0):
+    """Hash a list of str/bytes via the native batch kernel; None if no lib."""
+    import numpy as np
+    lib = load_native()
+    if lib is None:
+        return None
+    blobs = [s.encode("utf-8") if isinstance(s, str) else bytes(s) for s in strings]
+    offsets = np.zeros(len(blobs) + 1, np.int64)
+    for i, b in enumerate(blobs):
+        offsets[i + 1] = offsets[i] + len(b)
+    data = b"".join(blobs)
+    out = np.zeros(len(blobs), np.uint32)
+    lib.mm_murmur3_batch(data, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                         len(blobs), ctypes.c_uint32(seed),
+                         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
+    return out
+
+
+def csv_to_matrix_native(text: bytes, skip_header: bool = True):
+    """CSV bytes -> (n, F) float32 matrix via the native parser; None if no lib."""
+    import numpy as np
+    lib = load_native()
+    if lib is None:
+        return None
+    nrows = ctypes.c_int64()
+    ncols = ctypes.c_int64()
+    lib.mm_csv_shape(text, len(text), ctypes.byref(nrows), ctypes.byref(ncols))
+    cap = nrows.value
+    out = np.empty((max(cap, 1), ncols.value), np.float32)
+    got = lib.mm_csv_parse_f32(text, len(text), ncols.value,
+                               out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                               cap, 1 if skip_header else 0)
+    if got < 0:
+        return None
+    return out[:got]
+
+
+class ChunkedArray:
+    """Growable native float32 buffer (reference SWIG ChunkedArray analogue,
+    ``swig/SwigUtils.scala:23-100``)."""
+
+    def __init__(self, initial_cap: int = 1 << 16):
+        self._lib = load_native()
+        self._chunks = []  # fallback storage
+        self._handle = None
+        if self._lib is not None:
+            self._handle = ctypes.c_void_p(self._lib.mm_chunked_new(initial_cap))
+
+    def add(self, values) -> None:
+        import numpy as np
+        arr = np.ascontiguousarray(values, np.float32)
+        if self._handle is not None:
+            self._lib.mm_chunked_add(self._handle,
+                                     arr.ctypes.data_as(ctypes.c_void_p),
+                                     arr.size)
+        else:
+            self._chunks.append(arr.copy())
+
+    @property
+    def size(self) -> int:
+        if self._handle is not None:
+            return int(self._lib.mm_chunked_size(self._handle))
+        return int(sum(a.size for a in self._chunks))
+
+    def coalesce(self):
+        import numpy as np
+        if self._handle is not None:
+            out = np.empty(self.size, np.float32)
+            self._lib.mm_chunked_coalesce(self._handle,
+                                          out.ctypes.data_as(ctypes.c_void_p))
+            return out
+        return np.concatenate(self._chunks) if self._chunks else np.empty(0, np.float32)
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.mm_chunked_free(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass

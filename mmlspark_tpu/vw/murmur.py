@@ -78,5 +78,12 @@ class StringHashCache:
 
     def hash_array(self, arr: np.ndarray) -> np.ndarray:
         uniq, inv = np.unique(arr.astype(str), return_inverse=True)
+        misses = [u for u in uniq if u not in self._cache]
+        if len(misses) > 32:  # batch the cold strings through the C++ kernel
+            from ..utils.native_loader import murmur3_batch_native
+            hashed = murmur3_batch_native(misses, self.seed)
+            if hashed is not None:
+                for u, h in zip(misses, hashed):
+                    self._cache[u] = int(h)
         hashes = np.asarray([self(u) for u in uniq], dtype=np.uint32)
         return hashes[inv]

@@ -1,0 +1,185 @@
+// mmlspark_tpu native data plane.
+//
+// The reference keeps its ingest/marshalling hot loops in native code behind
+// JNI (LightGBM SWIG chunked arrays, reference dataset/DatasetAggregator.scala;
+// VW murmur hashing, docs/vw.md:29-30).  The TPU rebuild keeps device compute
+// in XLA, and hosts these CPU-bound loops here: batch MurmurHash3 for the
+// VW featurizer and a fast CSV->float32 columnar parser for ingest.
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <cmath>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// MurmurHash3_x86_32 (canonical)
+// ---------------------------------------------------------------------------
+
+static inline uint32_t rotl32(uint32_t x, int8_t r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+static inline uint32_t fmix32(uint32_t h) {
+  h ^= h >> 16; h *= 0x85ebca6b;
+  h ^= h >> 13; h *= 0xc2b2ae35;
+  h ^= h >> 16;
+  return h;
+}
+
+uint32_t mm_murmur3_32(const uint8_t* data, int64_t len, uint32_t seed) {
+  const int64_t nblocks = len / 4;
+  uint32_t h1 = seed;
+  const uint32_t c1 = 0xcc9e2d51, c2 = 0x1b873593;
+
+  const uint32_t* blocks = reinterpret_cast<const uint32_t*>(data);
+  for (int64_t i = 0; i < nblocks; i++) {
+    uint32_t k1;
+    std::memcpy(&k1, blocks + i, sizeof(k1));
+    k1 *= c1; k1 = rotl32(k1, 15); k1 *= c2;
+    h1 ^= k1; h1 = rotl32(h1, 13); h1 = h1 * 5 + 0xe6546b64;
+  }
+
+  const uint8_t* tail = data + nblocks * 4;
+  uint32_t k1 = 0;
+  switch (len & 3) {
+    case 3: k1 ^= static_cast<uint32_t>(tail[2]) << 16; [[fallthrough]];
+    case 2: k1 ^= static_cast<uint32_t>(tail[1]) << 8;  [[fallthrough]];
+    case 1: k1 ^= tail[0];
+            k1 *= c1; k1 = rotl32(k1, 15); k1 *= c2; h1 ^= k1;
+  }
+  h1 ^= static_cast<uint32_t>(len);
+  return fmix32(h1);
+}
+
+// Hash n byte strings packed into `data` with prefix-sum `offsets` (n+1).
+void mm_murmur3_batch(const uint8_t* data, const int64_t* offsets, int64_t n,
+                      uint32_t seed, uint32_t* out) {
+  for (int64_t i = 0; i < n; i++) {
+    out[i] = mm_murmur3_32(data + offsets[i], offsets[i + 1] - offsets[i], seed);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// CSV -> float32 columnar parser (numeric matrices; NaN for empty/bad cells)
+// ---------------------------------------------------------------------------
+
+// Parses `len` bytes of CSV with `ncols` columns into out (row-major,
+// nrows_cap rows).  Returns rows parsed, or -1 on overflow.  Fast path for
+// the framework's tabular ingest: no quoting support (numeric files).
+int64_t mm_csv_parse_f32(const char* buf, int64_t len, int64_t ncols,
+                         float* out, int64_t nrows_cap, int skip_header) {
+  int64_t pos = 0, row = 0, col = 0;
+  if (skip_header) {
+    while (pos < len && buf[pos] != '\n') pos++;
+    if (pos < len) pos++;
+  }
+  const char* p = buf + pos;
+  const char* end = buf + len;
+  while (p < end) {
+    if (row >= nrows_cap) return -1;
+    // parse one cell
+    const char* cell_start = p;
+    while (p < end && *p != ',' && *p != '\n' && *p != '\r') p++;
+    if (p == cell_start) {
+      out[row * ncols + col] = NAN;
+    } else {
+      char tmp[64];
+      int64_t m = p - cell_start;
+      if (m > 63) m = 63;
+      std::memcpy(tmp, cell_start, m);
+      tmp[m] = 0;
+      char* endp = nullptr;
+      double v = std::strtod(tmp, &endp);
+      out[row * ncols + col] = (endp == tmp) ? NAN : static_cast<float>(v);
+    }
+    col++;
+    if (p < end && *p == ',') {
+      p++;
+      continue;
+    }
+    // line end
+    while (p < end && (*p == '\r' || *p == '\n')) {
+      if (*p == '\n') {
+        while (col < ncols) out[row * ncols + col++] = NAN;
+        row++;
+        col = 0;
+      }
+      p++;
+    }
+    if (p >= end && col > 0) {  // last line without newline
+      while (col < ncols) out[row * ncols + col++] = NAN;
+      row++;
+      col = 0;
+    }
+  }
+  return row;
+}
+
+// Count rows/cols of a CSV buffer (cols from first line).
+void mm_csv_shape(const char* buf, int64_t len, int64_t* nrows, int64_t* ncols) {
+  int64_t rows = 0, cols = 1;
+  bool first = true, line_nonempty = false;
+  for (int64_t i = 0; i < len; i++) {
+    if (buf[i] == ',' && first) cols++;
+    if (buf[i] == '\n') {
+      if (line_nonempty || i > 0) rows++;
+      first = false;
+      line_nonempty = false;
+    } else if (buf[i] != '\r') {
+      line_nonempty = true;
+    }
+  }
+  if (line_nonempty) rows++;
+  *nrows = rows;
+  *ncols = cols;
+}
+
+// ---------------------------------------------------------------------------
+// Chunked column appender (DatasetAggregator analogue): accumulate float32
+// values in growable chunks without Python-loop overhead, then coalesce.
+// ---------------------------------------------------------------------------
+
+struct MMChunkedArray {
+  float* data;
+  int64_t size;
+  int64_t cap;
+};
+
+void* mm_chunked_new(int64_t initial_cap) {
+  auto* a = new MMChunkedArray();
+  a->cap = initial_cap > 0 ? initial_cap : 1024;
+  a->size = 0;
+  a->data = static_cast<float*>(std::malloc(sizeof(float) * a->cap));
+  return a;
+}
+
+void mm_chunked_add(void* handle, const float* values, int64_t n) {
+  auto* a = static_cast<MMChunkedArray*>(handle);
+  while (a->size + n > a->cap) {
+    a->cap *= 2;
+    a->data = static_cast<float*>(std::realloc(a->data, sizeof(float) * a->cap));
+  }
+  std::memcpy(a->data + a->size, values, sizeof(float) * n);
+  a->size += n;
+}
+
+int64_t mm_chunked_size(void* handle) {
+  return static_cast<MMChunkedArray*>(handle)->size;
+}
+
+void mm_chunked_coalesce(void* handle, float* out) {
+  auto* a = static_cast<MMChunkedArray*>(handle);
+  std::memcpy(out, a->data, sizeof(float) * a->size);
+}
+
+void mm_chunked_free(void* handle) {
+  auto* a = static_cast<MMChunkedArray*>(handle);
+  std::free(a->data);
+  delete a;
+}
+
+}  // extern "C"

@@ -146,3 +146,45 @@ def test_contextual_bandit():
     picked = np.asarray([np.argmin(s) for s in scores])
     regret_match = (picked == best).mean()
     assert regret_match > 0.6, regret_match
+
+
+def test_native_data_plane(tmp_path):
+    """C++ data plane: murmur batch matches python; CSV parser; chunked array.
+    (Builds native/libmmlspark_native.so on first use via NativeLoader.)"""
+    from mmlspark_tpu.utils.native_loader import (load_native,
+                                                  murmur3_batch_native,
+                                                  csv_to_matrix_native,
+                                                  ChunkedArray)
+    from mmlspark_tpu.vw import murmur3_bytes
+    lib = load_native()
+    if lib is None:
+        pytest.skip("no C++ toolchain available")
+    strings = ["hello", "world", "", "The quick brown fox", "a" * 100]
+    got = murmur3_batch_native(strings, seed=7)
+    expect = [murmur3_bytes(s.encode(), 7) for s in strings]
+    assert got.tolist() == expect
+    csv_text = b"a,b,c\n1,2.5,3\n4,,6\n7,8,bad\n"
+    mat = csv_to_matrix_native(csv_text)
+    assert mat.shape == (3, 3)
+    assert mat[0, 1] == 2.5 and np.isnan(mat[1, 1]) and np.isnan(mat[2, 2])
+    ca = ChunkedArray(initial_cap=4)
+    ca.add([1.0, 2.0, 3.0])
+    ca.add(np.arange(100, dtype=np.float32))
+    assert ca.size == 103
+    out = ca.coalesce()
+    assert out[2] == 3.0 and out[-1] == 99.0
+    ca.close()
+
+
+def test_csv_reader(tmp_path):
+    from mmlspark_tpu.io.csv import read_csv
+    p = tmp_path / "data.csv"
+    p.write_text("x,y,name\n1,2.5,alpha\n3,4.5,beta\n")
+    df = read_csv(str(p))
+    got = df.collect()
+    assert got["x"].tolist() == [1.0, 3.0]
+    assert got["name"][1] == "beta"
+    p2 = tmp_path / "num.csv"
+    p2.write_text("a,b\n1,2\n3,4\n")
+    df2 = read_csv(str(p2), numeric_only=True)
+    assert df2.collect()["b"].tolist() == [2.0, 4.0]
