@@ -1,0 +1,142 @@
+"""Executor runtime — one process per TPU host, mesh formed at startup.
+
+Reference: the Spark driver/executor split.  The driver's three bespoke
+socket channels (SURVEY.md §2.12) reduce to one job here: hand every executor
+the coordinator address and its process index, then ``jax.distributed
+.initialize`` forms the global device view and collectives ride ICI/DCN.
+
+``ExecutorConfig``/``bootstrap_executor`` are what a Spark/k8s launcher calls
+inside each worker; ``run_local_cluster`` spawns real separate processes on
+this host (each with its own virtual CPU devices) to validate the multi-host
+path end-to-end without TPU pods — the analogue of the reference testing its
+rendezvous in local mode (``LightGBMUtils.isLocalExecution``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import pickle
+import socket
+import subprocess
+import sys
+import tempfile
+from typing import Callable, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class ExecutorConfig:
+    coordinator_address: str
+    num_processes: int
+    process_id: int
+    devices_per_process: int = 1
+    mesh_axes: Optional[Dict[str, int]] = None
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def make_cluster_configs(num_processes: int, devices_per_process: int = 1,
+                         host: str = "127.0.0.1",
+                         mesh_axes: Optional[Dict[str, int]] = None) -> List[ExecutorConfig]:
+    """Driver role: allocate the coordinator endpoint and per-executor ids."""
+    addr = f"{host}:{free_port()}"
+    return [ExecutorConfig(addr, num_processes, i, devices_per_process, mesh_axes)
+            for i in range(num_processes)]
+
+
+def bootstrap_executor(cfg: ExecutorConfig):
+    """Worker role: join the cluster and build the global mesh."""
+    import jax
+    jax.distributed.initialize(coordinator_address=cfg.coordinator_address,
+                               num_processes=cfg.num_processes,
+                               process_id=cfg.process_id)
+    from .mesh import make_mesh, set_active_mesh
+    mesh = make_mesh(cfg.mesh_axes)
+    set_active_mesh(mesh)
+    return mesh
+
+
+_WORKER_TEMPLATE = r"""
+import os, pickle, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", {devices_per_process})
+sys.path.insert(0, {repo_root!r})
+from mmlspark_tpu.parallel.executor import ExecutorConfig, bootstrap_executor
+
+with open({cfg_path!r}, "rb") as f:
+    cfg = pickle.load(f)
+mesh = bootstrap_executor(cfg)
+with open({fn_path!r}, "rb") as f:
+    fn = pickle.load(f)
+result = fn(mesh, cfg.process_id)
+with open({out_path!r}, "wb") as f:
+    pickle.dump(result, f)
+"""
+
+
+def run_local_cluster(fn: Callable, num_processes: int = 2,
+                      devices_per_process: int = 2,
+                      mesh_axes: Optional[Dict[str, int]] = None,
+                      timeout_s: float = 300.0) -> List:
+    """Run fn(mesh, process_id) in `num_processes` REAL separate processes
+    forming one global mesh of num_processes*devices_per_process CPU devices.
+    Returns each process's pickled result."""
+    from ..utils import pickling
+
+    configs = make_cluster_configs(num_processes, devices_per_process,
+                                   mesh_axes=mesh_axes)
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    with tempfile.TemporaryDirectory() as d:
+        fn_path = os.path.join(d, "fn.pkl")
+        try:
+            # fn often lives in a driver-side module the workers can't import
+            # (test files, notebooks) — ship it by value
+            import cloudpickle
+            import inspect
+            mod = inspect.getmodule(fn)
+            if mod is not None and not mod.__name__.startswith("mmlspark_tpu"):
+                cloudpickle.register_pickle_by_value(mod)
+        except Exception:  # noqa: BLE001
+            pass
+        with open(fn_path, "wb") as f:
+            pickling.dump(fn, f)
+        procs = []
+        outs = []
+        for cfg in configs:
+            cfg_path = os.path.join(d, f"cfg_{cfg.process_id}.pkl")
+            out_path = os.path.join(d, f"out_{cfg.process_id}.pkl")
+            with open(cfg_path, "wb") as f:
+                pickle.dump(cfg, f)
+            code = _WORKER_TEMPLATE.format(
+                devices_per_process=devices_per_process, repo_root=repo_root,
+                cfg_path=cfg_path, fn_path=fn_path, out_path=out_path)
+            env = dict(os.environ)
+            env.pop("PYTHONPATH", None)  # drop sitecustomize TPU hooks
+            procs.append(subprocess.Popen([sys.executable, "-c", code], env=env,
+                                          stdout=subprocess.PIPE,
+                                          stderr=subprocess.PIPE))
+            outs.append(out_path)
+        results = []
+        errors = []
+        for p, out_path, cfg in zip(procs, outs, configs):
+            try:
+                stdout, stderr = p.communicate(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                errors.append(f"proc {cfg.process_id}: timeout")
+                continue
+            if p.returncode != 0:
+                errors.append(f"proc {cfg.process_id} rc={p.returncode}: "
+                              f"{stderr.decode()[-2000:]}")
+            elif os.path.exists(out_path):
+                with open(out_path, "rb") as f:
+                    results.append(pickle.load(f))
+        if errors:
+            raise RuntimeError("local cluster failed:\n" + "\n".join(errors))
+        return results
