@@ -1,0 +1,15 @@
+"""Shared example setup: run on the real TPU when present, else a CPU mesh."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def setup(force_cpu: bool = False):
+    if force_cpu or os.environ.get("MMLSPARK_TPU_EXAMPLES_CPU"):
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 8)
+    import jax
+    print(f"devices: {jax.devices()}")

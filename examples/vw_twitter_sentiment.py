@@ -1,0 +1,58 @@
+"""VowpalWabbit - Twitter sentiment — sparse hashed text classification.
+
+Equivalent of the reference's Twitter sentiment VW notebook (BASELINE.json
+config 4): text -> VowpalWabbitFeaturizer (murmur hashing, host C++ kernel)
+-> VowpalWabbitClassifier (adaptive/normalized SGD on TPU).
+"""
+import time
+
+import numpy as np
+
+from _common import setup
+
+POSITIVE = ["love", "great", "awesome", "fantastic", "happy", "best", "cool"]
+NEGATIVE = ["hate", "awful", "terrible", "worst", "sad", "angry", "broken"]
+FILLER = ["the", "a", "today", "lol", "just", "really", "so", "this", "that",
+          "phone", "game", "movie", "weather", "traffic"]
+
+
+def make_tweets(n=20000, seed=0):
+    from mmlspark_tpu.core import DataFrame
+    rng = np.random.default_rng(seed)
+    texts = np.empty(n, dtype=object)
+    labels = np.zeros(n)
+    for i in range(n):
+        pos = rng.random() < 0.5
+        words = list(rng.choice(FILLER, rng.integers(4, 10)))
+        pool = POSITIVE if pos else NEGATIVE
+        for _ in range(int(rng.integers(1, 3))):
+            words.insert(int(rng.integers(0, len(words))), str(rng.choice(pool)))
+        texts[i] = " ".join(words)
+        labels[i] = float(pos)
+    return DataFrame.from_dict({"text": texts, "label": labels}, num_partitions=8)
+
+
+def main():
+    setup()
+    from mmlspark_tpu.vw import VowpalWabbitFeaturizer, VowpalWabbitClassifier
+
+    df = make_tweets()
+    feat = VowpalWabbitFeaturizer(input_cols=["text"], output_col="features",
+                                  num_bits=18, string_split_cols=["text"])
+    t0 = time.perf_counter()
+    hashed = feat.transform(df)
+    print(f"hashed {df.count()} tweets in {time.perf_counter() - t0:.2f}s")
+    train, test = hashed.random_split([0.8, 0.2], seed=1)
+    clf = VowpalWabbitClassifier().set_params(num_bits=18, num_passes=3,
+                                              learning_rate=0.5)
+    t0 = time.perf_counter()
+    model = clf.fit(train)
+    print(f"trained in {time.perf_counter() - t0:.2f}s; stats:")
+    print(model.get_performance_statistics().to_pandas().head())
+    out = model.transform(test).collect()
+    acc = float((out["prediction"] == out["label"]).mean())
+    print(f"test accuracy: {acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()
