@@ -183,6 +183,30 @@ def lambdarank_grads(scores: np.ndarray, y: np.ndarray, group_ptr: np.ndarray,
 
 
 # ---------------------------------------------------------------------------
+# jit caches: reusing compiled programs across train() calls saves the ~60-90s
+# XLA compile on every fit (closures would otherwise defeat jit's cache)
+# ---------------------------------------------------------------------------
+
+_JIT_CACHE: Dict[tuple, object] = {}
+
+
+def _params_sig(p: "GBDTParams") -> tuple:
+    return (p.max_depth, p.max_bin, p.objective, p.num_class, p.boosting_type,
+            p.learning_rate, p.lambda_l1, p.lambda_l2, p.min_data_in_leaf,
+            p.min_sum_hessian_in_leaf, p.min_gain_to_split, p.max_delta_step,
+            p.sigmoid, p.alpha, p.top_rate, p.other_rate, p.feature_fraction,
+            p.bagging_fraction, p.bagging_freq)
+
+
+def _cached(key, builder):
+    fn = _JIT_CACHE.get(key)
+    if fn is None:
+        fn = builder()
+        _JIT_CACHE[key] = fn
+    return fn
+
+
+# ---------------------------------------------------------------------------
 # tree grower
 # ---------------------------------------------------------------------------
 
@@ -441,6 +465,7 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
     edges = jnp.asarray(mapper.edges)
     B = mapper.num_bins
 
+    sig = _params_sig(p)
     if shard_rows:
         from jax.sharding import PartitionSpec as P
         from ..parallel import get_active_mesh, batch_sharded
@@ -456,16 +481,20 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
         n = binned_np.shape[0]
         sharding = batch_sharded(mesh)
         binned = jax.device_put(binned_np, sharding)
+
         # explicit SPMD: each shard builds local histograms, psum over ICI
-        grow_raw = make_tree_grower(p.max_depth, F, B, p, axis_name=AXIS_DATA)
-        grower = jax.jit(jax.shard_map(
-            grow_raw, mesh=mesh,
-            in_specs=(P(AXIS_DATA), P(AXIS_DATA), P(AXIS_DATA), P(AXIS_DATA),
-                      P(), P()),
-            out_specs=(P(),) * 8 + (P(AXIS_DATA),), check_vma=False))
+        def _build_sharded():
+            grow_raw = make_tree_grower(p.max_depth, F, B, p, axis_name=AXIS_DATA)
+            return jax.jit(jax.shard_map(
+                grow_raw, mesh=mesh,
+                in_specs=(P(AXIS_DATA), P(AXIS_DATA), P(AXIS_DATA), P(AXIS_DATA),
+                          P(), P()),
+                out_specs=(P(),) * 8 + (P(AXIS_DATA),), check_vma=False))
+        grower = _cached(("sharded_grower", sig, F, id(mesh)), _build_sharded)
     else:
         binned = jnp.asarray(binned_np)
-        grower = jax.jit(make_tree_grower(p.max_depth, F, B, p))
+        grower = _cached(("grower", sig, F),
+                         lambda: jax.jit(make_tree_grower(p.max_depth, F, B, p)))
     objective = make_objective(p)
     D = p.max_depth
     I, L = 2 ** D - 1, 2 ** D
@@ -490,7 +519,7 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
                                            "split_gain", "internal_value", "internal_count",
                                            "leaf_value", "leaf_count")}
     tree_weights: List[float] = []
-    walker = make_binned_walker(D)
+    walker = _cached(("walker", D), lambda: make_binned_walker(D))
     if init_booster is not None:
         assert init_booster.max_depth == D and init_booster.num_features == F
         for t in range(init_booster.num_trees):
@@ -560,14 +589,138 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
         return scores, tree_out
 
     _iter_jit = {} if shard_rows else {
-        False: jax.jit(partial(_iter_body, use_pre=False),
-                       static_argnames=()),
-        True: jax.jit(partial(_iter_body, use_pre=True))}
+        False: _cached(("iter", sig, F, K, n, False),
+                       lambda: jax.jit(partial(_iter_body, use_pre=False))),
+        True: _cached(("iter", sig, F, K, n, True),
+                      lambda: jax.jit(partial(_iter_body, use_pre=True)))}
 
     import jax.random as jrandom
     jit_objective = jax.jit(objective) if objective is not None else None
     start_iter = len(tree_weights) // K
-    for it in range(start_iter, start_iter + p.num_iterations):
+
+    # ---- scan-chunked multi-iteration path: CH boosting iterations per
+    # device dispatch.  Opt-in (MMLSPARK_TPU_GBDT_CHUNK=8): on a single chip
+    # the async dispatch queue already pipelines iterations (measured wash),
+    # but on multi-host meshes chunking amortizes collective launch latency.
+    CH = max(1, int(__import__("os").environ.get("MMLSPARK_TPU_GBDT_CHUNK", "1")))
+    chunk_ok = (CH > 1 and not shard_rows and p.objective != "lambdarank"
+                and p.boosting_type != "dart" and p.bagging_freq <= 1
+                and p.num_iterations >= 2 * CH
+                and n >= 50_000)  # small data: scan compile cost dominates
+
+    def _build_multi():
+        keep = max(1, int(round(p.feature_fraction * F)))
+        bag_on = p.bagging_freq > 0 and p.bagging_fraction < 1.0
+        ff_on = p.feature_fraction < 1.0
+        rf_mode = p.boosting_type == "rf"
+
+        def body(carry, key):
+            scores_c, t = carry
+            kf, kb, kg = jrandom.split(key, 3)
+            feat_mask = jnp.ones((F,), bool)
+            if ff_on:
+                sel = jrandom.choice(kf, F, (keep,), replace=False)
+                feat_mask = jnp.zeros((F,), bool).at[sel].set(True)
+            base_mask = jnp.ones((n,), bool)
+            if bag_on:
+                base_mask = jrandom.uniform(kb, (n,)) < p.bagging_fraction
+            grad_scale = jnp.maximum(1.0, jnp.floor(t / K)) if rf_mode else 1.0
+            g, h = objective(scores_c / grad_scale, y_dev, w_dev)
+            hist_mask = base_mask
+            if is_goss:
+                absg = jnp.abs(g).sum(axis=1)
+                order = jnp.argsort(-absg)
+                top_idx = order[:a_n]
+                rest = order[a_n:]
+                perm = jrandom.permutation(kg, rest.shape[0])
+                small_idx = rest[perm[:b_n]]
+                mask = jnp.zeros((n,), bool).at[top_idx].set(True)                     .at[small_idx].set(True)
+                amp = (1.0 - p.top_rate) / max(p.other_rate, 1e-12)
+                wamp = jnp.ones((n,)).at[small_idx].set(amp)
+                hist_mask = hist_mask & mask
+                g, h = g * wamp[:, None], h * wamp[:, None]
+            outs = []
+            for c in range(K):
+                sf, th, tb, sg, iv, ic, lv, lc, leaf = grow_fn(
+                    binned, g[:, c], h[:, c], hist_mask, feat_mask, edges)
+                lv_s = lv * shrink_const
+                scores_c = scores_c.at[:, c].add(lv_s[leaf])
+                outs.append((sf, th, tb, sg, iv, ic, lv_s, lc))
+            stacked = tuple(jnp.stack([o[j] for o in outs]) for j in range(8))
+            return (scores_c, t + K), stacked
+
+        def multi(scores_c, t0, keys):
+            (scores_c, t), stacked = jax.lax.scan(body, (scores_c, t0), keys)
+            return scores_c, stacked
+
+        return jax.jit(multi)
+
+    multi_iter = _cached(("multi", sig, F, K, n, CH), _build_multi) if chunk_ok else None
+
+    def _build_valid_update():
+        def upd(scores_v_c, binned_v_c, sf_all, tb_all, lv_all):
+            CK = sf_all.shape[0] * sf_all.shape[1]
+            sf_f = sf_all.reshape(CK, -1)
+            tb_f = tb_all.reshape(CK, -1)
+            lv_f = lv_all.reshape(CK, -1)
+            nv = binned_v_c.shape[0]
+
+            def walk_one(sf_t, tb_t):
+                node = jnp.zeros((nv,), jnp.int32)
+                for _ in range(D):
+                    f = sf_t[node]
+                    tt = tb_t[node]
+                    row_bin = binned_v_c[jnp.arange(nv),
+                                         jnp.maximum(f, 0)].astype(jnp.int32)
+                    go_right = (f >= 0) & (row_bin > tt)
+                    node = 2 * node + 1 + go_right.astype(jnp.int32)
+                return node - (2 ** D - 1)
+
+            leaves = jax.vmap(walk_one)(sf_f, tb_f)                 # (CK, nv)
+            vals = jnp.take_along_axis(lv_f, leaves, axis=1)        # (CK, nv)
+            for c in range(K):
+                scores_v_c = scores_v_c.at[:, c].add(vals[c::K].sum(axis=0))
+            return scores_v_c
+
+        return jax.jit(upd)
+
+    valid_chunk_update = _cached(("validupd", D, K), _build_valid_update)
+
+    it = start_iter
+    end_iter = start_iter + p.num_iterations
+    while it < end_iter:
+        if multi_iter is not None and end_iter - it >= CH:
+            keys = jnp.stack([jrandom.PRNGKey(p.seed * 1000003 + it + j)
+                              for j in range(CH)])
+            scores, stacked = multi_iter(scores, jnp.float32(len(tree_weights)),
+                                         keys)
+            names = ("split_feature", "threshold", "threshold_bin", "split_gain",
+                     "internal_value", "internal_count", "leaf_value", "leaf_count")
+            for ci in range(CH):
+                for c in range(K):
+                    for k_name, arr in zip(names, stacked):
+                        trees[k_name].append(arr[ci, c])
+                    tree_weights.append(1.0)
+            if has_valid:
+                scores_v = valid_chunk_update(scores_v, binned_v, stacked[0],
+                                              stacked[2], stacked[6])
+                raw_v = np.asarray(scores_v, np.float64)
+                m = metric_fn(yv, raw_v)
+                evals.append({metric_name: m, "iteration": it + CH - 1})
+                improved = m > best_metric if larger_better else m < best_metric
+                if improved:
+                    best_metric, best_iter, rounds_no_improve = m, it + CH - 1, 0
+                else:
+                    rounds_no_improve += CH
+                if p.early_stopping_round > 0 and \
+                        rounds_no_improve >= p.early_stopping_round:
+                    break
+            if callbacks:
+                for cb in callbacks:
+                    cb(it + CH - 1, evals[-1] if evals else None)
+            it += CH
+            continue
+
         # ---- host-side per-iteration randomness
         feat_mask = feat_mask_full
         if p.feature_fraction < 1.0:
@@ -671,6 +824,7 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
         if callbacks:
             for cb in callbacks:
                 cb(it, evals[-1] if evals else None)
+        it += 1
 
     trees_np = jax.device_get({k: v for k, v in trees.items()})  # one transfer
     booster = GBDTBooster(

@@ -179,3 +179,26 @@ def test_histogram_backends_agree():
     assert float(jnp.max(jnp.abs(a - m))) < 1e-3
     # count channel must be exactly integral
     assert float(jnp.max(jnp.abs(m[..., 2] - jnp.round(m[..., 2])))) == 0.0
+
+
+def test_chunked_training_matches_unchunked(monkeypatch):
+    """The scan-chunked path must produce the same boosting trajectory shape
+    and comparable accuracy as per-iteration dispatch."""
+    import importlib
+    monkeypatch.setenv("MMLSPARK_TPU_GBDT_CHUNK", "4")
+    from mmlspark_tpu.lightgbm import core as gbdt_core
+    X, y = make_classification(600, 8, seed=9)
+    p = gbdt_core.GBDTParams(num_iterations=12, objective="binary",
+                             max_depth=4, min_data_in_leaf=5, seed=3)
+    # force-eligible despite small n by lowering the gate via monkeypatch of n
+    # threshold is internal; instead test the multi-iter machinery on a
+    # synthetic large-enough frame
+    Xl = np.tile(X, (100, 1))
+    yl = np.tile(y, 100)
+    res_chunked = gbdt_core.train(Xl, yl, p)
+    monkeypatch.setenv("MMLSPARK_TPU_GBDT_CHUNK", "1")
+    res_plain = gbdt_core.train(Xl, yl, p)
+    assert res_chunked.booster.num_trees == res_plain.booster.num_trees == 12
+    acc_c = ((res_chunked.booster.predict(X) > 0.5) == y).mean()
+    acc_p = ((res_plain.booster.predict(X) > 0.5) == y).mean()
+    assert acc_c > 0.9 and acc_p > 0.9, (acc_c, acc_p)
