@@ -16,13 +16,7 @@ from mmlspark_tpu.testing import (TestObject, ExperimentFuzzing,
 
 # stages whose construction/serialization needs runtime payloads the sweep
 # can't synthesize (reference keeps the same kind of exemption list)
-SERIALIZATION_EXEMPT = {
-    "Lambda", "UDFTransformer", "Timer",  # function payloads set at use site
-    "JaxModel", "ImageFeaturizer",        # model payloads set at use site
-    "Pipeline", "PipelineModel",          # stage-list payloads
-    "TuneHyperparameters", "FindBestModel", "RankingAdapter",
-    "RankingTrainValidationSplit", "TrainClassifier", "TrainRegressor",
-}
+SERIALIZATION_EXEMPT = set()  # every stage roundtrips, payloads included
 
 
 def test_registry_finds_the_framework():
@@ -62,7 +56,7 @@ def test_default_stage_serialization_roundtrip():
             assert re.uid == stage.uid
             assert re.has_same_params(stage), cls
         checked += 1
-    assert checked >= 60, f"only {checked} stages roundtripped"
+    assert checked >= 85, f"only {checked} stages roundtripped"
 
 
 def _vec_frame(n=60, d=5, seed=0, label=True):
