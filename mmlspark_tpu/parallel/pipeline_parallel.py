@@ -1,0 +1,106 @@
+"""Pipeline parallelism — GPipe-style microbatch pipelining over the ``pipe`` axis.
+
+No counterpart exists in the reference (SURVEY.md §2.11: model parallelism is
+absent); this completes the framework's parallelism surface alongside dp/tp
+(`trainer.py`) and sp (`seq_parallel.py`).
+
+Design: stage s of a depth-S sequential model lives on pipe-rank s (its
+params are the s-th slice of a leading-axis-stacked pytree sharded over
+``pipe``).  A `lax.scan` over M + S - 1 ticks rotates activations rightward
+with ``ppermute`` each tick while stage 0 injects microbatches — the classic
+GPipe schedule including its bubble.  The whole schedule is differentiable
+(scan + ppermute transpose), so one `value_and_grad` yields per-stage
+gradients that stay local to each device.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Tuple
+
+import numpy as np
+
+from .mesh import AXIS_PIPE, get_active_mesh
+
+
+def make_pipeline_train_step(stage_apply: Callable, num_stages: int,
+                             loss_fn: Callable, learning_rate: float = 1e-2,
+                             mesh=None):
+    """Build (init_fn, step_fn, forward_fn) for a pipelined sequential model.
+
+    stage_apply(stage_params, x) -> x' : one stage's computation; every stage
+    must preserve the activation shape (uniform-width pipeline).
+    loss_fn(outputs (M, mb, d), y (M, mb, ...)) -> scalar, evaluated on the
+    final stage's collected outputs.
+    Params are a pytree whose leaves have leading dim ``num_stages``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = mesh or get_active_mesh()
+    S = num_stages
+    if mesh.shape[AXIS_PIPE] != S:
+        raise ValueError(f"mesh pipe axis {mesh.shape[AXIS_PIPE]} != stages {S}")
+
+    def local_forward(params_stage, x_mb):
+        """Runs inside shard_map; params_stage leaves have leading dim 1."""
+        params_local = jax.tree.map(lambda a: a[0], params_stage)
+        idx = jax.lax.axis_index(AXIS_PIPE)
+        M = x_mb.shape[0]
+        T = M + S - 1
+        perm = [(i, i + 1) for i in range(S - 1)]       # rightward shift
+        act0 = jnp.zeros_like(x_mb[0])
+
+        def tick(act, t):
+            act_in = jax.lax.ppermute(act, AXIS_PIPE, perm)
+            mb = x_mb[jnp.clip(t, 0, M - 1)]
+            act_in = jnp.where(idx == 0, mb, act_in)
+            act_out = stage_apply(params_local, act_in)
+            return act_out, act_out
+
+        _, outs = jax.lax.scan(tick, act0, jnp.arange(T))
+        # on the last stage, outs[m + S - 1] is microbatch m's result
+        return outs[S - 1:]                              # (M, mb, d)
+
+    def local_collect(params_stage, x_mb):
+        """Replicated final outputs (mask + psum selects the last stage)."""
+        outs = local_forward(params_stage, x_mb)
+        idx = jax.lax.axis_index(AXIS_PIPE)
+        return jax.lax.psum(jnp.where(idx == S - 1, outs, 0.0), AXIS_PIPE)
+
+    def local_loss(params_stage, x_mb, y_mb):
+        outs = local_forward(params_stage, x_mb)
+        idx = jax.lax.axis_index(AXIS_PIPE)
+        l_local = loss_fn(outs, y_mb)
+        # only the last stage's outputs are meaningful
+        return jax.lax.psum(jnp.where(idx == S - 1, l_local, 0.0), AXIS_PIPE)
+
+    def local_step(params_stage, x_mb, y_mb):
+        loss, grads = jax.value_and_grad(local_loss)(params_stage, x_mb, y_mb)
+        new_params = jax.tree.map(lambda w, g: w - learning_rate * g,
+                                  params_stage, grads)
+        return new_params, loss
+
+    p_spec = P(AXIS_PIPE)
+    rep = P()
+    step_fn = jax.jit(jax.shard_map(
+        local_step, mesh=mesh, in_specs=(p_spec, rep, rep),
+        out_specs=(p_spec, rep), check_vma=False))
+    forward_fn = jax.jit(jax.shard_map(
+        local_collect, mesh=mesh, in_specs=(p_spec, rep),
+        out_specs=rep, check_vma=False))
+
+    def init_fn(params_stacked):
+        sh = NamedSharding(mesh, p_spec)
+        return jax.tree.map(
+            lambda a: jax.device_put(np.asarray(a), sh), params_stacked)
+
+    return init_fn, step_fn, forward_fn
+
+
+def microbatch(x: np.ndarray, num_microbatches: int) -> np.ndarray:
+    """(batch, ...) -> (M, batch/M, ...)."""
+    n = x.shape[0]
+    if n % num_microbatches:
+        raise ValueError(f"batch {n} not divisible by {num_microbatches} microbatches")
+    return x.reshape(num_microbatches, n // num_microbatches, *x.shape[1:])

@@ -1,0 +1,108 @@
+"""Pipeline (pp) and expert (ep) parallelism tests."""
+import numpy as np
+import pytest
+
+
+def test_pipeline_forward_matches_sequential():
+    import jax
+    import jax.numpy as jnp
+    from mmlspark_tpu.parallel import make_mesh, active_mesh
+    from mmlspark_tpu.parallel.pipeline_parallel import (
+        make_pipeline_train_step, microbatch)
+
+    rng = np.random.default_rng(0)
+    S, d = 4, 8
+    W = rng.normal(size=(S, d, d)).astype(np.float32) * 0.5
+    b = rng.normal(size=(S, d)).astype(np.float32) * 0.1
+    params = {"W": W, "b": b}
+
+    def stage_apply(p, x):
+        return jnp.tanh(x @ p["W"] + p["b"])
+
+    def loss_fn(outs, y):
+        return jnp.mean((outs - y) ** 2)
+
+    mesh = make_mesh({"pipe": 4, "data": 2})
+    with active_mesh(mesh):
+        init_fn, step_fn, fwd_fn = make_pipeline_train_step(
+            stage_apply, S, loss_fn, learning_rate=0.05, mesh=mesh)
+        p_dev = init_fn(params)
+        x = rng.normal(size=(8, 4, d)).astype(np.float32)  # 8 microbatches of 4
+        out = np.asarray(fwd_fn(p_dev, x))
+    # sequential reference
+    ref = x.reshape(-1, d)
+    for s in range(S):
+        ref = np.tanh(ref @ W[s] + b[s])
+    ref = ref.reshape(8, 4, d)
+    assert np.allclose(out, ref, atol=1e-4), np.abs(out - ref).max()
+
+
+def test_pipeline_train_step_learns():
+    import jax.numpy as jnp
+    from mmlspark_tpu.parallel import make_mesh, active_mesh
+    from mmlspark_tpu.parallel.pipeline_parallel import make_pipeline_train_step
+
+    rng = np.random.default_rng(1)
+    S, d = 4, 6
+    params = {"W": rng.normal(size=(S, d, d)).astype(np.float32) * 0.3,
+              "b": np.zeros((S, d), np.float32)}
+
+    def stage_apply(p, x):
+        return jnp.tanh(x @ p["W"] + p["b"])
+
+    x = rng.normal(size=(4, 8, d)).astype(np.float32)
+    y = np.tanh(x @ rng.normal(size=(d, d)).astype(np.float32) * 0.5)
+
+    def loss_fn(outs, yy):
+        return jnp.mean((outs - yy) ** 2)
+
+    mesh = make_mesh({"pipe": 4, "data": 2})
+    with active_mesh(mesh):
+        init_fn, step_fn, _ = make_pipeline_train_step(
+            stage_apply, S, loss_fn, learning_rate=0.2, mesh=mesh)
+        p_dev = init_fn(params)
+        losses = []
+        for _ in range(25):
+            p_dev, loss = step_fn(p_dev, x, y)
+            losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.6, losses[::8]
+
+
+def test_moe_expert_parallel_learns():
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from mmlspark_tpu.parallel import make_mesh, active_mesh
+    from mmlspark_tpu.parallel.moe import MoELayer, shard_moe_params
+
+    rng = np.random.default_rng(2)
+    T, d, E = 64, 8, 4
+    x = rng.normal(size=(T, d)).astype(np.float32)
+    y = np.where(x[:, :1] > 0, x * 2.0, -x).astype(np.float32)  # piecewise fn
+
+    module = MoELayer(num_experts=E, hidden=16)
+    variables = module.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    mesh = make_mesh({"data": 4, "expert": 2})
+    with active_mesh(mesh):
+        params = shard_moe_params(variables["params"], mesh)
+        # expert-stacked FFN weights actually sharded over the expert axis
+        assert "expert" in str(params["w_in"].sharding.spec)
+        tx = optax.adam(1e-2)
+        opt = tx.init(params)
+
+        @jax.jit
+        def step(params, opt, x, y):
+            def loss_fn(p):
+                out, aux = module.apply({"params": p}, x,
+                                        mutable=["losses"])
+                mse = jnp.mean((out - y) ** 2)
+                return mse + sum(jax.tree.leaves(aux["losses"]))
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt = tx.update(grads, opt)
+            return optax.apply_updates(params, updates), opt, loss
+
+        losses = []
+        for _ in range(60):
+            params, opt, loss = step(params, opt, jnp.asarray(x), jnp.asarray(y))
+            losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
