@@ -158,11 +158,15 @@ class GBDTBooster(Saveable):
             return e / e.sum(axis=1, keepdims=True)
         return raw[:, 0]
 
-    def predict_contrib(self, X: np.ndarray) -> np.ndarray:
+    def predict_contrib(self, X: np.ndarray, method: str = "tree_shap") -> np.ndarray:
         """Per-feature contributions (n, F+1), last col = expected value.
-        Saabas path attribution (sum over path of value deltas); the
-        reference's ``featuresShap:414`` uses exact TreeSHAP — noted
-        difference, same additivity property (rows sum to raw score)."""
+
+        method="tree_shap": exact path-dependent TreeSHAP (reference
+        ``featuresShap:414`` parity).  method="saabas": fast path-delta
+        attribution; both are additive (rows sum to the raw score).
+        """
+        if method == "tree_shap":
+            return tree_shap(self, X)
         X = np.asarray(X, np.float32)
         n, F = X.shape
         D, I = self.max_depth, self.split_feature.shape[1]
@@ -262,3 +266,126 @@ class GBDTBooster(Saveable):
         with np.load(os.path.join(path, "trees.npz")) as z:
             arrays = {k: z[k] for k in cls._ARRAYS}
         return cls(**arrays, **meta)
+
+
+# ---------------------------------------------------------------------------
+# Path-dependent TreeSHAP (Lundberg Algorithm 2) over perfect-depth trees
+# ---------------------------------------------------------------------------
+
+class _ShapPath:
+    __slots__ = ("d", "z", "o", "w")
+
+    def __init__(self, d, z, o, w):
+        self.d, self.z, self.o, self.w = d, z, o, w
+
+
+def _extend(path, pz, po, pi):
+    # value-copy the elements: the recursion branches share parent paths
+    path = [_ShapPath(p.d, p.z, p.o, p.w) for p in path] + \
+        [_ShapPath(pi, pz, po, 1.0 if len(path) == 0 else 0.0)]
+    l = len(path) - 1
+    for i in range(l - 1, -1, -1):
+        path[i + 1].w += po * path[i].w * (i + 1) / (l + 1)
+        path[i].w = pz * path[i].w * (l - i) / (l + 1)
+    return path
+
+
+def _unwind(path, i):
+    l = len(path) - 1
+    path = [(_ShapPath(p.d, p.z, p.o, p.w)) for p in path]
+    o, z = path[i].o, path[i].z
+    nxt = path[l].w
+    for j in range(l - 1, -1, -1):
+        if o != 0:
+            tmp = path[j].w
+            path[j].w = nxt * (l + 1) / ((j + 1) * o)
+            nxt = tmp - path[j].w * z * (l - j) / (l + 1)
+        else:
+            path[j].w = path[j].w * (l + 1) / (z * (l - j))
+    for j in range(i, l):
+        path[j].d, path[j].z, path[j].o = path[j + 1].d, path[j + 1].z, path[j + 1].o
+    path.pop()
+    return path
+
+
+def _unwound_sum(path, i):
+    l = len(path) - 1
+    o, z = path[i].o, path[i].z
+    total = 0.0
+    if o != 0:
+        nxt = path[l].w
+        for j in range(l - 1, -1, -1):
+            tmp = nxt / ((j + 1) * o)
+            total += tmp
+            nxt = path[j].w - tmp * z * (l - j)
+    else:
+        for j in range(l - 1, -1, -1):
+            total += path[j].w / (z * (l - j))
+    return total * (l + 1)
+
+
+def _tree_shap_one(x, phi, t, booster: "GBDTBooster"):
+    """Accumulate SHAP values of tree t for instance x into phi (F+1,)."""
+    D = booster.max_depth
+    I = 2 ** D - 1
+    sf = booster.split_feature[t]
+    th = booster.threshold[t]
+    iv = booster.internal_value[t]
+    ic = booster.internal_count[t]
+    lv = booster.leaf_value[t]
+    lc = booster.leaf_count[t]
+    w = float(booster.tree_weight[t])
+
+    def cover(j):
+        return float(ic[j]) if j < I else float(lc[j - I])
+
+    def value(j):
+        return float(lv[j - I])  # only leaves are valued in the recursion
+
+    total_cover = max(float(lc.sum()), 1e-12)
+    phi[-1] += w * float((lv * lc).sum()) / total_cover  # E[f] under covers
+
+    def recurse(j, path, pz, po, pi):
+        path = _extend(path, pz, po, pi)
+        if j >= I:  # leaf
+            for i in range(1, len(path)):
+                phi[path[i].d] += w * _unwound_sum(path, i) * \
+                    (path[i].o - path[i].z) * value(j)
+            return
+        f = int(sf[j])
+        left, right = 2 * j + 1, 2 * j + 2
+        if f < 0:
+            # pass-through node: everything goes left
+            recurse(left, path, 1.0, 1.0, -2)
+            return
+        xv = x[f]
+        goes_left = not (xv > th[j])        # NaN compares False -> left
+        hot, cold = (left, right) if goes_left else (right, left)
+        rj = max(cover(j), 1e-12)
+        hz, cz = cover(hot) / rj, cover(cold) / rj
+        iz, io = 1.0, 1.0
+        # undo previous occurrence of this feature on the path
+        for k in range(1, len(path)):
+            if path[k].d == f:
+                iz, io = path[k].z, path[k].o
+                path = _unwind(path, k)
+                break
+        recurse(hot, path, iz * hz, io, f)
+        recurse(cold, path, iz * cz, 0.0, f)
+
+    recurse(0, [], 1.0, 1.0, -1)
+
+
+def tree_shap(booster: "GBDTBooster", X: np.ndarray) -> np.ndarray:
+    """(n, F+1) exact path-dependent SHAP values (last col = expected value).
+    Reference parity: ``featuresShap`` (LightGBMBooster.scala:414)."""
+    X = np.asarray(X, np.float64)
+    n, F = X.shape
+    if booster.num_class > 1 and booster.objective == "multiclass":
+        raise ValueError("slice trees per class for multiclass SHAP")
+    out = np.zeros((n, F + 1), np.float64)
+    out[:, F] += booster.init_score
+    for i in range(n):
+        for t in range(booster.num_trees):
+            _tree_shap_one(X[i], out[i], t, booster)
+    return out

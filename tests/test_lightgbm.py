@@ -202,3 +202,60 @@ def test_chunked_training_matches_unchunked(monkeypatch):
     acc_c = ((res_chunked.booster.predict(X) > 0.5) == y).mean()
     acc_p = ((res_plain.booster.predict(X) > 0.5) == y).mean()
     assert acc_c > 0.9 and acc_p > 0.9, (acc_c, acc_p)
+
+
+def test_tree_shap_exact_vs_bruteforce():
+    """Path-dependent TreeSHAP must match brute-force Shapley values computed
+    from the tree's conditional expectations over all feature subsets."""
+    from itertools import combinations
+    from math import factorial
+    from mmlspark_tpu.lightgbm import LightGBMRegressor
+    from mmlspark_tpu.models.gbdt import tree_shap
+
+    rng = np.random.default_rng(11)
+    F = 4
+    X = rng.normal(size=(200, F))
+    y = 2 * X[:, 0] - 1.5 * X[:, 1] + X[:, 0] * X[:, 2]
+    model = LightGBMRegressor().set_params(num_iterations=3, max_depth=3,
+                                           min_data_in_leaf=2).fit(frame_of(X, y))
+    b = model.booster
+    I = 2 ** b.max_depth - 1
+
+    def cond_exp(t, x, S):
+        """Path-dependent expectation following x on S, covers elsewhere."""
+        def rec(j):
+            if j >= I:
+                return float(b.leaf_value[t, j - I])
+            f = int(b.split_feature[t, j])
+            l, r = 2 * j + 1, 2 * j + 2
+            if f < 0:
+                return rec(l)
+            if f in S:
+                return rec(l) if not (x[f] > b.threshold[t, j]) else rec(r)
+            def cov(k):
+                return float(b.internal_count[t, k]) if k < I else \
+                    float(b.leaf_count[t, k - I])
+            cl, cr = cov(l), cov(r)
+            tot = max(cl + cr, 1e-12)
+            return (cl * rec(l) + cr * rec(r)) / tot
+        return rec(0)
+
+    x = X[0]
+    # brute-force Shapley per tree, summed
+    phi_brute = np.zeros(F + 1)
+    for t in range(b.num_trees):
+        for i in range(F):
+            others = [f for f in range(F) if f != i]
+            for k in range(F):
+                for S in combinations(others, k):
+                    wgt = factorial(len(S)) * factorial(F - len(S) - 1) / factorial(F)
+                    phi_brute[i] += wgt * (cond_exp(t, x, set(S) | {i}) -
+                                           cond_exp(t, x, set(S)))
+        phi_brute[F] += cond_exp(t, x, set())
+    phi_brute[F] += b.init_score
+
+    phi = tree_shap(b, x[None, :])[0]
+    assert np.allclose(phi, phi_brute, atol=1e-4), np.abs(phi - phi_brute).max()
+    # additivity: contributions sum to the raw score
+    raw = b.raw_scores(x[None, :])[0, 0]
+    assert abs(phi.sum() - raw) < 1e-4
