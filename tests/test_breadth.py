@@ -147,3 +147,61 @@ def test_image_transformer_chain():
     assert v[0].shape == (48,)
     aug = ImageSetAugmenter().set_params(input_col="image", output_col="aug")
     assert aug.transform(df).count() == 6  # original + LR flip
+
+
+def test_http_parsers(mesh8):
+    from mmlspark_tpu.io.parsers import JSONInputParser, JSONOutputParser
+    from mmlspark_tpu.io.http import HTTPResponseData
+    import dataclasses
+    df = DataFrame.from_dict({"data": np.array([{"q": 1}], dtype=object)})
+    req = JSONInputParser().set_params(input_col="data", output_col="req",
+                                       url="http://x/api").transform(df)
+    r = req.collect()["req"][0]
+    assert r.method == "POST" and b'"q": 1' in r.entity
+    resp_col = np.empty(1, dtype=object)
+    resp_col[0] = dataclasses.asdict(HTTPResponseData(200, entity=b'{"a": 2}'))
+    df2 = DataFrame.from_dict({"resp": resp_col})
+    out = JSONOutputParser().set_params(input_col="resp", output_col="parsed") \
+        .transform(df2).collect()["parsed"][0]
+    assert out == {"a": 2}
+
+
+def test_modifiers_and_checkpoint(tmp_path):
+    from mmlspark_tpu.testing.modifiers import try_with_retries, flaky
+    calls = {"n": 0}
+
+    @flaky(retries=3)
+    def sometimes():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise AssertionError("flaky")
+        return "ok"
+
+    assert sometimes() == "ok" and calls["n"] == 3
+
+    # trainer checkpoint roundtrip
+    import jax
+    import flax.linen as nn
+    import optax
+    from mmlspark_tpu.parallel import data_parallel_mesh, active_mesh
+    from mmlspark_tpu.parallel.trainer import Trainer, softmax_cross_entropy
+    from mmlspark_tpu.parallel.checkpoint import save_train_state, load_train_state
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4)(x)
+
+    mesh = data_parallel_mesh()
+    with active_mesh(mesh):
+        tr = Trainer(M(), optax.adam(1e-2), softmax_cross_entropy, mesh=mesh)
+        batch = {"x": np.ones((8, 3), np.float32),
+                 "y": np.zeros(8, np.int32)}
+        st = tr.init_state(jax.random.PRNGKey(0), batch)
+        st, _ = tr.train_step(st, batch)
+        p = str(tmp_path / "ckpt")
+        save_train_state(st, p)
+        st2 = load_train_state(p, trainer=tr)
+        assert int(st2.step) == 1
+        st3, loss = tr.train_step(st2, batch)  # resume training works
+        assert np.isfinite(float(loss)) and int(st3.step) == 2
