@@ -1,6 +1,7 @@
 from .jax_model import JaxModel, FlaxModelPayload
 from .image_featurizer import ImageFeaturizer
 from .model_downloader import ModelDownloader, ModelRepo, ModelSchema
+from .torch_import import torch_to_jax, torch_to_jax_model
 
 __all__ = ["JaxModel", "FlaxModelPayload", "ImageFeaturizer", "ModelDownloader",
-           "ModelRepo", "ModelSchema"]
+           "ModelRepo", "ModelSchema", "torch_to_jax", "torch_to_jax_model"]

@@ -1,0 +1,146 @@
+"""Torch model import — the ONNX->StableHLO bridge for offline environments.
+
+Reference capability: CNTKModel loads externally-trained graphs (CNTK
+protobuf; SURVEY.md §7 step 2 plans ONNX->StableHLO import).  No ONNX
+runtime ships in this image, but torch (CPU) does — this module converts
+common torch modules into pure JAX apply functions by extracting weights,
+so pretrained torch checkpoints can run under ``JaxModel`` on TPU.
+
+Supported layers: Linear, Conv2d (NCHW->NHWC translated), BatchNorm2d (eval),
+ReLU/GELU/Tanh/Sigmoid, MaxPool2d, AvgPool2d, AdaptiveAvgPool2d(1), Flatten,
+Dropout (identity), Sequential nesting.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple
+
+import numpy as np
+
+
+def _conv_params(mod) -> Dict[str, np.ndarray]:
+    w = mod.weight.detach().numpy()            # (O, I, kH, kW)
+    out = {"kernel": np.transpose(w, (2, 3, 1, 0))}  # HWIO
+    if mod.bias is not None:
+        out["bias"] = mod.bias.detach().numpy()
+    return out
+
+
+def torch_to_jax(model) -> Tuple[Callable, Dict[str, Any]]:
+    """Returns (apply_fn(variables, x), variables).  Input x is NHWC for
+    convolutional models, (n, features) for MLPs."""
+    import torch
+    import torch.nn as tnn
+
+    model = model.eval()
+    layers: List[Tuple[str, Dict[str, np.ndarray], Dict[str, Any]]] = []
+
+    def walk(m):
+        for child in m.children():
+            if isinstance(child, tnn.Sequential):
+                walk(child)
+            elif isinstance(child, tnn.Linear):
+                layers.append(("linear",
+                               {"kernel": child.weight.detach().numpy().T,
+                                "bias": None if child.bias is None else
+                                child.bias.detach().numpy()}, {}))
+            elif isinstance(child, tnn.Conv2d):
+                layers.append(("conv", _conv_params(child),
+                               {"stride": child.stride,
+                                "padding": child.padding}))
+            elif isinstance(child, tnn.BatchNorm2d):
+                layers.append(("batchnorm",
+                               {"scale": child.weight.detach().numpy(),
+                                "bias": child.bias.detach().numpy(),
+                                "mean": child.running_mean.detach().numpy(),
+                                "var": child.running_var.detach().numpy()},
+                               {"eps": child.eps}))
+            elif isinstance(child, tnn.ReLU):
+                layers.append(("relu", {}, {}))
+            elif isinstance(child, tnn.GELU):
+                layers.append(("gelu", {}, {}))
+            elif isinstance(child, tnn.Tanh):
+                layers.append(("tanh", {}, {}))
+            elif isinstance(child, tnn.Sigmoid):
+                layers.append(("sigmoid", {}, {}))
+            elif isinstance(child, tnn.MaxPool2d):
+                layers.append(("maxpool", {}, {"k": child.kernel_size,
+                                               "s": child.stride}))
+            elif isinstance(child, tnn.AvgPool2d):
+                layers.append(("avgpool", {}, {"k": child.kernel_size,
+                                               "s": child.stride}))
+            elif isinstance(child, tnn.AdaptiveAvgPool2d):
+                layers.append(("gap", {}, {}))
+            elif isinstance(child, (tnn.Flatten,)):
+                layers.append(("flatten", {}, {}))
+            elif isinstance(child, (tnn.Dropout, tnn.Identity)):
+                pass
+            else:
+                raise NotImplementedError(
+                    f"torch layer {type(child).__name__} not supported")
+
+    walk(model)
+    variables = {f"layer_{i}": p for i, (_, p, _) in enumerate(layers)}
+    specs = [(kind, f"layer_{i}", cfg) for i, (kind, _, cfg)
+             in enumerate(layers)]
+
+    def apply_fn(variables, x):
+        import jax
+        import jax.numpy as jnp
+        from flax import linen as nn
+
+        for kind, key, cfg in specs:
+            p = variables.get(key, {})
+            if kind == "linear":
+                x = x @ jnp.asarray(p["kernel"])
+                if p.get("bias") is not None:
+                    x = x + jnp.asarray(p["bias"])
+            elif kind == "conv":
+                s = cfg["stride"]
+                pad = cfg["padding"]
+                pad = ((pad[0], pad[0]), (pad[1], pad[1])) \
+                    if isinstance(pad, (tuple, list)) else ((pad, pad),) * 2
+                x = jax.lax.conv_general_dilated(
+                    x, jnp.asarray(p["kernel"]), window_strides=tuple(s),
+                    padding=pad, dimension_numbers=("NHWC", "HWIO", "NHWC"))
+                if "bias" in p:
+                    x = x + jnp.asarray(p["bias"])
+            elif kind == "batchnorm":
+                mean, var = jnp.asarray(p["mean"]), jnp.asarray(p["var"])
+                x = (x - mean) / jnp.sqrt(var + cfg["eps"])
+                x = x * jnp.asarray(p["scale"]) + jnp.asarray(p["bias"])
+            elif kind == "relu":
+                x = jax.nn.relu(x)
+            elif kind == "gelu":
+                x = jax.nn.gelu(x)
+            elif kind == "tanh":
+                x = jnp.tanh(x)
+            elif kind == "sigmoid":
+                x = jax.nn.sigmoid(x)
+            elif kind in ("maxpool", "avgpool"):
+                k = cfg["k"]
+                k = (k, k) if isinstance(k, int) else tuple(k)
+                s = cfg["s"] or k
+                s = (s, s) if isinstance(s, int) else tuple(s)
+                if kind == "maxpool":
+                    x = nn.max_pool(x, k, strides=s)
+                else:
+                    x = nn.avg_pool(x, k, strides=s)
+            elif kind == "gap":
+                x = x.mean(axis=(1, 2), keepdims=True)
+            elif kind == "flatten":
+                x = x.reshape(x.shape[0], -1)
+        return x
+
+    return apply_fn, variables
+
+
+def torch_to_jax_model(model, input_col: str = "input",
+                       output_col: str = "output", batch_size: int = 64):
+    """Torch module -> ready-to-use JaxModel transformer."""
+    from .jax_model import JaxModel
+    apply_fn, variables = torch_to_jax(model)
+    jm = JaxModel()
+    jm.set_model(apply_fn=apply_fn, variables=variables)
+    jm.set_params(input_col=input_col, output_col=output_col,
+                  batch_size=batch_size)
+    return jm

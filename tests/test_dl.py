@@ -103,3 +103,33 @@ def test_minibatch_roundtrip():
     flat = FlattenBatch().transform(batched)
     assert flat.count() == 10
     assert np.array_equal(np.sort(np.asarray(flat.collect()["a"], dtype=int)), np.arange(10))
+
+
+def test_torch_import_matches_torch():
+    torch = pytest.importorskip("torch")
+    import torch.nn as tnn
+    from mmlspark_tpu.dl.torch_import import torch_to_jax, torch_to_jax_model
+
+    torch.manual_seed(0)
+    mlp = tnn.Sequential(tnn.Linear(6, 16), tnn.ReLU(), tnn.Linear(16, 3))
+    x = np.random.default_rng(0).normal(size=(9, 6)).astype(np.float32)
+    ref = mlp(torch.from_numpy(x)).detach().numpy()
+    apply_fn, variables = torch_to_jax(mlp)
+    got = np.asarray(apply_fn(variables, x))
+    assert np.allclose(got, ref, atol=1e-5)
+
+    conv = tnn.Sequential(
+        tnn.Conv2d(3, 4, 3, stride=1, padding=1), tnn.BatchNorm2d(4),
+        tnn.ReLU(), tnn.MaxPool2d(2), tnn.AdaptiveAvgPool2d(1),
+        tnn.Flatten(), tnn.Linear(4, 2)).eval()
+    xi = np.random.default_rng(1).normal(size=(2, 3, 8, 8)).astype(np.float32)
+    ref2 = conv(torch.from_numpy(xi)).detach().numpy()
+    apply2, vars2 = torch_to_jax(conv)
+    got2 = np.asarray(apply2(vars2, np.transpose(xi, (0, 2, 3, 1))))  # NHWC in
+    assert np.allclose(got2, ref2, atol=1e-4), np.abs(got2 - ref2).max()
+
+    # end-to-end through JaxModel
+    jm = torch_to_jax_model(mlp, input_col="f", output_col="o", batch_size=4)
+    df = DataFrame.from_dict({"f": np.asarray(x, np.float64)})
+    out = jm.transform(df).collect()["o"]
+    assert np.allclose(np.stack(list(out)), ref, atol=1e-4)
