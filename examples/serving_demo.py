@@ -1,0 +1,68 @@
+"""Spark-Serving-equivalent demo: deploy a fitted pipeline as a web service.
+
+Mirrors the reference's serving quickstart (``docs/mmlspark-serving.md``):
+train a model, wrap it in a request->reply pipeline, serve it continuously,
+and measure request latency.
+"""
+import json
+import time
+import urllib.request
+
+import numpy as np
+
+from _common import setup
+
+
+def main():
+    setup()
+    from mmlspark_tpu.core import DataFrame, Transformer
+    from mmlspark_tpu.core.schema import vector_column
+    from mmlspark_tpu.lightgbm import LightGBMClassifier
+    from mmlspark_tpu.serving import PipelineServer
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2000, 8))
+    y = (X[:, 0] - X[:, 1] > 0).astype(float)
+    model = LightGBMClassifier().set_params(num_iterations=30).fit(
+        DataFrame.from_dict({"features": vector_column(list(X)), "label": y}))
+
+    class RequestToReply(Transformer):
+        """request {features: [...]} -> reply {probability: p}."""
+
+        def _transform(self, df):
+            feats = np.empty(df.count(), dtype=object)
+            for i, r in enumerate(df.collect()["request"]):
+                feats[i] = np.asarray(r["features"], np.float64)
+            scored = model.transform(DataFrame([{"features": feats}]))
+            probs = scored.collect()["probability"]
+            out = np.empty(len(probs), dtype=object)
+            for i, p in enumerate(probs):
+                out[i] = {"probability": float(p[1])}
+            return df.with_column("reply", lambda part: out)
+
+    server = PipelineServer(RequestToReply(), mode="continuous", port=0).start()
+    print(f"serving at {server.address}")
+
+    # warm + latency probe
+    def call(vec):
+        req = urllib.request.Request(
+            server.address, data=json.dumps({"features": vec}).encode(),
+            headers={"Content-Type": "application/json"})
+        return json.loads(urllib.request.urlopen(req, timeout=10).read())
+
+    call(list(X[0]))
+    lat = []
+    for i in range(50):
+        t0 = time.perf_counter()
+        resp = call(list(X[i % len(X)]))
+        lat.append(1000 * (time.perf_counter() - t0))
+    lat = np.asarray(lat)
+    stats = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{server.port}/stats").read())
+    print(f"latency p50={np.percentile(lat, 50):.2f}ms "
+          f"p95={np.percentile(lat, 95):.2f}ms; server stats: {stats}")
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()
