@@ -1,11 +1,27 @@
 """North-star bench (BASELINE.json): LightGBM rows/sec/chip on 1M x 200.
 
-Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", ...extras}.
+Prints JSON lines {"metric", "value", "unit", "vs_baseline", ...extras};
+the LAST line printed is the result (the driver parses last-JSON-wins).
 
 vs_baseline = TPU rows/sec divided by this host's CPU-executor rows/sec for
 the identical trainer (the reference target is >=8x CPU-executor throughput,
-BASELINE.md).  A ResNet-50 featurize images/sec/chip secondary metric rides
-in the extras.
+BASELINE.md).  ResNet-50 featurize images/sec/chip rides in the extras.
+
+Resilience design (round 2, after BENCH_r01 ended rc=124 / parsed=null):
+- a valid JSON result line is printed after EVERY phase, so an outer
+  timeout can never erase completed measurements;
+- the persistent XLA compilation cache is enabled (relay compiles dominated
+  round 1: one conv net took 1502s) and bench shapes match __graft_entry__
+  .entry() exactly, so the driver's compile check pre-warms the cache;
+- the CPU baseline probe runs in a subprocess pinned to the CPU platform
+  with sitecustomize TPU hooks scrubbed; it launches AFTER the timed TPU
+  GBDT phase (host-CPU contention would deflate that phase's host-side
+  binning) and overlaps only the ResNet phase, whose host work is
+  negligible;
+- phase deadlines keep the worst case under ~800s;
+- timed loops vary their inputs every step and end with a host fetch: the
+  relay can serve repeated (computation, args) pairs from cache without
+  executing (.claude/skills/verify/SKILL.md).
 """
 from __future__ import annotations
 
@@ -17,11 +33,32 @@ import time
 
 import numpy as np
 
+_REPO = os.path.dirname(os.path.abspath(__file__))
 
-def gbdt_rows_per_sec(n=1_000_000, f=200, iters_a=2, iters_b=32) -> float:
+RESULT = {
+    "metric": "lightgbm_train_rows_per_sec_per_chip_1Mx200",
+    "value": None,
+    "unit": "rows/sec",
+    "vs_baseline": None,
+    "extras": {},
+}
+
+
+def _emit() -> None:
+    print(json.dumps(RESULT), flush=True)
+
+
+def _log(msg) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def gbdt_rows_per_sec(n=1_000_000, f=200, iters_a=2, iters_b=12) -> float:
     """Marginal boosting rate: rows * (B - A) / (t_B - t_A).  Subtracts the
-    shared fixed costs (compile via cache warm, binning, transfer) so the
-    number is the steady-state training rate both backends are judged by."""
+    shared fixed costs (compile — cached across runs since the jitted
+    per-iteration program's key excludes num_iterations — binning, host->
+    device transfer), leaving the steady-state training rate both backends
+    are judged by.  Scores evolve every iteration, so each dispatch is a
+    distinct (computation, args) pair — no relay result caching."""
     from mmlspark_tpu.lightgbm import GBDTParams, train
     rng = np.random.default_rng(0)
     X = rng.normal(size=(n, f)).astype(np.float32)
@@ -36,70 +73,85 @@ def gbdt_rows_per_sec(n=1_000_000, f=200, iters_a=2, iters_b=32) -> float:
     return n * (iters_b - iters_a) / max(t_b - t_a, 1e-9)
 
 
-def resnet_images_per_sec(batch=32, steps=20, hw=224) -> float:
+def resnet_images_per_sec(batch=32, steps=10, hw=224) -> float:
+    """Same program as __graft_entry__.entry() (shapes, dtype, step-scalar),
+    so the driver's compile check warms the persistent cache for this."""
     import jax
     import jax.numpy as jnp
     from mmlspark_tpu.models import resnet50
     from mmlspark_tpu.ops import image as image_ops
 
     module = resnet50(num_classes=1000, dtype=jnp.bfloat16)
-    x = jax.random.uniform(jax.random.PRNGKey(0), (batch, hw, hw, 3), jnp.float32, 0, 255)
-    variables = module.init(jax.random.PRNGKey(1), x)
+    variables = module.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 64, 64, 3), jnp.float32))
+    x = jax.random.uniform(jax.random.PRNGKey(1), (batch, hw, hw, 3),
+                           jnp.float32, 0, 255)
 
     @jax.jit
-    def featurize(variables, batch):
-        return module.apply(variables, image_ops.normalize(batch), features=True)
+    def featurize(variables, batch, step):
+        return module.apply(variables, image_ops.normalize(batch + step),
+                            features=True)
 
-    featurize(variables, x).block_until_ready()
-    xs = [jax.random.uniform(jax.random.PRNGKey(i + 2), (batch, hw, hw, 3),
-                             jnp.float32, 0, 255) for i in range(min(8, steps))]
-    for z in xs:
-        z.block_until_ready()
+    # warm the EXACT benched shape; host fetch forces remote execution
+    float(featurize(variables, x, jnp.float32(-1.0)).sum())
     t0 = time.perf_counter()
+    out = None
     for i in range(steps):
-        out = featurize(variables, xs[i % len(xs)])
-        out.block_until_ready()
+        out = featurize(variables, x, jnp.float32(i))  # distinct args/step
+    float(out.sum())  # drain the async dispatch queue
     return batch * steps / (time.perf_counter() - t0)
 
 
-def cpu_probe() -> float:
-    """CPU-executor baseline: identical trainer, scaled-down probe."""
-    code = (
-        "import os\n"
-        "os.environ['JAX_PLATFORMS']='cpu'\n"
-        "import jax\n"
-        "jax.config.update('jax_platforms','cpu')\n"
-        "import numpy as np, time\n"
-        "from mmlspark_tpu.lightgbm import GBDTParams, train\n"
-        "rng = np.random.default_rng(0)\n"
-        "n, f = 200_000, 200\n"
-        "X = rng.normal(size=(n, f)).astype(np.float32)\n"
-        "y = (X[:,0] > 0).astype(np.float32)\n"
-        "train(X, y, GBDTParams(num_iterations=1, objective='binary', max_depth=5))\n"
-        "import time as _t\n"
-        "t0 = _t.perf_counter()\n"
-        "train(X, y, GBDTParams(num_iterations=2, objective='binary', max_depth=5))\n"
-        "ta = _t.perf_counter() - t0\n"
-        "t0 = _t.perf_counter()\n"
-        "train(X, y, GBDTParams(num_iterations=7, objective='binary', max_depth=5))\n"
-        "tb = _t.perf_counter() - t0\n"
-        "print('CPU_RPS', n * 5 / max(tb - ta, 1e-9))\n"
-    )
+_CPU_PROBE_CODE = r"""
+import os
+os.environ['JAX_PLATFORMS'] = 'cpu'
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np, time, sys
+sys.path.insert(0, {repo!r})
+from mmlspark_tpu.lightgbm import GBDTParams, train
+rng = np.random.default_rng(0)
+n, f = 200_000, 200
+X = rng.normal(size=(n, f)).astype(np.float32)
+y = (X[:, 0] + 0.5 * X[:, 1] + rng.normal(scale=0.3, size=n) > 0).astype(np.float32)
+train(X, y, GBDTParams(num_iterations=1, objective='binary', max_depth=5))
+t0 = time.perf_counter()
+train(X, y, GBDTParams(num_iterations=2, objective='binary', max_depth=5))
+ta = time.perf_counter() - t0
+t0 = time.perf_counter()
+train(X, y, GBDTParams(num_iterations=7, objective='binary', max_depth=5))
+tb = time.perf_counter() - t0
+print('CPU_RPS', n * 5 / max(tb - ta, 1e-9), flush=True)
+"""
+
+
+def launch_cpu_probe() -> subprocess.Popen:
+    """CPU-executor baseline: identical trainer in a subprocess pinned to the
+    CPU platform.  Runs concurrently with the TPU phases (it shares no
+    device); PYTHONPATH is scrubbed so sitecustomize's TPU hooks never touch
+    the relay from this process."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("TPU", "AXON"))}
+    env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.Popen(
+        [sys.executable, "-c", _CPU_PROBE_CODE.replace("{repo!r}", repr(_REPO))],
+        cwd=_REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True)
+
+
+def collect_cpu_probe(proc: subprocess.Popen, timeout: float) -> float:
     try:
-        out = subprocess.run([sys.executable, "-c", code],
-                             cwd=os.path.dirname(os.path.abspath(__file__)),
-                             capture_output=True, text=True, timeout=1200)
-        for line in out.stdout.splitlines():
+        out, _ = proc.communicate(timeout=timeout)
+        for line in out.splitlines():
             if line.startswith("CPU_RPS"):
                 return float(line.split()[1])
-    except Exception:
-        pass
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        _log("[bench] cpu probe timed out")
+    except Exception as e:  # noqa: BLE001
+        _log(f"[bench] cpu probe failed: {e}")
     return 0.0
-
-
-def _log(msg):
-    import sys
-    print(msg, file=sys.stderr, flush=True)
 
 
 class _PhaseTimeout(Exception):
@@ -107,8 +159,10 @@ class _PhaseTimeout(Exception):
 
 
 def _with_deadline(fn, seconds, default=None):
-    """Run fn() with a SIGALRM deadline; on expiry return `default` so one
-    wedged device phase can't hang the whole bench."""
+    """Run fn() under a SIGALRM deadline so one wedged device phase can't
+    consume the whole outer budget (note: the alarm cannot preempt a blocked
+    relay RPC — it fires when control returns to Python — which is why the
+    risky phases run LAST and results are emitted incrementally)."""
     import signal
 
     def handler(signum, frame):
@@ -130,32 +184,51 @@ def _with_deadline(fn, seconds, default=None):
 
 
 def main() -> None:
-    # ResNet first: device state is clean (running after the 1M-row GBDT
-    # dataset measurably degrades inference throughput in this environment)
-    import time as _t
-    t0 = _t.perf_counter()
-    images_sec = _with_deadline(lambda: resnet_images_per_sec(batch=64), 900)
-    _log(f"[bench] resnet done in {_t.perf_counter()-t0:.0f}s")
-    t0 = _t.perf_counter()
-    tpu_rps = _with_deadline(gbdt_rows_per_sec, 1200)
-    if tpu_rps is None:  # degraded fallback: smaller workload
-        tpu_rps = _with_deadline(lambda: gbdt_rows_per_sec(n=200_000, iters_b=12), 600,
-                                 default=0.0)
-    _log(f"[bench] gbdt tpu done in {_t.perf_counter()-t0:.0f}s")
-    t0 = _t.perf_counter()
-    cpu_rps = _with_deadline(cpu_probe, 1200, default=0.0)
-    _log(f"[bench] cpu probe done in {_t.perf_counter()-t0:.0f}s")
-    print(json.dumps({
-        "metric": "lightgbm_train_rows_per_sec_per_chip_1Mx200",
-        "value": round(tpu_rps, 1),
-        "unit": "rows/sec",
-        "vs_baseline": round(tpu_rps / cpu_rps, 3) if cpu_rps else None,
-        "extras": {
-            "cpu_executor_rows_per_sec": round(cpu_rps, 1) if cpu_rps else None,
-            "resnet50_featurize_images_per_sec_per_chip": round(images_sec, 1)
-            if images_sec else None,
-        },
-    }))
+    import gc
+    from __graft_entry__ import enable_compilation_cache
+    enable_compilation_cache()
+    wall0 = time.perf_counter()
+
+    # Phase 1 — headline metric: GBDT rows/sec on the real chip (no other
+    # process competes for host CPU during its timed window).
+    t0 = time.perf_counter()
+    tpu_rps = _with_deadline(gbdt_rows_per_sec, 330)
+    scaled = False
+    if tpu_rps is None:  # degraded fallback: quarter-size, same trainer
+        tpu_rps = _with_deadline(
+            lambda: gbdt_rows_per_sec(n=250_000, iters_b=10), 150, default=0.0)
+        scaled = tpu_rps > 0
+    _log(f"[bench] gbdt tpu done in {time.perf_counter() - t0:.0f}s")
+    RESULT["value"] = round(tpu_rps, 1)
+    if scaled:
+        RESULT["extras"]["note"] = (
+            "measured at 250k x 200 (1M deadline exceeded); rows/sec is the "
+            "steady-state marginal rate, which scales ~linearly in rows")
+    _emit()
+
+    # Phase 2 — ResNet-50 featurize.  The CPU probe overlaps this phase only
+    # (its host work is a handful of dispatches).  GBDT host buffers are
+    # dropped first: round 1 observed inference degradation after the 1M-row
+    # dataset, so reclaim host/device memory before timing inference.
+    cpu_proc = launch_cpu_probe()
+    gc.collect()
+    t0 = time.perf_counter()
+    images_sec = _with_deadline(resnet_images_per_sec, 240)
+    _log(f"[bench] resnet done in {time.perf_counter() - t0:.0f}s")
+    if images_sec:
+        RESULT["extras"]["resnet50_featurize_images_per_sec_per_chip"] = round(
+            images_sec, 1)
+    _emit()
+
+    # Phase 3 — CPU-executor baseline (collect; it ran during phase 2).
+    remaining = max(60.0, 780.0 - (time.perf_counter() - wall0))
+    cpu_rps = collect_cpu_probe(cpu_proc, remaining)
+    _log(f"[bench] cpu probe: {cpu_rps:.0f} rows/sec")
+    if cpu_rps:
+        RESULT["extras"]["cpu_executor_rows_per_sec"] = round(cpu_rps, 1)
+        if tpu_rps:
+            RESULT["vs_baseline"] = round(tpu_rps / cpu_rps, 3)
+    _emit()
 
 
 if __name__ == "__main__":

@@ -11,6 +11,17 @@ Layout on disk::
 
 Every complex payload kind gets a tagged directory so load() can dispatch
 without pickle-by-default; arbitrary objects fall back to pickle (stdlib).
+
+.. warning:: **Security.** ``load()`` imports the class named in
+   ``metadata.json`` and, for closure-typed params (UDFs, Lambda stages),
+   falls back to ``pickle`` — both execute code from the artifact.  Only
+   load model/pipeline directories you trust, exactly as the reference's
+   serializers (SparkML ``DefaultParamsReader`` class-forname + Java
+   deserialization) and ``torch.load`` require.  For artifacts from
+   untrusted sources, pass ``safe=True`` (or set env
+   ``MMLSPARK_TPU_SAFE_LOAD=1``): class imports are then restricted to
+   registered trusted prefixes (``mmlspark_tpu.`` plus
+   ``register_loadable_prefix(...)``) and pickle payloads refuse to load.
 """
 from __future__ import annotations
 
@@ -42,7 +53,25 @@ def _qualname(obj) -> str:
     return f"{cls.__module__}.{cls.__qualname__}"
 
 
-def _import_qual(qual: str):
+_TRUSTED_PREFIXES = {"mmlspark_tpu."}
+
+
+def register_loadable_prefix(prefix: str) -> None:
+    """Allow classes under ``prefix`` (e.g. ``myproject.stages.``) to be
+    instantiated by ``load(..., safe=True)``."""
+    _TRUSTED_PREFIXES.add(prefix)
+
+
+def _default_safe() -> bool:
+    return os.environ.get("MMLSPARK_TPU_SAFE_LOAD", "0") not in ("0", "", "false")
+
+
+def _import_qual(qual: str, safe: bool = False):
+    if safe and not any(qual.startswith(p) for p in _TRUSTED_PREFIXES):
+        raise PermissionError(
+            f"safe load: class {qual!r} is outside the trusted prefixes "
+            f"{sorted(_TRUSTED_PREFIXES)}; call register_loadable_prefix() "
+            f"for code you trust, or load with safe=False for trusted paths")
     mod, _, name = qual.rpartition(".")
     m = importlib.import_module(mod)
     obj = m
@@ -92,26 +121,32 @@ def _save_complex(value: Any, path: str) -> Dict[str, Any]:
     return {"kind": "pickle"}
 
 
-def _load_complex(tag: Dict[str, Any], path: str) -> Any:
+def _load_complex(tag: Dict[str, Any], path: str, safe: bool = False) -> Any:
     kind = tag["kind"]
     if kind == "saveable":
-        cls = _import_qual(tag["class"])
+        cls = _import_qual(tag["class"], safe=safe)
         return cls.load(os.path.join(path, "payload"))
     if kind == "stage":
-        return load_stage(os.path.join(path, "stage"))
+        return load_stage(os.path.join(path, "stage"), safe=safe)
     if kind == "stage_list":
-        return [load_stage(os.path.join(path, f"stage_{i}")) for i in range(tag["n"])]
+        return [load_stage(os.path.join(path, f"stage_{i}"), safe=safe)
+                for i in range(tag["n"])]
     if kind == "dataframe":
-        return load_dataframe(os.path.join(path, "frame"))
+        return load_dataframe(os.path.join(path, "frame"), safe=safe)
     if kind == "ndarray":
-        return np.load(os.path.join(path, "array.npy"), allow_pickle=True)
+        return np.load(os.path.join(path, "array.npy"), allow_pickle=not safe)
     if kind == "bytes":
         with open(os.path.join(path, "payload.bin"), "rb") as f:
             return f.read()
     if kind == "ndarray_dict":
-        with np.load(os.path.join(path, "arrays.npz"), allow_pickle=True) as z:
+        with np.load(os.path.join(path, "arrays.npz"), allow_pickle=not safe) as z:
             return {k: z[k] for k in z.files}
     if kind == "pickle":
+        if safe:
+            raise PermissionError(
+                "safe load: refusing pickle payload at "
+                f"{os.path.join(path, 'payload.pkl')!r} (pickle executes "
+                "arbitrary code); load with safe=False only on trusted paths")
         with open(os.path.join(path, "payload.pkl"), "rb") as f:
             return pickle.load(f)
     raise ValueError(f"unknown complex payload kind {kind!r}")
@@ -137,10 +172,15 @@ def save_stage(stage: Params, path: str, overwrite: bool = True) -> None:
         json.dump(meta, f, indent=1, default=str)
 
 
-def load_stage(path: str) -> Params:
+def load_stage(path: str, safe: bool = None) -> Params:
+    """Load a stage directory.  ``safe=True`` (default from env
+    ``MMLSPARK_TPU_SAFE_LOAD``) restricts class imports to trusted prefixes
+    and refuses pickle payloads — see the module security warning."""
+    if safe is None:
+        safe = _default_safe()
     with open(os.path.join(path, "metadata.json")) as f:
         meta = json.load(f)
-    cls = _import_qual(meta["class"])
+    cls = _import_qual(meta["class"], safe=safe)
     stage = cls.__new__(cls)
     Params.__init__(stage, uid=meta["uid"])
     for name, value in meta["params"].items():
@@ -148,7 +188,8 @@ def load_stage(path: str) -> Params:
     for name, d in meta.get("service", {}).items():
         stage._paramMap[name] = ServiceValue.from_json(d)
     for name, tag in meta.get("complex", {}).items():
-        stage._paramMap[name] = _load_complex(tag, os.path.join(path, "complex", name))
+        stage._paramMap[name] = _load_complex(tag, os.path.join(path, "complex", name),
+                                              safe=safe)
     if hasattr(stage, "_post_load"):
         stage._post_load()
     return stage
@@ -167,14 +208,17 @@ def save_dataframe(df, path: str) -> None:
         json.dump(manifest, f)
 
 
-def load_dataframe(path: str):
+def load_dataframe(path: str, safe: bool = False):
+    """``safe=True`` loads arrays with ``allow_pickle=False`` — object-dtype
+    columns (sparse dicts, nested arrays) then raise instead of unpickling."""
     from .dataframe import DataFrame
     from .schema import Schema
     with open(os.path.join(path, "manifest.json")) as f:
         manifest = json.load(f)
     parts = []
     for i in range(manifest["num_partitions"]):
-        with np.load(os.path.join(path, f"part_{i}.npz"), allow_pickle=True) as z:
+        with np.load(os.path.join(path, f"part_{i}.npz"),
+                     allow_pickle=not safe) as z:
             parts.append({k: z[k] for k in manifest["columns"]})
     return DataFrame(parts, schema=Schema(manifest["schema"]))
 
@@ -184,5 +228,5 @@ def save(stage: Params, path: str, overwrite: bool = True) -> None:
     save_stage(stage, path, overwrite)
 
 
-def load(path: str) -> Params:
-    return load_stage(path)
+def load(path: str, safe: bool = None) -> Params:
+    return load_stage(path, safe=safe)

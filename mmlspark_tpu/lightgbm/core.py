@@ -531,8 +531,10 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
                           jnp.asarray(init_booster.threshold_bin[t]))
             contrib = jnp.asarray(init_booster.leaf_value[t])[leaf] * init_booster.tree_weight[t]
             scores = scores.at[:, t % K].add(contrib)
-        init_score = init_booster.init_score
+        # shift base score to the incoming booster's BEFORE reassigning, so
+        # continued training optimizes against the recorded init_score
         scores = scores + (init_booster.init_score - init_score)
+        init_score = init_booster.init_score
 
     metric_name = p.metric or default_metric(p.objective)
     metric_fn, larger_better = METRICS.get(metric_name, METRICS[default_metric(p.objective)])
@@ -687,6 +689,7 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
     valid_chunk_update = _cached(("validupd", D, K), _build_valid_update)
 
     it = start_iter
+    bag_mask = None  # sampled lazily on the first bagging-eligible iteration
     end_iter = start_iter + p.num_iterations
     while it < end_iter:
         if multi_iter is not None and end_iter - it >= CH:
@@ -729,7 +732,10 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
             feat_mask = jnp.zeros((F,), bool).at[jnp.asarray(sel)].set(True)
         base_mask = hist_mask_full
         if p.boosting_type != "goss" and p.bagging_freq > 0 and p.bagging_fraction < 1.0:
-            if it % p.bagging_freq == 0:
+            # resample on schedule-aligned iterations AND on the first
+            # iteration of this call (a warm start may begin off-schedule,
+            # in which case bag_mask would otherwise be unbound)
+            if it % p.bagging_freq == 0 or bag_mask is None:
                 bag_mask = jnp.asarray(rng.random(n) < p.bagging_fraction)
             base_mask = hist_mask_full & bag_mask
 

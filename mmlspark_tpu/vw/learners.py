@@ -8,8 +8,10 @@ allreduce between passes (``trainInternalDistributed:434-462``), and
 TPU-native redesign: the model is a dense weight vector over the 2^b hash
 space living in HBM; examples arrive as padded (indices, values) minibatches;
 one jitted step does predict + VW-style adaptive/normalized gradient update
-via segment scatter-adds.  Passes end with ``lax.pmean`` of weights over the
-``data`` mesh axis — the spanning-tree replacement (SURVEY.md §2.12).
+via segment scatter-adds.  In multi-process (executor) runs each process
+trains its own partition shard and passes end with a cross-process mean of
+weights and optimizer accumulators (``_allreduce_pass_end``) — the
+spanning-tree replacement (SURVEY.md §2.12).
 
 The update rule follows VW's ``--adaptive --normalized`` defaults: AdaGrad
 per-weight step sizes with per-weight scale normalization; ``--bfgs`` errors
@@ -30,9 +32,14 @@ from ..core.schema import ColumnType
 from ..utils.stopwatch import StopWatch
 
 
-def pack_sparse_column(col: np.ndarray, max_nnz: Optional[int] = None):
+def pack_sparse_column(col: np.ndarray, max_nnz: Optional[int] = None,
+                       mask: Optional[int] = None):
     """Object column of {'indices','values'} dicts -> padded (n, k) arrays.
-    Padding uses value 0.0 so padded slots contribute nothing."""
+    Padding uses value 0.0 so padded slots contribute nothing.  ``mask``
+    folds indices into the learner's weight space (VW masks hashes into the
+    2^b table at example-parse time, so a featurizer hashed with more bits
+    than the learner's ``-b`` still trains — out-of-range indices would be
+    silently dropped by XLA's scatter instead)."""
     n = len(col)
     if max_nnz is None:
         max_nnz = max((len(v["indices"]) for v in col), default=1) or 1
@@ -42,6 +49,8 @@ def pack_sparse_column(col: np.ndarray, max_nnz: Optional[int] = None):
         k = min(len(v["indices"]), max_nnz)
         idx[i, :k] = v["indices"][:k]
         val[i, :k] = v["values"][:k]
+    if mask is not None:
+        idx &= mask
     return idx, val
 
 
@@ -58,6 +67,63 @@ class TrainingStats:
 
     def as_row(self) -> Dict:
         return dataclasses.asdict(self)
+
+
+def _allreduce_pass_end(state):
+    """End-of-pass weight averaging across executor processes — the
+    spanning-tree allreduce replacement (``trainInternalDistributed``,
+    VowpalWabbitBase.scala:434-462; SURVEY.md §2.12).  Each executor trains
+    its own partition shard; at pass end, weights AND the AdaGrad/normalizer
+    accumulators are averaged so every process continues the next pass from
+    the same model.  Single-process runs return the state untouched."""
+    import jax
+    if jax.process_count() <= 1:
+        return state
+    from jax.experimental import multihost_utils
+    import jax.numpy as jnp
+    weights, gsq, xmax = state
+    gathered = multihost_utils.process_allgather(
+        jnp.stack([weights, gsq]))                      # (P, 2, D)
+    mean = gathered.mean(axis=0)
+    xmax_all = multihost_utils.process_allgather(xmax)  # (P, D)
+    return (jnp.asarray(mean[0]), jnp.asarray(mean[1]),
+            jnp.asarray(xmax_all.max(axis=0)))
+
+
+def _interaction_features(part: Dict, base_col: np.ndarray, specs: List[str],
+                          mask: int) -> np.ndarray:
+    """VW ``-q ab`` semantics: cross every namespace whose name starts with
+    'a' against every one starting with 'b' and append the crossed features
+    to each example.  Namespaces are sparse-dict columns of the frame (the
+    featurizer's namespace=column convention); the pair hash matches
+    ``VowpalWabbitInteractions`` (h_a * 16777619 + h_b)."""
+    ns_cols = {name: col for name, col in part.items()
+               if len(col) and isinstance(col[0], dict) and "indices" in col[0]}
+    prime = np.uint32(16777619)
+    n = len(base_col)
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        idx_list = [np.asarray(base_col[i]["indices"], np.int32)]
+        val_list = [np.asarray(base_col[i]["values"], np.float32)]
+        for spec in specs:
+            if len(spec) < 2:
+                continue
+            a_cols = [c for c in ns_cols if c.startswith(spec[0])]
+            b_cols = [c for c in ns_cols if c.startswith(spec[1])]
+            for ca in a_cols:
+                for cb in b_cols:
+                    fa, fb = ns_cols[ca][i], ns_cols[cb][i]
+                    ia = np.asarray(fa["indices"]).astype(np.uint32)
+                    ib = np.asarray(fb["indices"]).astype(np.uint32)
+                    with np.errstate(over="ignore"):
+                        hh = (ia[:, None] * prime + ib[None, :]).reshape(-1)
+                    vv = (np.asarray(fa["values"])[:, None]
+                          * np.asarray(fb["values"])[None, :]).reshape(-1)
+                    idx_list.append((hh & mask).astype(np.int32))
+                    val_list.append(vv.astype(np.float32))
+        out[i] = {"indices": np.concatenate(idx_list),
+                  "values": np.concatenate(val_list)}
+    return out
 
 
 def _loss_grads(loss: str, quantile_tau: float):
@@ -83,6 +149,9 @@ class _VWBase(Estimator, HasFeaturesCol, HasLabelCol, HasWeightCol):
     num_bits = Param("num_bits", "hash space bits (VW -b)", "int", default=18)
     learning_rate = Param("learning_rate", "base learning rate (VW -l)", "float", default=0.5)
     power_t = Param("power_t", "lr decay exponent", "float", default=0.5)
+    initial_t = Param("initial_t", "initial example-count t (VW --initial_t); "
+                      "the non-adaptive lr denominator is t^power_t", "float",
+                      default=0.0)
     num_passes = Param("num_passes", "passes over the data", "int", default=1)
     l1 = Param("l1", "L1 regularization", "float", default=0.0)
     l2 = Param("l2", "L2 regularization", "float", default=0.0)
@@ -91,14 +160,20 @@ class _VWBase(Estimator, HasFeaturesCol, HasLabelCol, HasWeightCol):
     batch_size = Param("batch_size", "device minibatch size", "int", default=256)
     initial_model = Param("initial_model", "warm-start model bytes", "object")
     args = Param("args", "VW-style passthrough arg string (subset parsed: "
-                         "-b -l --l1 --l2 --passes --loss_function)", "string", default="")
+                         "-b -l --l1 --l2 --passes --loss_function --power_t "
+                         "--initial_t --(no)adaptive --(no)normalized -q "
+                         "--interactions --cb_type --quiet)", "string", default="")
+    interactions = Param("interactions", "namespace-pair interaction specs "
+                         "(VW -q/--interactions)", "list", default=None)
     use_barrier_execution_mode = Param("use_barrier_execution_mode",
                                        "parity param (gang scheduling is implicit "
                                        "in XLA collectives)", "bool", default=False)
-    _loss = "squared"
 
     def _parse_args(self):
-        """Reference passes a raw VW arg string (VowpalWabbitBase.scala:80)."""
+        """Reference builds its native command line from Params and a raw
+        passthrough string (``VowpalWabbitBase.buildCommandLineArguments``,
+        VowpalWabbitBase.scala:237, args param :80).  Parsed flags land in
+        this INSTANCE's Params only — never in class state."""
         toks = (self.get("args") or "").split()
         i = 0
         while i < len(toks):
@@ -115,8 +190,36 @@ class _VWBase(Estimator, HasFeaturesCol, HasLabelCol, HasWeightCol):
                 self.set("l2", float(nxt())); i += 1
             elif t == "--passes" and nxt():
                 self.set("num_passes", int(nxt())); i += 1
+            elif t == "--power_t" and nxt():
+                self.set("power_t", float(nxt())); i += 1
+            elif t == "--initial_t" and nxt():
+                self.set("initial_t", float(nxt())); i += 1
             elif t == "--loss_function" and nxt():
-                type(self)._loss = nxt(); i += 1
+                if "loss_function" in type(self)._params:
+                    self.set("loss_function", nxt())
+                i += 1
+            elif t == "--adaptive":
+                self.set("adaptive", True)
+            elif t == "--noadaptive":
+                self.set("adaptive", False)
+            elif t == "--normalized":
+                self.set("normalized", True)
+            elif t == "--nonormalized":
+                self.set("normalized", False)
+            elif t in ("-q", "--quadratic", "--interactions") and nxt():
+                pairs = list(self.get("interactions") or [])
+                if nxt() not in pairs:  # idempotent across re-parses
+                    pairs.append(nxt())
+                self.set("interactions", pairs); i += 1
+            elif t == "--cb_type" and nxt():
+                if "cb_type" in type(self)._params:
+                    self.set("cb_type", nxt())
+                elif nxt() != "ips":
+                    raise NotImplementedError(
+                        f"--cb_type {nxt()} on a non-bandit learner")
+                i += 1
+            elif t == "--quiet":
+                pass
             elif t == "--bfgs":
                 raise NotImplementedError("--bfgs is not supported on the TPU "
                                           "backend; increase --passes instead")
@@ -182,14 +285,19 @@ class _VWBase(Estimator, HasFeaturesCol, HasLabelCol, HasWeightCol):
                  jnp.zeros(D, jnp.float32))
 
         stats: List[TrainingStats] = []
-        t = 1.0
+        specs = self.get("interactions") or []
+        mask = (1 << self.get("num_bits")) - 1
+        t = 1.0 + self.get("initial_t")
         for pass_i in range(self.get("num_passes")):
             for pid, part in enumerate(df.partitions):
                 n = len(part[fc]) if fc in part else 0
                 if n == 0:
                     continue
                 with sw.measure("ingest"):
-                    idx, val = pack_sparse_column(part[fc])
+                    feats = part[fc]
+                    if specs:
+                        feats = _interaction_features(part, feats, specs, mask)
+                    idx, val = pack_sparse_column(feats, mask=mask)
                     y = y_transform(np.asarray(part[lc], np.float64)).astype(np.float32)
                     w = np.asarray(part[wc], np.float32) if wc else np.ones(n, np.float32)
                 with sw.measure("learn"):
@@ -215,16 +323,16 @@ class _VWBase(Estimator, HasFeaturesCol, HasLabelCol, HasWeightCol):
                         total_time_s=sw.total_elapsed(),
                         ingest_time_s=sw.elapsed("ingest"),
                         learn_time_s=sw.elapsed("learn")))
-            # end of pass: average weights across the mesh (spanning-tree
-            # allreduce replacement) — no-op on a single device
-            import jax as _jax
-            if _jax.device_count() > 1 and False:
-                pass  # multi-host weight averaging hook (executor integration)
+            # end of pass: average weights across executor processes — the
+            # reference's spanning-tree allreduce (VowpalWabbitBase.scala:
+            # 434-462).  No-op in a single-process run.
+            state = _allreduce_pass_end(state)
         return np.asarray(state[0]), stats
 
     def _attach_common(self, model, stats):
         model.set("features_col", self.get("features_col"))
         model.set("num_bits", self.get("num_bits"))
+        model.set("interactions", self.get("interactions"))
         model.set("stats", [s.as_row() for s in stats])
         for pc in ("prediction_col",):
             if pc in type(model)._params and pc in type(self)._params:
@@ -236,6 +344,18 @@ class VowpalWabbitModelBase(Model, HasFeaturesCol, HasPredictionCol):
     weights_param = ComplexParam("weights", "dense hash-space weights")
     num_bits = Param("num_bits", "hash space bits", "int", default=18)
     stats = Param("stats", "per-partition training stats rows", "list")
+    interactions = Param("interactions", "namespace-pair interaction specs "
+                         "applied at scoring time", "list", default=None)
+
+    def _effective_features(self, part: Dict) -> np.ndarray:
+        """Feature column with any trained ``-q`` interactions appended —
+        scoring must hash exactly what training hashed."""
+        col = part[self.get("features_col")]
+        specs = self.get("interactions") or []
+        if specs:
+            col = _interaction_features(part, col, specs,
+                                        (1 << self.get("num_bits")) - 1)
+        return col
 
     @property
     def weights(self) -> np.ndarray:
@@ -257,7 +377,7 @@ class VowpalWabbitModelBase(Model, HasFeaturesCol, HasPredictionCol):
         return w.copy()
 
     def _raw_scores(self, col: np.ndarray) -> np.ndarray:
-        idx, val = pack_sparse_column(col)
+        idx, val = pack_sparse_column(col, mask=(1 << self.get("num_bits")) - 1)
         w = self.weights
         return (w[idx] * val).sum(axis=1)
 
@@ -265,10 +385,10 @@ class VowpalWabbitModelBase(Model, HasFeaturesCol, HasPredictionCol):
 class VowpalWabbitClassifier(_VWBase, HasPredictionCol, HasProbabilityCol,
                              HasRawPredictionCol):
     """Binary classifier, logistic loss (reference VowpalWabbitClassifier)."""
-    _loss = "logistic"
     loss_function = Param("loss_function", "logistic|hinge", "string", default="logistic")
 
     def _fit(self, df: DataFrame) -> "VowpalWabbitClassificationModel":
+        self._parse_args()  # --loss_function etc. must land before reads
         weights, stats = self._fit_weights(
             df, self.get("loss_function"),
             lambda y: np.where(y > 0, 1.0, -1.0))
@@ -285,7 +405,7 @@ class VowpalWabbitClassificationModel(VowpalWabbitModelBase, HasProbabilityCol,
         fc = self.get("features_col")
 
         def per_part(p):
-            raw = self._raw_scores(p[fc])
+            raw = self._raw_scores(self._effective_features(p))
             prob = 1.0 / (1.0 + np.exp(-raw))
             prob_col = np.empty(len(raw), dtype=object)
             raw_col = np.empty(len(raw), dtype=object)
@@ -304,10 +424,10 @@ class VowpalWabbitClassificationModel(VowpalWabbitModelBase, HasProbabilityCol,
 
 
 class VowpalWabbitRegressor(_VWBase, HasPredictionCol):
-    _loss = "squared"
     loss_function = Param("loss_function", "squared|quantile", "string", default="squared")
 
     def _fit(self, df: DataFrame) -> "VowpalWabbitRegressionModel":
+        self._parse_args()  # --loss_function etc. must land before reads
         weights, stats = self._fit_weights(df, self.get("loss_function"), lambda y: y)
         model = VowpalWabbitRegressionModel()
         model.set("weights", weights)
@@ -319,7 +439,8 @@ class VowpalWabbitRegressionModel(VowpalWabbitModelBase):
         fc = self.get("features_col")
 
         def per_part(p):
-            return {**p, self.get("prediction_col"): self._raw_scores(p[fc])}
+            return {**p, self.get("prediction_col"):
+                    self._raw_scores(self._effective_features(p))}
 
         return df.map_partitions(per_part)
 
@@ -347,6 +468,9 @@ class VowpalWabbitContextualBandit(_VWBase):
     cost_col = Param("cost_col", "observed cost of chosen action", "string", default="cost")
     probability_col2 = Param("probability_col", "logging policy probability", "string",
                              default="probability")
+    cb_type = Param("cb_type", "bandit estimator: ips (inverse-propensity "
+                    "weights) | mtr (regress observed costs unweighted)",
+                    "string", default="ips")
 
     def _fit(self, df: DataFrame) -> "VowpalWabbitContextualBanditModel":
         import jax.numpy as jnp
@@ -359,7 +483,7 @@ class VowpalWabbitContextualBandit(_VWBase):
 
         state = (jnp.zeros(D, jnp.float32), jnp.zeros(D, jnp.float32),
                  jnp.zeros(D, jnp.float32))
-        t = 1.0
+        t = 1.0 + self.get("initial_t")
         stats: List[TrainingStats] = []
         for pass_i in range(self.get("num_passes")):
             for pid, part in enumerate(df.partitions):
@@ -367,6 +491,10 @@ class VowpalWabbitContextualBandit(_VWBase):
                 if n == 0:
                     continue
                 rows_idx, rows_val, targets, ws = [], [], [], []
+                cbt = self.get("cb_type")
+                if cbt not in ("ips", "mtr"):
+                    raise NotImplementedError(
+                        f"--cb_type {cbt}: only ips/mtr on this backend")
                 with sw.measure("ingest"):
                     chosen = np.asarray(part[self.get("chosen_action_col")], np.int64) - 1
                     cost = np.asarray(part[self.get("cost_col")], np.float64)
@@ -378,11 +506,11 @@ class VowpalWabbitContextualBandit(_VWBase):
                         rows_idx.append(np.concatenate([sh["indices"], a["indices"]]))
                         rows_val.append(np.concatenate([sh["values"], a["values"]]))
                         targets.append(cost[i])
-                        ws.append(1.0 / max(prob[i], 1e-6))
+                        ws.append(1.0 / max(prob[i], 1e-6) if cbt == "ips" else 1.0)
                 col = np.empty(n, dtype=object)
                 for i in range(n):
                     col[i] = {"indices": rows_idx[i], "values": rows_val[i]}
-                idx, val = pack_sparse_column(col)
+                idx, val = pack_sparse_column(col, mask=(1 << self.get("num_bits")) - 1)
                 y = np.asarray(targets, np.float32)
                 w = np.asarray(ws, np.float32)
                 w = w / w.mean()
@@ -416,6 +544,8 @@ class VowpalWabbitContextualBanditModel(VowpalWabbitModelBase):
         w = self.weights
         shared_c, act_c = self.get("shared_col"), self.get("action_col")
 
+        mask = (1 << self.get("num_bits")) - 1
+
         def per_part(p):
             n = len(p[act_c])
             out = np.empty(n, dtype=object)
@@ -424,9 +554,10 @@ class VowpalWabbitContextualBanditModel(VowpalWabbitModelBase):
                 scores = []
                 sh = p[shared_c][i] if shared_c in p else None
                 for a in acts:
-                    s = float((w[a["indices"]] * a["values"]).sum())
+                    s = float((w[np.asarray(a["indices"]) & mask] * a["values"]).sum())
                     if sh is not None:
-                        s += float((w[sh["indices"]] * sh["values"]).sum())
+                        s += float((w[np.asarray(sh["indices"]) & mask]
+                                    * sh["values"]).sum())
                     scores.append(s)
                 out[i] = np.asarray(scores)
             return {**p, self.get("prediction_col"): out}

@@ -1,0 +1,130 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md)."""
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.lightgbm import GBDTParams, train
+from mmlspark_tpu.vw import VowpalWabbitClassifier, VowpalWabbitRegressor
+from mmlspark_tpu.vw.featurizer import VowpalWabbitFeaturizer
+
+
+def _sparse_frame(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    y = (x1 + 0.5 * x2 > 0).astype(np.float64)
+    df = DataFrame.from_dict({"a_num": x1, "b_num": x2, "label": y})
+    feats = VowpalWabbitFeaturizer(input_cols=["a_num", "b_num"],
+                                   output_col="features")
+    return feats.transform(df)
+
+
+def test_vw_loss_function_arg_is_per_instance():
+    """ADVICE #2: ``--loss_function`` must set the instance Param; parsing on
+    one estimator must not leak into other instances of the class."""
+    df = _sparse_frame()
+    hinge = VowpalWabbitClassifier().set_params(args="--loss_function hinge", num_passes=2)
+    plain = VowpalWabbitClassifier().set_params(num_passes=2)
+    m_hinge = hinge.fit(df)
+    assert hinge.get("loss_function") == "hinge"
+    # the second instance is untouched by the first instance's arg parsing
+    assert plain.get("loss_function") == "logistic"
+    m_plain = plain.fit(df)
+    # and the parsed loss actually changes training
+    assert not np.allclose(m_hinge.weights, m_plain.weights)
+
+
+def test_vw_args_power_t_and_interactions():
+    """``-q ab`` crosses namespace (sparse featurizer output) columns whose
+    names start with 'a' and 'b' — VW's first-letter namespace matching."""
+    rng = np.random.default_rng(0)
+    x1, x2 = rng.normal(size=200), rng.normal(size=200)
+    y = x1 * x2  # pure interaction target: only -q can fit this
+    df = DataFrame.from_dict({"a_num": x1, "b_num": x2, "label": y})
+    for cols, out in ((["a_num"], "a_ns"), (["b_num"], "b_ns"),
+                      (["a_num", "b_num"], "features")):
+        df = VowpalWabbitFeaturizer(input_cols=cols, output_col=out).transform(df)
+
+    est = VowpalWabbitRegressor().set_params(args="--power_t 0.3 -q ab",
+                                             label_col="label", num_passes=4)
+    est._parse_args()
+    assert est.get("power_t") == 0.3
+    assert est.get("interactions") == ["ab"]
+    model = est.fit(df)
+    assert model.get("interactions") == ["ab"]
+    out = model.transform(df).to_pandas()
+    assert len(out["prediction"]) == 200
+    # interactions add crossed feature mass: weights differ from a plain fit
+    plain = VowpalWabbitRegressor().set_params(label_col="label",
+                                               num_passes=4).fit(df)
+    assert not np.allclose(model.weights, plain.weights)
+    # and the crossed features actually capture the x1*x2 structure better
+    err_q = float(np.mean((out["prediction"] - y) ** 2))
+    pred_plain = plain.transform(df).to_pandas()["prediction"]
+    err_plain = float(np.mean((pred_plain - y) ** 2))
+    assert err_q < err_plain
+
+
+def test_gbdt_warm_start_bagging_off_schedule():
+    """ADVICE #4: warm start beginning on an iteration where
+    ``it % bagging_freq != 0`` must not raise UnboundLocalError."""
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(400, 8)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    p = GBDTParams(num_iterations=3, objective="binary", max_depth=3,
+                   bagging_freq=2, bagging_fraction=0.5, seed=7)
+    r1 = train(X, y, p)
+    assert r1.booster.num_trees == 3
+    # continue from iteration 3 (3 % 2 != 0): first loop pass must resample
+    p2 = GBDTParams(num_iterations=2, objective="binary", max_depth=3,
+                    bagging_freq=2, bagging_fraction=0.5, seed=7)
+    r2 = train(X, y, p2, init_booster=r1.booster)
+    assert r2.booster.num_trees == 5
+
+
+def test_gbdt_warm_start_respects_init_score_shift():
+    """ADVICE #1: continuing training on data with a different base score
+    must anchor the replayed scores at the INIT booster's init_score, so the
+    returned booster's predictions match the new data."""
+    rng = np.random.default_rng(1)
+    X1 = rng.normal(size=(500, 6)).astype(np.float32)
+    y1 = (0.05 * X1[:, 0]).astype(np.float32)          # mean ~ 0
+    r1 = train(X1, y1, GBDTParams(num_iterations=2, objective="regression",
+                                  max_depth=3, learning_rate=0.2))
+    assert abs(r1.booster.init_score) < 0.5
+    X2 = rng.normal(size=(500, 6)).astype(np.float32)
+    y2 = (10.0 + 0.05 * X2[:, 0]).astype(np.float32)   # mean ~ 10
+    r2 = train(X2, y2, GBDTParams(num_iterations=40, objective="regression",
+                                  max_depth=3, learning_rate=0.3),
+               init_booster=r1.booster)
+    pred = r2.booster.predict(X2)
+    # with the old no-op delta the booster predicted ~0 here (off by ~10)
+    assert abs(float(np.mean(pred)) - 10.0) < 1.0
+
+
+def test_safe_load_refuses_pickle_and_foreign_classes(tmp_path):
+    """ADVICE #5: opt-in safe mode blocks the two code-execution paths."""
+    from mmlspark_tpu.core import serialize
+    from mmlspark_tpu.stages import Lambda
+
+    stage = Lambda(fn=lambda p: p)  # closure payload -> pickle fallback
+    path = str(tmp_path / "lam")
+    serialize.save(stage, path)
+    loaded = serialize.load(path)  # trusted path: works
+    assert isinstance(loaded, Lambda)
+    with pytest.raises(PermissionError):
+        serialize.load(path, safe=True)
+
+    class NotOurs(Lambda):
+        pass
+
+    p2 = str(tmp_path / "foreign")
+    serialize.save(NotOurs(fn=lambda p: p), p2)
+    with pytest.raises(PermissionError):
+        serialize.load(p2, safe=True)
+    serialize.register_loadable_prefix("tests.")
+    try:
+        with pytest.raises(PermissionError):  # still pickled payload inside
+            serialize.load(p2, safe=True)
+    finally:
+        serialize._TRUSTED_PREFIXES.discard("tests.")
