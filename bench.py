@@ -8,18 +8,23 @@ the identical trainer (the reference target is >=8x CPU-executor throughput,
 BASELINE.md).  ResNet-50 featurize images/sec/chip rides in the extras.
 
 Resilience design (round 2, after BENCH_r01 ended rc=124 / parsed=null):
-- a valid JSON result line is printed after EVERY phase, so an outer
-  timeout can never erase completed measurements;
-- the persistent XLA compilation cache is enabled (relay compiles dominated
-  round 1: one conv net took 1502s) and bench shapes match __graft_entry__
-  .entry() exactly, so the driver's compile check pre-warms the cache;
-- the CPU baseline probe runs in a subprocess pinned to the CPU platform
-  with sitecustomize TPU hooks scrubbed; it launches AFTER the timed TPU
-  GBDT phase (host-CPU contention would deflate that phase's host-side
-  binning) and overlaps only the ResNet phase, whose host work is
-  negligible;
-- phase deadlines keep the worst case under ~800s;
-- timed loops vary their inputs every step and end with a host fetch: the
+
+- The PARENT process never touches the device.  Every TPU phase runs in a
+  child process with a parent-side wall-clock kill: a wedged device relay
+  (observed: jax.devices() itself can block forever, and SIGALRM cannot
+  preempt a blocked relay RPC) costs one child, never the bench.
+- A valid JSON result line is printed after EVERY phase, so an outer
+  timeout can never erase completed measurements.
+- A 120s health-check child gates the TPU phases: if a trivial matmul
+  cannot complete, TPU phases are skipped with an explanatory note and the
+  CPU baseline still gets measured and reported.
+- The persistent XLA compilation cache is enabled in children, and bench
+  shapes match __graft_entry__.entry() exactly so the driver's compile
+  check pre-warms the cache.
+- The CPU probe runs pinned to the CPU platform with sitecustomize TPU
+  hooks scrubbed, concurrent only with the ResNet phase (host contention
+  would skew the GBDT phase's host-side binning).
+- Timed loops vary their inputs every step and end with a host fetch: the
   relay can serve repeated (computation, args) pairs from cache without
   executing (.claude/skills/verify/SKILL.md).
 """
@@ -30,8 +35,6 @@ import os
 import subprocess
 import sys
 import time
-
-import numpy as np
 
 _REPO = os.path.dirname(os.path.abspath(__file__))
 
@@ -52,30 +55,53 @@ def _log(msg) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def gbdt_rows_per_sec(n=1_000_000, f=200, iters_a=2, iters_b=12) -> float:
+# --------------------------------------------------------------------------
+# phase bodies (run inside child processes; print MARKER lines on stdout)
+# --------------------------------------------------------------------------
+
+def phase_health() -> None:
+    """Trivial device round trip — proves the relay can compile + execute."""
+    from __graft_entry__ import enable_compilation_cache
+    enable_compilation_cache()
+    import jax
+    import jax.numpy as jnp
+    x = jnp.ones((256, 256))
+    val = float((x @ x).sum())
+    print(f"HEALTH_OK {val}", flush=True)
+
+
+def phase_gbdt(n=1_000_000, f=200, iters_a=2, iters_b=12) -> None:
     """Marginal boosting rate: rows * (B - A) / (t_B - t_A).  Subtracts the
-    shared fixed costs (compile — cached across runs since the jitted
+    shared fixed costs (compile — cached across calls since the jitted
     per-iteration program's key excludes num_iterations — binning, host->
     device transfer), leaving the steady-state training rate both backends
     are judged by.  Scores evolve every iteration, so each dispatch is a
     distinct (computation, args) pair — no relay result caching."""
+    from __graft_entry__ import enable_compilation_cache
+    enable_compilation_cache()
+    import numpy as np
     from mmlspark_tpu.lightgbm import GBDTParams, train
     rng = np.random.default_rng(0)
     X = rng.normal(size=(n, f)).astype(np.float32)
     y = (X[:, 0] + 0.5 * X[:, 1] + rng.normal(scale=0.3, size=n) > 0).astype(np.float32)
-    train(X, y, GBDTParams(num_iterations=1, objective="binary", max_depth=5))  # compile
+    t0 = time.perf_counter()
+    train(X, y, GBDTParams(num_iterations=1, objective="binary", max_depth=5))
+    _log(f"[bench] gbdt warm(compile) {time.perf_counter() - t0:.0f}s")
     t0 = time.perf_counter()
     train(X, y, GBDTParams(num_iterations=iters_a, objective="binary", max_depth=5))
     t_a = time.perf_counter() - t0
     t0 = time.perf_counter()
     train(X, y, GBDTParams(num_iterations=iters_b, objective="binary", max_depth=5))
     t_b = time.perf_counter() - t0
-    return n * (iters_b - iters_a) / max(t_b - t_a, 1e-9)
+    rps = n * (iters_b - iters_a) / max(t_b - t_a, 1e-9)
+    print(f"GBDT_RPS {rps} {n}", flush=True)
 
 
-def resnet_images_per_sec(batch=32, steps=10, hw=224) -> float:
+def phase_resnet(batch=32, steps=10, hw=224) -> None:
     """Same program as __graft_entry__.entry() (shapes, dtype, step-scalar),
     so the driver's compile check warms the persistent cache for this."""
+    from __graft_entry__ import enable_compilation_cache
+    enable_compilation_cache()
     import jax
     import jax.numpy as jnp
     from mmlspark_tpu.models import resnet50
@@ -92,144 +118,141 @@ def resnet_images_per_sec(batch=32, steps=10, hw=224) -> float:
         return module.apply(variables, image_ops.normalize(batch + step),
                             features=True)
 
-    # warm the EXACT benched shape; host fetch forces remote execution
-    float(featurize(variables, x, jnp.float32(-1.0)).sum())
+    t0 = time.perf_counter()
+    float(featurize(variables, x, jnp.float32(-1.0)).sum())  # warm, forced
+    _log(f"[bench] resnet warm(compile) {time.perf_counter() - t0:.0f}s")
     t0 = time.perf_counter()
     out = None
     for i in range(steps):
         out = featurize(variables, x, jnp.float32(i))  # distinct args/step
     float(out.sum())  # drain the async dispatch queue
-    return batch * steps / (time.perf_counter() - t0)
+    ips = batch * steps / (time.perf_counter() - t0)
+    print(f"IMAGES_SEC {ips}", flush=True)
 
 
-_CPU_PROBE_CODE = r"""
-import os
-os.environ['JAX_PLATFORMS'] = 'cpu'
-import jax
-jax.config.update('jax_platforms', 'cpu')
-import numpy as np, time, sys
-sys.path.insert(0, {repo!r})
-from mmlspark_tpu.lightgbm import GBDTParams, train
-rng = np.random.default_rng(0)
-n, f = 200_000, 200
-X = rng.normal(size=(n, f)).astype(np.float32)
-y = (X[:, 0] + 0.5 * X[:, 1] + rng.normal(scale=0.3, size=n) > 0).astype(np.float32)
-train(X, y, GBDTParams(num_iterations=1, objective='binary', max_depth=5))
-t0 = time.perf_counter()
-train(X, y, GBDTParams(num_iterations=2, objective='binary', max_depth=5))
-ta = time.perf_counter() - t0
-t0 = time.perf_counter()
-train(X, y, GBDTParams(num_iterations=7, objective='binary', max_depth=5))
-tb = time.perf_counter() - t0
-print('CPU_RPS', n * 5 / max(tb - ta, 1e-9), flush=True)
-"""
+def phase_cpu(n=200_000, f=200) -> None:
+    """CPU-executor baseline: identical trainer on the host CPU."""
+    import numpy as np
+    from mmlspark_tpu.lightgbm import GBDTParams, train
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] + rng.normal(scale=0.3, size=n) > 0).astype(np.float32)
+    train(X, y, GBDTParams(num_iterations=1, objective="binary", max_depth=5))
+    t0 = time.perf_counter()
+    train(X, y, GBDTParams(num_iterations=2, objective="binary", max_depth=5))
+    ta = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    train(X, y, GBDTParams(num_iterations=7, objective="binary", max_depth=5))
+    tb = time.perf_counter() - t0
+    print(f"CPU_RPS {n * 5 / max(tb - ta, 1e-9)}", flush=True)
 
 
-def launch_cpu_probe() -> subprocess.Popen:
-    """CPU-executor baseline: identical trainer in a subprocess pinned to the
-    CPU platform.  Runs concurrently with the TPU phases (it shares no
-    device); PYTHONPATH is scrubbed so sitecustomize's TPU hooks never touch
-    the relay from this process."""
+# --------------------------------------------------------------------------
+# parent orchestration
+# --------------------------------------------------------------------------
+
+def _tpu_env() -> dict:
+    return dict(os.environ)
+
+
+def _cpu_env() -> dict:
     env = {k: v for k, v in os.environ.items()
-           if not k.startswith(("TPU", "AXON"))}
-    env.pop("PYTHONPATH", None)
+           if not k.startswith(("TPU", "AXON", "PALLAS_AXON"))}
+    env.pop("PYTHONPATH", None)  # drop sitecustomize TPU hooks
     env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _spawn(phase: str, env: dict, extra_args=()) -> subprocess.Popen:
     return subprocess.Popen(
-        [sys.executable, "-c", _CPU_PROBE_CODE.replace("{repo!r}", repr(_REPO))],
-        cwd=_REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        [sys.executable, os.path.abspath(__file__), "--phase", phase,
+         *extra_args],
+        cwd=_REPO, env=env, stdout=subprocess.PIPE, stderr=sys.stderr,
         text=True)
 
 
-def collect_cpu_probe(proc: subprocess.Popen, timeout: float) -> float:
+def _collect(proc: subprocess.Popen, marker: str, timeout: float):
+    """Wait for the child; return the marker line's floats or None.  A hung
+    child is killed — the relay may already be wedged at that point, and a
+    salvaged partial result beats an erased bench."""
     try:
         out, _ = proc.communicate(timeout=timeout)
-        for line in out.splitlines():
-            if line.startswith("CPU_RPS"):
-                return float(line.split()[1])
     except subprocess.TimeoutExpired:
         proc.kill()
-        _log("[bench] cpu probe timed out")
-    except Exception as e:  # noqa: BLE001
-        _log(f"[bench] cpu probe failed: {e}")
-    return 0.0
-
-
-class _PhaseTimeout(Exception):
-    pass
-
-
-def _with_deadline(fn, seconds, default=None):
-    """Run fn() under a SIGALRM deadline so one wedged device phase can't
-    consume the whole outer budget (note: the alarm cannot preempt a blocked
-    relay RPC — it fires when control returns to Python — which is why the
-    risky phases run LAST and results are emitted incrementally)."""
-    import signal
-
-    def handler(signum, frame):
-        raise _PhaseTimeout()
-
-    old = signal.signal(signal.SIGALRM, handler)
-    signal.alarm(int(seconds))
-    try:
-        return fn()
-    except _PhaseTimeout:
-        _log(f"[bench] phase timed out after {seconds}s")
-        return default
-    except Exception as e:  # noqa: BLE001
-        _log(f"[bench] phase failed: {e}")
-        return default
-    finally:
-        signal.alarm(0)
-        signal.signal(signal.SIGALRM, old)
+        _log(f"[bench] phase {marker} timed out after {timeout:.0f}s; killed")
+        try:  # reap + salvage anything already printed (a child can finish
+            out, _ = proc.communicate(timeout=10)  # its work then wedge in
+        except Exception:  # noqa: BLE001          # relay teardown at exit)
+            return None
+    for line in (out or "").splitlines():
+        if line.startswith(marker):
+            return [float(v) for v in line.split()[1:]]
+    _log(f"[bench] phase {marker} exited rc={proc.returncode} without result")
+    return None
 
 
 def main() -> None:
-    import gc
-    from __graft_entry__ import enable_compilation_cache
-    enable_compilation_cache()
     wall0 = time.perf_counter()
 
-    # Phase 1 — headline metric: GBDT rows/sec on the real chip (no other
-    # process competes for host CPU during its timed window).
-    t0 = time.perf_counter()
-    tpu_rps = _with_deadline(gbdt_rows_per_sec, 330)
-    scaled = False
-    if tpu_rps is None:  # degraded fallback: quarter-size, same trainer
-        tpu_rps = _with_deadline(
-            lambda: gbdt_rows_per_sec(n=250_000, iters_b=10), 150, default=0.0)
-        scaled = tpu_rps > 0
-    _log(f"[bench] gbdt tpu done in {time.perf_counter() - t0:.0f}s")
-    RESULT["value"] = round(tpu_rps, 1)
-    if scaled:
+    # Phase 0 — relay health gate.
+    health = _collect(_spawn("health", _tpu_env()), "HEALTH_OK", 150)
+    _log(f"[bench] health: {'ok' if health else 'FAILED'} "
+         f"({time.perf_counter() - wall0:.0f}s)")
+    tpu_ok = health is not None
+    if not tpu_ok:
         RESULT["extras"]["note"] = (
-            "measured at 250k x 200 (1M deadline exceeded); rows/sec is the "
-            "steady-state marginal rate, which scales ~linearly in rows")
-    _emit()
+            "TPU device relay unreachable (health matmul did not complete "
+            "in 150s); TPU phases skipped, CPU baseline only")
+        _emit()
 
-    # Phase 2 — ResNet-50 featurize.  The CPU probe overlaps this phase only
-    # (its host work is a handful of dispatches).  GBDT host buffers are
-    # dropped first: round 1 observed inference degradation after the 1M-row
-    # dataset, so reclaim host/device memory before timing inference.
-    cpu_proc = launch_cpu_probe()
-    gc.collect()
-    t0 = time.perf_counter()
-    images_sec = _with_deadline(resnet_images_per_sec, 240)
-    _log(f"[bench] resnet done in {time.perf_counter() - t0:.0f}s")
-    if images_sec:
-        RESULT["extras"]["resnet50_featurize_images_per_sec_per_chip"] = round(
-            images_sec, 1)
-    _emit()
+    tpu_rps = 0.0
+    if tpu_ok:
+        # Phase 1 — headline metric: GBDT rows/sec on the real chip.
+        got = _collect(_spawn("gbdt", _tpu_env()), "GBDT_RPS", 420)
+        if got is None:  # degraded fallback: quarter-size, same trainer
+            got = _collect(_spawn("gbdt", _tpu_env(),
+                                  ["--n", "250000", "--iters_b", "10"]),
+                           "GBDT_RPS", 240)
+            if got:
+                RESULT["extras"]["note"] = (
+                    "measured at 250k x 200 (1M run exceeded its deadline); "
+                    "rows/sec is the steady-state marginal rate, ~linear in rows")
+        if got:
+            tpu_rps = got[0]
+            RESULT["value"] = round(tpu_rps, 1)
+        _emit()
 
-    # Phase 3 — CPU-executor baseline (collect; it ran during phase 2).
-    remaining = max(60.0, 780.0 - (time.perf_counter() - wall0))
-    cpu_rps = collect_cpu_probe(cpu_proc, remaining)
-    _log(f"[bench] cpu probe: {cpu_rps:.0f} rows/sec")
-    if cpu_rps:
+    # Phase 2 — CPU baseline launches now; concurrent only with ResNet.
+    cpu_proc = _spawn("cpu", _cpu_env())
+
+    if tpu_ok:
+        # Phase 3 — ResNet-50 featurize (riskiest compile last).
+        got = _collect(_spawn("resnet", _tpu_env()), "IMAGES_SEC", 300)
+        if got:
+            RESULT["extras"]["resnet50_featurize_images_per_sec_per_chip"] = \
+                round(got[0], 1)
+        _emit()
+
+    # Phase 4 — collect the CPU baseline.
+    remaining = max(60.0, 840.0 - (time.perf_counter() - wall0))
+    got = _collect(cpu_proc, "CPU_RPS", remaining)
+    if got:
+        cpu_rps = got[0]
         RESULT["extras"]["cpu_executor_rows_per_sec"] = round(cpu_rps, 1)
         if tpu_rps:
             RESULT["vs_baseline"] = round(tpu_rps / cpu_rps, 3)
     _emit()
+    _log(f"[bench] done in {time.perf_counter() - wall0:.0f}s")
 
 
 if __name__ == "__main__":
-    main()
+    if "--phase" in sys.argv:
+        args = sys.argv[sys.argv.index("--phase") + 1:]
+        phase, rest = args[0], args[1:]
+        kw = {}
+        for i in range(0, len(rest) - 1, 2):
+            kw[rest[i].lstrip("-")] = int(rest[i + 1])
+        {"health": phase_health, "gbdt": phase_gbdt,
+         "resnet": phase_resnet, "cpu": phase_cpu}[phase](**kw)
+    else:
+        main()

@@ -2,6 +2,8 @@ from .jax_model import JaxModel, FlaxModelPayload
 from .image_featurizer import ImageFeaturizer
 from .model_downloader import ModelDownloader, ModelRepo, ModelSchema
 from .torch_import import torch_to_jax, torch_to_jax_model
+from .onnx_import import (OnnxModelPayload, onnx_to_jax, onnx_to_jax_model)
 
 __all__ = ["JaxModel", "FlaxModelPayload", "ImageFeaturizer", "ModelDownloader",
-           "ModelRepo", "ModelSchema", "torch_to_jax", "torch_to_jax_model"]
+           "ModelRepo", "ModelSchema", "torch_to_jax", "torch_to_jax_model",
+           "OnnxModelPayload", "onnx_to_jax", "onnx_to_jax_model"]

@@ -35,29 +35,49 @@ class ImageFeaturizer(Model, HasInputCol, HasOutputCol):
         if kwargs:
             self.set_params(**kwargs)
 
-    def set_model(self, module=None, variables=None, apply_fn=None, apply_kwargs=None):
-        self.set("model", FlaxModelPayload(module, variables, apply_fn, apply_kwargs))
+    def set_model(self, module=None, variables=None, apply_fn=None, apply_kwargs=None,
+                  payload=None):
+        """Accepts a flax module / raw apply_fn (wrapped in FlaxModelPayload)
+        or a ready payload — including ``OnnxModelPayload`` for pretrained
+        imported graphs (head truncation then happens at import time via
+        ``cut_layers``, the ``cutOutputLayers`` analogue)."""
+        if payload is None:
+            payload = FlaxModelPayload(module, variables, apply_fn, apply_kwargs)
+        self.set("model", payload)
         return self
 
     def _build_runner(self) -> JaxModel:
-        payload: FlaxModelPayload = self.get_or_fail("model")
+        from .onnx_import import OnnxModelPayload
+        payload = self.get_or_fail("model")
         h, w = self.get("height"), self.get("width")
         cut = self.get("cut_output_layers")
         norm = self.get("auto_convert")
+        is_onnx = isinstance(payload, OnnxModelPayload)
+        if is_onnx and cut > 0 and not payload.cut_layers \
+                and not payload.output_names:
+            # honor cut_output_layers for uncut ONNX graphs by re-importing
+            # with the head dropped (the payload's own truncation wins when
+            # it was imported pre-cut)
+            payload = OnnxModelPayload(payload.model_bytes, cut_layers=cut)
         base = payload.pure_apply
         base_kwargs = dict(payload.apply_kwargs)
-        if payload.module is not None:
+        if getattr(payload, "module", None) is not None:
             module = payload.module
             def base(variables, batch, _m=module, _kw=base_kwargs):
                 return _m.apply(variables, batch, features=(cut > 0), **_kw)
 
         def fused(variables, batch):
-            x = batch
+            x = batch                       # NHWC column convention
             if x.shape[1] != h or x.shape[2] != w:
                 x = image_ops.resize(x, h, w)
             if norm:
                 x = image_ops.normalize(x)
-            return base(variables, x)
+            if is_onnx:                     # ONNX graphs run native NCHW
+                x = x.transpose(0, 3, 1, 2)
+            out = base(variables, x)
+            if is_onnx and getattr(out, "ndim", 2) > 2:
+                out = out.reshape(out.shape[0], -1)  # pooled feature maps
+            return out
 
         runner = JaxModel()
         runner.set_model(apply_fn=fused, variables=payload.variables)

@@ -79,8 +79,30 @@ class ModelRepo:
             f.write(schema.to_json())
         return path
 
-    def load_model(self, name: str) -> FlaxModelPayload:
-        path = os.path.join(self.root, name, "checkpoint")
+    def save_onnx_model(self, schema: ModelSchema, model_bytes: bytes,
+                        cut_layers: int = 0) -> str:
+        """Register a pretrained ONNX model file (the reference repo stores
+        serialized graph files + JSON schema, ``ModelDownloader.scala:26``).
+        Writes the artifact directly — the graph is decoded once, at load."""
+        path = os.path.join(self.root, schema.name)
+        onnx_dir = os.path.join(path, "onnx")
+        os.makedirs(onnx_dir, exist_ok=True)
+        with open(os.path.join(onnx_dir, "model.onnx"), "wb") as f:
+            f.write(model_bytes)
+        with open(os.path.join(onnx_dir, "meta.json"), "w") as f:
+            json.dump({"cut_layers": cut_layers, "output_names": None}, f)
+        schema.uri = onnx_dir
+        with open(os.path.join(path, "schema.json"), "w") as f:
+            f.write(schema.to_json())
+        return path
+
+    def load_model(self, name: str):
+        base = os.path.join(self.root, name)
+        onnx_dir = os.path.join(base, "onnx")
+        if os.path.exists(os.path.join(onnx_dir, "model.onnx")):
+            from .onnx_import import OnnxModelPayload
+            return OnnxModelPayload.load(onnx_dir)
+        path = os.path.join(base, "checkpoint")
         if not os.path.exists(path):
             raise FileNotFoundError(f"model '{name}' not in repo {self.root}")
         return FlaxModelPayload.load(path)
@@ -93,6 +115,21 @@ class ModelDownloader:
 
     def __init__(self, local_cache: Optional[str] = None):
         self.repo = ModelRepo(local_cache) if local_cache else None
+
+    def import_onnx(self, name: str, source: "bytes | str",
+                    cut_layers: int = 0, input_shape: Optional[List[int]] = None):
+        """Register a pretrained ONNX file (path or bytes) under ``name`` —
+        the zero-egress analogue of the reference's remote fetch: the user
+        supplies the artifact, the repo caches it with its schema."""
+        if self.repo is None:
+            raise ValueError("ModelDownloader needs a local_cache to import into")
+        if isinstance(source, str):
+            with open(source, "rb") as f:
+                source = f.read()
+        schema = ModelSchema(name=name, input_shape=input_shape,
+                             model_type="onnx")
+        self.repo.save_onnx_model(schema, source, cut_layers=cut_layers)
+        return self.repo.load_model(name)
 
     def download_by_name(self, name: str, seed: int = 0, **model_kwargs) -> FlaxModelPayload:
         if self.repo is not None:

@@ -1,0 +1,412 @@
+"""ONNX graph import — serialized model files -> pure JAX apply functions.
+
+Reference capability: ``CNTKModel`` evaluates externally-trained serialized
+graphs on executors (``deep-learning/.../cntk/CNTKModel.scala:88-140``) and
+``ImageFeaturizer`` runs *pretrained* zoo models (``ImageFeaturizer.scala:41``,
+``downloader/ModelDownloader.scala:26``).  Here the interchange format is
+ONNX: ``onnx_to_jax`` decodes a ModelProto (via the dependency-free wire
+codec in ``onnx_wire``) and builds a jittable ``apply_fn(variables, *inputs)``
+whose ops run in the graph's native layout (NCHW for vision models — XLA
+lays out for the MXU itself, no host-side transposition needed).
+
+Supported op set (the Conv/BN/Gemm/Pool/LSTM/activations scope the zoo
+models need, same coverage philosophy as ``torch_import``): Conv,
+BatchNormalization, Gemm, MatMul, LSTM (uni/bidirectional), MaxPool,
+AveragePool, GlobalAveragePool, Relu/LeakyRelu/Sigmoid/Tanh/Softmax/Erf/
+Gelu-decomposition, elementwise arithmetic, Clip, Concat, Flatten, Reshape,
+Transpose, Squeeze/Unsqueeze, Pad, Slice, Gather, Shape, Cast, Constant,
+ConstantOfShape, ReduceMean, Dropout/Identity (inference no-ops).
+
+Static-shape machinery (Shape -> Gather -> Concat -> Reshape chains emitted
+by exporters) is evaluated on the HOST with numpy — under ``jit`` every
+shape is static, so these fold to constants instead of polluting the traced
+program with dynamic ops.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .onnx_wire import Graph, Node, parse_model
+
+_HOST_OPS = {"Shape", "Constant", "ConstantOfShape", "Range"}
+
+
+def _is_host(*vals) -> bool:
+    return all(isinstance(v, (np.ndarray, np.generic, int, float)) or v is None
+               for v in vals)
+
+
+def _pool_dims(node: Node, rank: int):
+    k = node.attr_ints("kernel_shape")
+    s = node.attr_ints("strides", [1] * len(k))
+    p = node.attr_ints("pads", [0] * (2 * len(k)))
+    half = len(p) // 2
+    pads = list(zip(p[:half], p[half:]))
+    window = (1, 1) + tuple(k)
+    strides = (1, 1) + tuple(s)
+    padding = ((0, 0), (0, 0)) + tuple(pads)
+    return window, strides, padding
+
+
+def _eval_node(node: Node, env: Dict[str, Any], jnp, jax):
+    op = node.op_type
+    ins = [env[n] if n else None for n in node.inputs]
+    host = op in _HOST_OPS or (_is_host(*ins) and op in (
+        "Gather", "Concat", "Unsqueeze", "Squeeze", "Slice", "Cast", "Add",
+        "Sub", "Mul", "Div", "Reshape", "Transpose", "Identity"))
+    xp = np if host else jnp
+    x = ins[0] if ins else None
+
+    if op in ("Identity", "Dropout"):
+        return x
+    if op == "Constant":
+        a = node.attrs.get("value")
+        if a is not None and a.t is not None:
+            return a.t
+        if "value_float" in node.attrs:
+            return np.float32(node.attrs["value_float"].f)
+        if "value_int" in node.attrs:
+            return np.int64(node.attrs["value_int"].i)
+        if "value_floats" in node.attrs:
+            return np.asarray(node.attrs["value_floats"].floats, np.float32)
+        if "value_ints" in node.attrs:
+            return np.asarray(node.attrs["value_ints"].ints, np.int64)
+        raise NotImplementedError("Constant without tensor value")
+    if op == "Shape":
+        return np.asarray(x.shape, np.int64)
+    if op == "ConstantOfShape":
+        a = node.attrs.get("value")
+        fill = a.t.reshape(-1)[0] if a is not None and a.t is not None else np.float32(0)
+        return np.full(tuple(int(d) for d in np.asarray(x).reshape(-1)), fill)
+    if op == "Cast":
+        from .onnx_wire import DTYPES
+        return xp.asarray(x).astype(DTYPES[node.attr_i("to", 1)])
+    if op == "Conv":
+        w = ins[1]
+        group = node.attr_i("group", 1)
+        spatial = w.ndim - 2
+        s = node.attr_ints("strides", [1] * spatial)
+        d = node.attr_ints("dilations", [1] * spatial)
+        p = node.attr_ints("pads", [0] * (2 * spatial))
+        if node.attr_s("auto_pad", "NOTSET") not in ("NOTSET", ""):
+            raise NotImplementedError("Conv auto_pad")
+        pads = list(zip(p[:spatial], p[spatial:]))
+        dn = ("NCHW", "OIHW", "NCHW") if spatial == 2 else \
+            (("NCW", "OIW", "NCW") if spatial == 1 else ("NCDHW", "OIDHW", "NCDHW"))
+        out = jax.lax.conv_general_dilated(
+            x, jnp.asarray(w), window_strides=tuple(s), padding=pads,
+            rhs_dilation=tuple(d), dimension_numbers=dn,
+            feature_group_count=group)
+        if len(ins) > 2 and ins[2] is not None:
+            out = out + jnp.asarray(ins[2]).reshape((1, -1) + (1,) * spatial)
+        return out
+    if op == "BatchNormalization":
+        scale, bias, mean, var = (jnp.asarray(v) for v in ins[1:5])
+        eps = node.attr_f("epsilon", 1e-5)
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        inv = scale / jnp.sqrt(var + eps)
+        return x * inv.reshape(shape) + (bias - mean * inv).reshape(shape)
+    if op == "Gemm":
+        a, b = x, ins[1]
+        if node.attr_i("transA"):
+            a = a.T
+        if node.attr_i("transB"):
+            b = jnp.asarray(b).T
+        out = node.attr_f("alpha", 1.0) * (a @ b)
+        if len(ins) > 2 and ins[2] is not None:
+            out = out + node.attr_f("beta", 1.0) * jnp.asarray(ins[2])
+        return out
+    if op == "MatMul":
+        return x @ ins[1]
+    if op == "Relu":
+        return jax.nn.relu(x)
+    if op == "LeakyRelu":
+        return jax.nn.leaky_relu(x, node.attr_f("alpha", 0.01))
+    if op == "Sigmoid":
+        return jax.nn.sigmoid(x)
+    if op == "Tanh":
+        return jnp.tanh(x)
+    if op == "Erf":
+        return jax.scipy.special.erf(x)
+    if op == "Softmax":
+        return jax.nn.softmax(x, axis=node.attr_i("axis", -1))
+    if op == "Exp":
+        return jnp.exp(x)
+    if op == "Sqrt":
+        return jnp.sqrt(x)
+    if op == "Reciprocal":
+        return 1.0 / x
+    if op == "Neg":
+        return -x
+    if op == "Abs":
+        return jnp.abs(x)
+    if op == "Pow":
+        return x ** ins[1]
+    if op in ("Add", "Sub", "Mul", "Div"):
+        b = ins[1]
+        return {"Add": lambda: x + b, "Sub": lambda: x - b,
+                "Mul": lambda: x * b, "Div": lambda: x / b}[op]()
+    if op == "Clip":
+        lo = ins[1] if len(ins) > 1 and ins[1] is not None else node.attrs.get("min")
+        hi = ins[2] if len(ins) > 2 and ins[2] is not None else node.attrs.get("max")
+        lo = lo.f if hasattr(lo, "f") else lo
+        hi = hi.f if hasattr(hi, "f") else hi
+        return jnp.clip(x, lo, hi)
+    if op in ("MaxPool", "AveragePool"):
+        if node.attr_i("ceil_mode"):
+            raise NotImplementedError("ceil_mode pooling")
+        window, strides, padding = _pool_dims(node, x.ndim)
+        if op == "MaxPool":
+            return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window,
+                                         strides, padding)
+        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides,
+                                       padding)
+        if node.attr_i("count_include_pad"):
+            denom = float(np.prod(window))
+        else:  # divide by the number of REAL elements under each window
+            ones = jnp.ones(x.shape[2:], x.dtype)[None, None]
+            denom = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                          strides, padding)
+        return summed / denom
+    if op == "GlobalAveragePool":
+        return x.mean(axis=tuple(range(2, x.ndim)), keepdims=True)
+    if op == "Flatten":
+        ax = node.attr_i("axis", 1)
+        lead = int(np.prod(x.shape[:ax])) if ax else 1
+        return x.reshape(lead, -1)
+    if op == "Reshape":
+        target = [int(d) for d in np.asarray(ins[1]).reshape(-1)]
+        target = [x.shape[i] if d == 0 else d for i, d in enumerate(target)]
+        return xp.reshape(x, target)
+    if op == "Transpose":
+        perm = node.attr_ints("perm", list(range(x.ndim))[::-1])
+        return xp.transpose(x, perm)
+    if op == "Concat":
+        arrs = [v for v in ins if v is not None]
+        return xp.concatenate(arrs, axis=node.attr_i("axis"))
+    if op in ("Squeeze", "Unsqueeze"):
+        axes = node.attr_ints("axes") or (
+            [int(d) for d in np.asarray(ins[1]).reshape(-1)] if len(ins) > 1 else [])
+        if op == "Squeeze":
+            return xp.squeeze(x, axis=tuple(axes) if axes else None)
+        for ax in sorted(axes):
+            x = xp.expand_dims(x, ax)
+        return x
+    if op == "Gather":
+        idx = np.asarray(ins[1]) if _is_host(ins[1]) else ins[1]
+        return xp.take(x, idx, axis=node.attr_i("axis", 0))
+    if op == "Slice":
+        if len(ins) > 1:  # opset 10+: tensors
+            starts = [int(v) for v in np.asarray(ins[1]).reshape(-1)]
+            ends = [int(v) for v in np.asarray(ins[2]).reshape(-1)]
+            axes = ([int(v) for v in np.asarray(ins[3]).reshape(-1)]
+                    if len(ins) > 3 and ins[3] is not None else list(range(len(starts))))
+            steps = ([int(v) for v in np.asarray(ins[4]).reshape(-1)]
+                     if len(ins) > 4 and ins[4] is not None else [1] * len(starts))
+        else:
+            starts = node.attr_ints("starts")
+            ends = node.attr_ints("ends")
+            axes = node.attr_ints("axes", list(range(len(starts))))
+            steps = [1] * len(starts)
+        sl = [slice(None)] * x.ndim
+        for st, en, ax, sp in zip(starts, ends, axes, steps):
+            sl[ax] = slice(st, None if en >= 2 ** 31 - 1 else en, sp)
+        return x[tuple(sl)]
+    if op == "Pad":
+        mode = node.attr_s("mode", "constant")
+        if mode != "constant":
+            raise NotImplementedError(f"Pad mode {mode}")
+        if len(ins) > 1 and ins[1] is not None:
+            p = [int(v) for v in np.asarray(ins[1]).reshape(-1)]
+            cval = float(np.asarray(ins[2]).reshape(-1)[0]) \
+                if len(ins) > 2 and ins[2] is not None else 0.0
+        else:
+            p = node.attr_ints("pads")
+            cval = node.attr_f("value", 0.0)
+        half = len(p) // 2
+        return jnp.pad(x, list(zip(p[:half], p[half:])), constant_values=cval)
+    if op == "ReduceMean":
+        axes = node.attr_ints("axes") or (
+            [int(d) for d in np.asarray(ins[1]).reshape(-1)]
+            if len(ins) > 1 and ins[1] is not None else None)
+        keep = bool(node.attr_i("keepdims", 1))
+        return x.mean(axis=tuple(axes) if axes else None, keepdims=keep)
+    if op == "LSTM":
+        return _lstm(node, ins, jnp, jax)
+    raise NotImplementedError(f"ONNX op {op} not supported "
+                              f"(node {node.name or node.outputs})")
+
+
+def _lstm(node: Node, ins, jnp, jax):
+    """ONNX LSTM: gates iofc, activations sigmoid/tanh/tanh.  Returns the
+    (Y, Y_h, Y_c) triple; unused outputs are dropped by the caller."""
+    X, W, R = ins[0], jnp.asarray(ins[1]), jnp.asarray(ins[2])
+    B = jnp.asarray(ins[3]) if len(ins) > 3 and ins[3] is not None else None
+    if len(ins) > 4 and ins[4] is not None:
+        raise NotImplementedError(
+            "LSTM sequence_lens: variable-length batches are not supported; "
+            "pad to equal length and drop the sequence_lens input")
+    H = node.attr_i("hidden_size", R.shape[-1])
+    direction = node.attr_s("direction", "forward")
+    dirs = 2 if direction == "bidirectional" else 1
+    seq, batch = X.shape[0], X.shape[1]
+    h0 = ins[5] if len(ins) > 5 and ins[5] is not None else \
+        jnp.zeros((dirs, batch, H), X.dtype)
+    c0 = ins[6] if len(ins) > 6 and ins[6] is not None else \
+        jnp.zeros((dirs, batch, H), X.dtype)
+
+    def run_dir(d, reverse):
+        Wd, Rd = W[d], R[d]                       # (4H, in), (4H, H)
+        bd = (B[d][:4 * H] + B[d][4 * H:]) if B is not None else 0.0
+        xs = X[::-1] if reverse else X
+
+        def step(carry, x_t):
+            h, c = carry
+            z = x_t @ Wd.T + h @ Rd.T + bd        # (batch, 4H)
+            i_g = jax.nn.sigmoid(z[:, :H])
+            o_g = jax.nn.sigmoid(z[:, H:2 * H])
+            f_g = jax.nn.sigmoid(z[:, 2 * H:3 * H])
+            c_t = jnp.tanh(z[:, 3 * H:])
+            c = f_g * c + i_g * c_t
+            h = o_g * jnp.tanh(c)
+            return (h, c), h
+
+        (h_T, c_T), ys = jax.lax.scan(step, (jnp.asarray(h0)[d], jnp.asarray(c0)[d]), xs)
+        if reverse:
+            ys = ys[::-1]
+        return ys, h_T, c_T
+
+    outs = [run_dir(0, direction == "reverse")]
+    if dirs == 2:
+        outs.append(run_dir(1, True))
+    Y = jnp.stack([o[0] for o in outs], axis=1)    # (seq, dirs, batch, H)
+    Y_h = jnp.stack([o[1] for o in outs], axis=0)  # (dirs, batch, H)
+    Y_c = jnp.stack([o[2] for o in outs], axis=0)
+    return (Y, Y_h, Y_c)
+
+
+def onnx_to_jax(model: "bytes | str", output_names: Optional[List[str]] = None,
+                cut_layers: int = 0) -> Tuple[Callable, Dict[str, np.ndarray]]:
+    """Decode ONNX bytes (or a file path) into ``(apply_fn, variables)``.
+
+    ``apply_fn(variables, *inputs)`` is jit-compatible; ``variables`` holds
+    the graph initializers (the pretrained weights) keyed by tensor name, so
+    they ride the standard checkpoint/donation paths like any params pytree.
+    Inputs/outputs keep the graph's declared order and native layout.
+
+    ``cut_layers=N`` drops the trailing N nodes and outputs the last kept
+    node's result — the reference ImageFeaturizer's ``cutOutputLayers`` head
+    truncation (``ImageFeaturizer.scala:49-120``); ``output_names`` instead
+    names any intermediate tensors to emit.
+    """
+    if isinstance(model, str):
+        with open(model, "rb") as f:
+            model = f.read()
+    graph = parse_model(model)
+    if cut_layers:
+        if output_names is not None:
+            raise ValueError("pass either cut_layers or output_names")
+        graph.nodes = graph.nodes[:-cut_layers]
+        output_names = [graph.nodes[-1].outputs[0]]
+    # float initializers are the trainable/pretrained WEIGHTS and travel as
+    # the variables pytree; integer/bool initializers are shape machinery
+    # (Reshape targets, Gather indices, axes) and must stay compile-time
+    # host constants — as jit arguments they would become tracers and the
+    # static-shape folding below could not run.
+    variables = {k: v for k, v in graph.initializers.items()
+                 if v.dtype.kind == "f"}
+    consts = {k: v for k, v in graph.initializers.items()
+              if v.dtype.kind != "f"}
+    input_names = [vi.name for vi in graph.inputs
+                   if vi.name not in graph.initializers]
+    if output_names is None:
+        output_names = [vi.name for vi in graph.outputs]
+    nodes = list(graph.nodes)
+
+    def apply_fn(variables, *inputs):
+        import jax
+        import jax.numpy as jnp
+        if len(inputs) != len(input_names):
+            raise ValueError(f"graph takes {input_names}, got {len(inputs)} inputs")
+        env: Dict[str, Any] = dict(consts)
+        env.update(variables)
+        env.update(zip(input_names, inputs))
+        want = set(output_names)
+        for node in nodes:
+            out = _eval_node(node, env, jnp, jax)
+            if isinstance(out, tuple):
+                for name, val in zip(node.outputs, out):
+                    if name:
+                        env[name] = val
+            else:
+                env[node.outputs[0]] = out
+            if want <= env.keys():
+                break  # requested intermediates reached; skip the cut head
+        outs = tuple(env[n] for n in output_names)
+        return outs[0] if len(outs) == 1 else outs
+
+    return apply_fn, variables
+
+
+class OnnxModelPayload:
+    """Saveable bundle around raw ONNX bytes — the pretrained-model artifact
+    the repo stores (reference ``ModelDownloader`` keeps CNTK graph files,
+    ``downloader/ModelDownloader.scala:26``).  ``pure_apply``/``variables``
+    expose the same surface as ``FlaxModelPayload`` so ``JaxModel`` and
+    ``ImageFeaturizer`` take either."""
+
+    def __init__(self, model_bytes: bytes, cut_layers: int = 0,
+                 output_names: Optional[List[str]] = None):
+        self.model_bytes = model_bytes
+        self.cut_layers = cut_layers
+        self.output_names = output_names
+        self.apply_fn, self.variables = onnx_to_jax(
+            model_bytes, output_names=output_names, cut_layers=cut_layers)
+        self.module = None
+        self.apply_kwargs: Dict[str, Any] = {}
+
+    @property
+    def pure_apply(self) -> Callable:
+        return self.apply_fn
+
+    def apply(self, batch):
+        return self.apply_fn(self.variables, batch)
+
+    def save(self, path: str) -> None:
+        import json
+        import os
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "model.onnx"), "wb") as f:
+            f.write(self.model_bytes)
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump({"cut_layers": self.cut_layers,
+                       "output_names": self.output_names}, f)
+
+    @classmethod
+    def load(cls, path: str) -> "OnnxModelPayload":
+        import json
+        import os
+        with open(os.path.join(path, "model.onnx"), "rb") as f:
+            data = f.read()
+        meta = {"cut_layers": 0, "output_names": None}
+        mp = os.path.join(path, "meta.json")
+        if os.path.exists(mp):
+            with open(mp) as f:
+                meta = json.load(f)
+        return cls(data, cut_layers=meta.get("cut_layers", 0),
+                   output_names=meta.get("output_names"))
+
+
+def onnx_to_jax_model(model: "bytes | str", input_col: str = "input",
+                      output_col: str = "output", batch_size: int = 64):
+    """ONNX file -> ready-to-use ``JaxModel`` transformer (the CNTKModel
+    load-a-serialized-graph path, ``CNTKModel.scala:500-545``)."""
+    from .jax_model import JaxModel
+    apply_fn, variables = onnx_to_jax(model)
+    jm = JaxModel()
+    jm.set_model(apply_fn=apply_fn, variables=variables)
+    jm.set_params(input_col=input_col, output_col=output_col,
+                  batch_size=batch_size)
+    return jm

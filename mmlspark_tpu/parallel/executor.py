@@ -96,11 +96,18 @@ def run_local_cluster(fn: Callable, num_processes: int = 2,
         fn_path = os.path.join(d, "fn.pkl")
         try:
             # fn often lives in a driver-side module the workers can't import
-            # (test files, notebooks) — ship it by value
+            # (test files, notebooks) — ship it by value.  Unwrap partials
+            # first: getmodule(partial) is functools itself, and registering
+            # a stdlib module by value breaks cloudpickle.
             import cloudpickle
+            import functools
             import inspect
-            mod = inspect.getmodule(fn)
-            if mod is not None and not mod.__name__.startswith("mmlspark_tpu"):
+            target = fn
+            while isinstance(target, functools.partial):
+                target = target.func
+            mod = inspect.getmodule(target)
+            if mod is not None and not mod.__name__.startswith(("mmlspark_tpu",
+                                                                "functools")):
                 cloudpickle.register_pickle_by_value(mod)
         except Exception:  # noqa: BLE001
             pass

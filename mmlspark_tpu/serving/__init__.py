@@ -1,3 +1,7 @@
 from .server import (PipelineServer, DistributedPipelineServer, ServingStats)
+from .distributed import RoutingClient, TopologyService, WorkerServer
+from .streaming import HTTPStreamSource, StreamingQuery, read_stream
 
-__all__ = ["PipelineServer", "DistributedPipelineServer", "ServingStats"]
+__all__ = ["PipelineServer", "DistributedPipelineServer", "ServingStats",
+           "TopologyService", "WorkerServer", "RoutingClient",
+           "HTTPStreamSource", "StreamingQuery", "read_stream"]

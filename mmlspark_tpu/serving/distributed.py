@@ -1,0 +1,240 @@
+"""Distributed serving topology — driver registry + worker servers + routing.
+
+Reference: Spark Serving v2's driver-side routing service
+(``continuous/HTTPSourceV2.scala:190-196`` ``DriverServiceUtils
+.createDriverService`` announces which executor hosts which partition's
+server so a load balancer can route) and its server/client registries
+(``HTTPSourceStateHolder`` ``:337-371``); the v1 distributed variant shards
+buffered requests across partitions (``DistributedHTTPSource.scala:27-88``
+``MultiChannelMap``).
+
+TPU-native mapping: one ``WorkerServer`` per executor host (each wrapping an
+already-jitted pipeline on that host's chip), a ``TopologyService`` on the
+driver holding the ``server_id -> host:port`` routing table plus aggregated
+stats, and a ``RoutingClient`` that routes by partition key (hash) or round
+robin — the ``MultiChannelMap`` analogue, client-side where the reference
+put it behind an LB.  Workers reply directly on their own sockets
+(continuous-mode semantics: no reply forwarding hop, ``HTTPSinkV2``).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from .server import PipelineServer
+
+
+def _http_json(url: str, payload: Optional[dict] = None, timeout: float = 10.0):
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(url, data=data,
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read().decode() or "null")
+
+
+class TopologyService:
+    """Driver-side registry: workers announce ``server_id -> host:port``;
+    clients fetch the routing table; ``/stats`` aggregates every worker's
+    counters (reference: driver service ``HTTPSourceV2.scala:190`` +
+    state-holder registries ``:337-371``)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host, self.port = host, port
+        self._lock = threading.Lock()
+        self._workers: Dict[str, Dict] = {}
+        self._flags: Dict[str, str] = {}
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    # ------------------------------------------------------------------ http
+    def _make_handler(self):
+        svc = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, status, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length).decode() or "{}")
+                if self.path == "/register":
+                    with svc._lock:
+                        svc._workers[payload["server_id"]] = payload
+                    self._json(200, {"ok": True,
+                                     "num_workers": len(svc._workers)})
+                elif self.path == "/deregister":
+                    with svc._lock:
+                        svc._workers.pop(payload.get("server_id"), None)
+                    self._json(200, {"ok": True})
+                elif self.path == "/flag":
+                    with svc._lock:
+                        svc._flags[payload["key"]] = payload["value"]
+                    self._json(200, {"ok": True})
+                else:
+                    self._json(404, {"error": "not found"})
+
+            def do_GET(self):
+                if self.path == "/routing":
+                    with svc._lock:
+                        table = dict(svc._workers)
+                    self._json(200, table)
+                elif self.path.startswith("/flag/"):
+                    with svc._lock:
+                        self._json(200, {"value": svc._flags.get(self.path[6:])})
+                elif self.path == "/stats":
+                    self._json(200, svc.aggregate_stats())
+                elif self.path == "/health":
+                    self._json(200, {"ok": True})
+                else:
+                    self._json(404, {"error": "not found"})
+
+        return Handler
+
+    # ------------------------------------------------------------------ api
+    def start(self) -> "TopologyService":
+        self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                          self._make_handler())
+        self.port = self._httpd.server_port
+        t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        t.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+    @property
+    def address(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def routing_table(self) -> Dict[str, Dict]:
+        with self._lock:
+            return dict(self._workers)
+
+    def aggregate_stats(self) -> Dict:
+        """Pull and sum every registered worker's counters."""
+        with self._lock:
+            workers = list(self._workers.values())
+        total = {"received": 0, "replied": 0, "errors": 0, "workers": {}}
+        lat_sum = 0.0
+        for w in workers:
+            try:
+                s = _http_json(f"http://{w['host']}:{w['port']}/stats")
+            except Exception as e:  # noqa: BLE001 — a dead worker is a stat
+                total["workers"][w["server_id"]] = {"error": str(e)}
+                continue
+            total["workers"][w["server_id"]] = s
+            total["received"] += s.get("received", 0)
+            total["replied"] += s.get("replied", 0)
+            total["errors"] += s.get("errors", 0)
+            lat_sum += s.get("mean_latency_ms", 0.0) * s.get("replied", 0)
+        if total["replied"]:
+            total["mean_latency_ms"] = lat_sum / total["replied"]
+        return total
+
+
+class WorkerServer:
+    """Executor-side server: a ``PipelineServer`` that registers its
+    ``host:port`` (and owned partition ids) with the driver's topology
+    service at start and deregisters at stop — the worker half of
+    ``HTTPSourceStateHolder`` registration."""
+
+    def __init__(self, model, server_id: str, driver_address: str,
+                 partition_ids: Optional[List[int]] = None, **kw):
+        self.server_id = server_id
+        self.driver_address = driver_address.rstrip("/")
+        self.partition_ids = partition_ids or []
+        self.server = PipelineServer(model, **kw)
+
+    def start(self) -> "WorkerServer":
+        self.server.start()
+        _http_json(f"{self.driver_address}/register",
+                   {"server_id": self.server_id, "host": self.server.host,
+                    "port": self.server.port,
+                    "api_path": self.server.api_path,
+                    "partition_ids": self.partition_ids})
+        return self
+
+    def stop(self) -> None:
+        try:
+            _http_json(f"{self.driver_address}/deregister",
+                       {"server_id": self.server_id})
+        except Exception:  # noqa: BLE001 — driver may already be gone
+            pass
+        self.server.stop()
+
+    @property
+    def address(self) -> str:
+        return self.server.address
+
+
+class RoutingClient:
+    """Client-side router over the driver's table: round robin by default,
+    or deterministic key-hash routing (``MultiChannelMap.nextList``'s
+    request sharding, client-side).  Refreshes the table on demand."""
+
+    def __init__(self, driver_address: str, refresh_s: float = 5.0):
+        self.driver_address = driver_address.rstrip("/")
+        self.refresh_s = refresh_s
+        self._table: List[Dict] = []
+        self._fetched = 0.0
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    def _refresh(self, force: bool = False):
+        now = time.monotonic()
+        if force or not self._table or now - self._fetched > self.refresh_s:
+            table = _http_json(f"{self.driver_address}/routing")
+            with self._lock:
+                self._table = sorted(table.values(),
+                                     key=lambda w: w["server_id"])
+                self._fetched = now
+
+    def _pick(self, key: Optional[str]) -> Dict:
+        self._refresh()
+        with self._lock:
+            if not self._table:
+                raise RuntimeError("no serving workers registered")
+            if key is not None:
+                # stable across processes/restarts (builtin hash is salted),
+                # so partition affinity survives like MultiChannelMap's
+                import zlib
+                return self._table[zlib.crc32(key.encode()) % len(self._table)]
+            w = self._table[self._rr % len(self._table)]
+            self._rr += 1
+            return w
+
+    def request(self, payload, key: Optional[str] = None,
+                timeout: float = 30.0, retries: int = 2):
+        """POST to the routed worker; on connection failure, refresh the
+        table and fail over to the next worker (the LB behavior the
+        reference delegates to Azure LB, ``docs/mmlspark-serving.md:87``)."""
+        last = None
+        for _ in range(retries + 1):
+            w = self._pick(key)
+            url = f"http://{w['host']}:{w['port']}{w.get('api_path', '/score')}"
+            try:
+                return _http_json(url, payload, timeout=timeout)
+            except Exception as e:  # noqa: BLE001 — fail over
+                last = e
+                try:  # a briefly-unreachable driver must not abort the
+                    self._refresh(force=True)  # retry; stale table still works
+                except Exception:  # noqa: BLE001
+                    pass
+                key = None  # reroute away from the dead worker
+        raise RuntimeError(f"all serving workers failed: {last}")
+
+    def stats(self) -> Dict:
+        return _http_json(f"{self.driver_address}/stats")

@@ -1,0 +1,17 @@
+"""Importable-by-worker-process helpers for distributed serving tests."""
+import numpy as np
+
+from mmlspark_tpu.core import DataFrame, Transformer
+
+
+class Doubler(Transformer):
+    """Trivial pipeline stage: reply = 2 * request (numeric JSON)."""
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        def per_part(p):
+            vals = np.asarray([2 * float(v) for v in p["request"]], float)
+            return {**p, "reply": vals}
+        return df.map_partitions(per_part)
+
+    def transform_schema(self, schema):
+        return schema

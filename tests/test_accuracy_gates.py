@@ -1,0 +1,131 @@
+"""Absolute accuracy gates: held-out metrics anchored to sklearn.
+
+Reference: the checked-in benchmark CSVs (``benchmarks_VerifyLightGBM
+Classifier.csv:1-33``) pin 8 real datasets x 4 boosting modes.  Those
+datasets are unreachable offline, so these gates anchor against a
+CROSS-LIBRARY absolute: sklearn's histogram GBDT
+(``HistGradientBoostingClassifier/Regressor`` — the same algorithm family
+LightGBM pioneered) and ``SGDRegressor`` (the VW analogue), trained on
+identical train/test splits.  A repo-side regression that halves model
+quality cannot pass these no matter what the drift CSVs regenerate to.
+
+All metrics are computed on HELD-OUT data (30% split) — AUC, logloss and
+accuracy for classification, L2 for regression.
+"""
+import numpy as np
+import pytest
+
+sklearn = pytest.importorskip("sklearn")
+from sklearn.ensemble import HistGradientBoostingClassifier, HistGradientBoostingRegressor  # noqa: E402
+from sklearn.linear_model import SGDRegressor  # noqa: E402
+from sklearn.metrics import accuracy_score, log_loss, roc_auc_score  # noqa: E402
+from sklearn.model_selection import train_test_split  # noqa: E402
+
+from mmlspark_tpu.core import DataFrame  # noqa: E402
+from mmlspark_tpu.core.schema import vector_column  # noqa: E402
+
+
+def _cls_datasets():
+    out = {}
+    rng = np.random.default_rng(7)
+    n = 2000
+    # noisy linear
+    X = rng.normal(size=(n, 12))
+    y = (X[:, 0] * 2 - X[:, 1] + X[:, 2] + rng.normal(scale=1.5, size=n) > 0)
+    out["noisy_linear"] = (X, y.astype(float))
+    # xor (pure interaction)
+    X = rng.normal(size=(n, 8))
+    y = ((X[:, 0] > 0) ^ (X[:, 1] > 0))
+    flip = rng.random(n) < 0.05
+    out["xor"] = (X, (y ^ flip).astype(float))
+    # concentric rings
+    X = rng.normal(size=(n, 6))
+    r = np.sqrt(X[:, 0] ** 2 + X[:, 1] ** 2 + X[:, 2] ** 2)
+    out["rings"] = (X, (r > np.median(r)).astype(float))
+    return out
+
+
+def _reg_datasets():
+    out = {}
+    rng = np.random.default_rng(17)
+    n = 2000
+    X = rng.normal(size=(n, 10))
+    out["friedman_like"] = (X, 10 * np.sin(np.pi * X[:, 0] * X[:, 1])
+                            + 20 * (X[:, 2] - 0.5) ** 2 + 10 * X[:, 3]
+                            + 5 * X[:, 4] + rng.normal(scale=1.0, size=n))
+    X = rng.normal(size=(n, 8))
+    out["linear_heavy_noise"] = (X, 3 * X[:, 0] - 2 * X[:, 1]
+                                 + rng.normal(scale=2.0, size=n))
+    return out
+
+
+def _frame(X, y):
+    return DataFrame.from_dict({"features": vector_column(list(X)),
+                                "label": y.astype(float)}, 2)
+
+
+def test_gbdt_classifier_matches_sklearn_heldout():
+    from mmlspark_tpu.lightgbm import LightGBMClassifier
+    for name, (X, y) in _cls_datasets().items():
+        Xtr, Xte, ytr, yte = train_test_split(X, y, test_size=0.3,
+                                              random_state=0, stratify=y)
+        clf = LightGBMClassifier().set_params(num_iterations=60, max_depth=5,
+                                              min_data_in_leaf=10, seed=3)
+        model = clf.fit(_frame(Xtr, ytr))
+        out = model.transform(_frame(Xte, yte)).collect()
+        prob = np.stack(list(out["probability"]))[:, 1]
+        pred = np.asarray(out["prediction"], float)
+
+        skl = HistGradientBoostingClassifier(max_iter=60, max_depth=5,
+                                             random_state=0).fit(Xtr, ytr)
+        skl_prob = skl.predict_proba(Xte)[:, 1]
+
+        auc, skl_auc = roc_auc_score(yte, prob), roc_auc_score(yte, skl_prob)
+        ll, skl_ll = log_loss(yte, prob), log_loss(yte, skl_prob)
+        acc = accuracy_score(yte, pred)
+        skl_acc = accuracy_score(yte, skl.predict(Xte))
+        print(f"{name}: auc={auc:.4f} (skl {skl_auc:.4f}) "
+              f"logloss={ll:.4f} (skl {skl_ll:.4f}) acc={acc:.4f} (skl {skl_acc:.4f})")
+        assert auc >= skl_auc - 0.02, f"{name}: AUC {auc} vs sklearn {skl_auc}"
+        assert ll <= skl_ll * 1.3 + 0.05, f"{name}: logloss {ll} vs sklearn {skl_ll}"
+        assert acc >= skl_acc - 0.03, f"{name}: acc {acc} vs sklearn {skl_acc}"
+
+
+def test_gbdt_regressor_matches_sklearn_heldout():
+    from mmlspark_tpu.lightgbm import LightGBMRegressor
+    for name, (X, y) in _reg_datasets().items():
+        Xtr, Xte, ytr, yte = train_test_split(X, y, test_size=0.3, random_state=1)
+        reg = LightGBMRegressor().set_params(num_iterations=80, max_depth=5,
+                                             min_data_in_leaf=10, seed=3)
+        model = reg.fit(_frame(Xtr, ytr))
+        pred = np.asarray(model.transform(_frame(Xte, yte)).collect()["prediction"],
+                          float)
+        skl = HistGradientBoostingRegressor(max_iter=80, max_depth=5,
+                                            random_state=0).fit(Xtr, ytr)
+        l2 = float(np.mean((pred - yte) ** 2))
+        skl_l2 = float(np.mean((skl.predict(Xte) - yte) ** 2))
+        print(f"{name}: L2={l2:.4f} (sklearn {skl_l2:.4f})")
+        assert l2 <= skl_l2 * 1.35 + 0.1, f"{name}: L2 {l2} vs sklearn {skl_l2}"
+
+
+def test_vw_regressor_matches_sgd_heldout():
+    from mmlspark_tpu.vw import VowpalWabbitRegressor
+    X, y = _reg_datasets()["linear_heavy_noise"]
+    Xtr, Xte, ytr, yte = train_test_split(X, y, test_size=0.3, random_state=2)
+
+    def sparse_frame(Xs, ys):
+        col = np.empty(len(Xs), dtype=object)
+        for i in range(len(Xs)):
+            col[i] = {"indices": np.arange(Xs.shape[1], dtype=np.int32),
+                      "values": Xs[i].astype(np.float32)}
+        return DataFrame.from_dict({"features": col, "label": ys}, 2)
+
+    model = VowpalWabbitRegressor().set_params(num_bits=10, num_passes=20) \
+        .fit(sparse_frame(Xtr, ytr))
+    pred = np.asarray(model.transform(sparse_frame(Xte, yte)).to_pandas()["prediction"],
+                      float)
+    skl = SGDRegressor(max_iter=20, random_state=0, tol=None).fit(Xtr, ytr)
+    l2 = float(np.mean((pred - yte) ** 2))
+    skl_l2 = float(np.mean((skl.predict(Xte) - yte) ** 2))
+    print(f"vw L2={l2:.4f} (SGDRegressor {skl_l2:.4f})")
+    assert l2 <= skl_l2 * 1.5 + 0.1, f"VW heldout L2 {l2} vs SGD {skl_l2}"
