@@ -9,6 +9,8 @@ from .services import (TextSentiment, LanguageDetector, EntityDetector, NER,
                        AnalyzeReceipts, AnalyzeBusinessCards, AnalyzeInvoices,
                        AnalyzeIDDocuments, SpeechToText, BingImageSearch)
 from .search import AzureSearchWriter
+from .speech import (SpeechToTextSDK, ConversationTranscription,
+                     StreamingRecognizer, SpeechServingModel)
 
 __all__ = ["CognitiveServicesBase", "TextSentiment", "LanguageDetector",
            "EntityDetector", "NER", "PII", "KeyPhraseExtractor", "OCR",
@@ -18,4 +20,6 @@ __all__ = ["CognitiveServicesBase", "TextSentiment", "LanguageDetector",
            "DetectAnomalies", "Translate", "Transliterate", "BreakSentence",
            "Detect", "AnalyzeLayout", "AnalyzeReceipts",
            "AnalyzeBusinessCards", "AnalyzeInvoices", "AnalyzeIDDocuments",
-           "SpeechToText", "BingImageSearch", "AzureSearchWriter"]
+           "SpeechToText", "BingImageSearch", "AzureSearchWriter",
+           "SpeechToTextSDK", "ConversationTranscription",
+           "StreamingRecognizer", "SpeechServingModel"]
