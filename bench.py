@@ -130,6 +130,30 @@ def phase_resnet(batch=32, steps=10, hw=224) -> None:
     print(f"IMAGES_SEC {ips}", flush=True)
 
 
+def phase_ranker(n=200_000, f=50, group=100, iters_a=2, iters_b=8) -> None:
+    """LambdaRank marginal rows/sec — the lambda pass is device-resident
+    (make_lambdarank_grad_fn), so this measures the fused iteration rate."""
+    from __graft_entry__ import enable_compilation_cache
+    enable_compilation_cache()
+    import numpy as np
+    from mmlspark_tpu.lightgbm import GBDTParams, train
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    rel = (X[:, 0] + 0.3 * rng.normal(size=n) > 0.5).astype(np.float32) \
+        + (X[:, 1] > 1.0)
+    gp = np.arange(0, n + 1, group)
+    p = dict(objective="lambdarank", max_depth=5)
+    train(X, rel, GBDTParams(num_iterations=1, **p), group_ptr=gp)
+    t0 = time.perf_counter()
+    train(X, rel, GBDTParams(num_iterations=iters_a, **p), group_ptr=gp)
+    t_a = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    train(X, rel, GBDTParams(num_iterations=iters_b, **p), group_ptr=gp)
+    t_b = time.perf_counter() - t0
+    print(f"RANKER_RPS {n * (iters_b - iters_a) / max(t_b - t_a, 1e-9)}",
+          flush=True)
+
+
 def phase_cpu(n=200_000, f=200) -> None:
     """CPU-executor baseline: identical trainer on the host CPU."""
     import numpy as np
@@ -226,8 +250,15 @@ def main() -> None:
     cpu_proc = _spawn("cpu", _cpu_env())
 
     if tpu_ok:
-        # Phase 3 — ResNet-50 featurize (riskiest compile last).
-        got = _collect(_spawn("resnet", _tpu_env()), "IMAGES_SEC", 300)
+        # Phase 3 — LambdaRank iteration rate (device-resident lambdas).
+        got = _collect(_spawn("ranker", _tpu_env()), "RANKER_RPS", 180)
+        if got:
+            RESULT["extras"]["lambdarank_train_rows_per_sec_200kx50"] = \
+                round(got[0], 1)
+        _emit()
+
+        # Phase 4 — ResNet-50 featurize (riskiest compile last).
+        got = _collect(_spawn("resnet", _tpu_env()), "IMAGES_SEC", 240)
         if got:
             RESULT["extras"]["resnet50_featurize_images_per_sec_per_chip"] = \
                 round(got[0], 1)
@@ -252,7 +283,7 @@ if __name__ == "__main__":
         kw = {}
         for i in range(0, len(rest) - 1, 2):
             kw[rest[i].lstrip("-")] = int(rest[i + 1])
-        {"health": phase_health, "gbdt": phase_gbdt,
+        {"health": phase_health, "gbdt": phase_gbdt, "ranker": phase_ranker,
          "resnet": phase_resnet, "cpu": phase_cpu}[phase](**kw)
     else:
         main()

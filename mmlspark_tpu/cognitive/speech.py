@@ -91,7 +91,8 @@ class StreamingRecognizer:
     def __init__(self, module=None, variables=None,
                  apply_fn: Optional[Callable] = None,
                  alphabet: str = DEFAULT_ALPHABET, sample_rate: int = 16000,
-                 n_mels: int = 40, chunk_s: float = 0.5, seed: int = 0):
+                 n_mels: int = 40, chunk_s: float = 0.5, seed: int = 0,
+                 hidden_shapes: Optional[List[int]] = None):
         import jax
         import jax.numpy as jnp
         self.alphabet = alphabet
@@ -108,12 +109,13 @@ class StreamingRecognizer:
         if apply_fn is not None:
             self._apply = jax.jit(apply_fn)
             self.variables = variables
-            self._hidden_shapes = None
+            self._hidden_shapes = hidden_shapes
         else:
             self.variables = variables
             self._apply = jax.jit(
                 lambda v, c, f: self.module.apply(v, c, f))
-            self._hidden_shapes = [self.module.hidden_size] * self.module.layers
+            self._hidden_shapes = hidden_shapes or \
+                [self.module.hidden_size] * self.module.layers
         self._jnp = jnp
         self._jax = jax
         self._seed = seed
@@ -121,9 +123,13 @@ class StreamingRecognizer:
     # ---------------------------------------------------------------- state
     def init_carry(self, batch: int = 1):
         jnp = self._jnp
+        if self._hidden_shapes is None:
+            raise ValueError(
+                "carry shapes unknown for an apply_fn-based recognizer: pass "
+                "hidden_shapes=[h1, h2, ...] or override init_carry")
         return tuple((jnp.zeros((batch, h), jnp.float32),
                       jnp.zeros((batch, h), jnp.float32))
-                     for h in (self._hidden_shapes or [128, 128]))
+                     for h in self._hidden_shapes)
 
     def new_state(self) -> RecognitionState:
         carry = self.init_carry(1)
@@ -285,7 +291,13 @@ class SpeechToTextSDK(Transformer, HasInputCol, HasOutputCol):
         return stream
 
     def _events_for(self, rec: StreamingRecognizer, cell) -> List[Dict]:
-        events = list(rec.transcribe_stream(self._stream_for(rec, cell)))
+        # direct synchronous loop — the BlockingQueueIterator thread bridge
+        # is only for the truly streaming transcribe_stream() API
+        stream = self._stream_for(rec, cell)
+        state = rec.new_state()
+        events = [rec.process_chunk(state, chunk)
+                  for chunk in stream.chunks(rec.chunk_samples)]
+        events.append(rec.finish(state))
         if not self.get("detailed"):
             events = [e for e in events if e["status"] == "Recognized"]
         return events
@@ -354,18 +366,23 @@ class SpeechServingModel(Transformer):
         self._lock = threading.Lock()
         self.session_ttl_s = session_ttl_s
 
-    def _state(self, sid: str) -> RecognitionState:
+    def _state(self, sid: str) -> Tuple[RecognitionState, threading.Lock]:
+        """Returns the session's state AND its lock — callers mutate the
+        state (pending buffer, LSTM carry, CTC prev_id) under the session
+        lock so concurrent requests for one session serialize instead of
+        corrupting the transcript."""
         import time
         with self._lock:
             now = time.monotonic()
-            for k in [k for k, (t, _) in self._sessions.items()
+            for k in [k for k, (t, _, _) in self._sessions.items()
                       if now - t > self.session_ttl_s]:
                 del self._sessions[k]
             if sid not in self._sessions:
-                self._sessions[sid] = (now, self.recognizer.new_state())
-            t, st = self._sessions[sid]
-            self._sessions[sid] = (now, st)
-            return st
+                self._sessions[sid] = (now, self.recognizer.new_state(),
+                                       threading.Lock())
+            t, st, lk = self._sessions[sid]
+            self._sessions[sid] = (now, st, lk)
+            return st, lk
 
     def _transform(self, df: DataFrame) -> DataFrame:
         def per_part(p):
@@ -374,29 +391,31 @@ class SpeechServingModel(Transformer):
             for i in range(n):
                 req = p[self.input_col][i]
                 sid = str(req.get("session", "default"))
-                state = self._state(sid)
+                state, session_lock = self._state(sid)
                 rec = self.recognizer
-                # buffer client chunks into fixed device-step sizes so the
-                # compiled shape never changes mid-session (pad frames
-                # would otherwise pollute the LSTM carry)
-                incoming = np.asarray(req.get("chunk", []), np.float32)
-                state.pending = np.concatenate([state.pending, incoming])
-                ev = None
-                while len(state.pending) >= rec.chunk_samples:
-                    full, state.pending = (state.pending[:rec.chunk_samples],
-                                           state.pending[rec.chunk_samples:])
-                    ev = rec.process_chunk(state, full)
-                if req.get("final"):
-                    if len(state.pending):
-                        rec.process_chunk(state, state.pending)
-                        state.pending = np.zeros(0, np.float32)
-                    ev = rec.finish(state)
-                    with self._lock:
-                        self._sessions.pop(sid, None)
-                elif ev is None:  # not enough buffered for a device step yet
-                    ev = {"status": "Buffering", "text": state.text,
-                          "offset": state.frames_seen * rec.hop / rec.sample_rate,
-                          "duration": 0.0, "speaker": state.speaker}
+                with session_lock:
+                    # buffer client chunks into fixed device-step sizes so
+                    # the compiled shape never changes mid-session (pad
+                    # frames would otherwise pollute the LSTM carry)
+                    incoming = np.asarray(req.get("chunk", []), np.float32)
+                    state.pending = np.concatenate([state.pending, incoming])
+                    ev = None
+                    while len(state.pending) >= rec.chunk_samples:
+                        full, state.pending = (state.pending[:rec.chunk_samples],
+                                               state.pending[rec.chunk_samples:])
+                        ev = rec.process_chunk(state, full)
+                    if req.get("final"):
+                        if len(state.pending):
+                            rec.process_chunk(state, state.pending)
+                            state.pending = np.zeros(0, np.float32)
+                        ev = rec.finish(state)
+                        with self._lock:
+                            self._sessions.pop(sid, None)
+                    elif ev is None:  # not enough buffered for a step yet
+                        ev = {"status": "Buffering", "text": state.text,
+                              "offset": state.frames_seen * rec.hop
+                              / rec.sample_rate,
+                              "duration": 0.0, "speaker": state.speaker}
                 out[i] = ev
             return {**p, self.reply_col: out}
 

@@ -2,12 +2,19 @@
 
 Reference: ``core/src/main/python/mmlspark/cyber/anomaly/
 collaborative_filtering.py`` (988 LoC): per-tenant ALS collaborative
-filtering over (user, resource) access counts, complement sampling of
-unobserved pairs as implicit negatives, and score standardisation so higher
-output = more anomalous.
+filtering over (user, resource) access counts, implicit-CF by default
+(``default_apply_implicit_cf``), complement sampling of unobserved pairs as
+explicit negatives otherwise, and score standardisation so higher output =
+more anomalous.
 
-TPU-native: the ALS alternating ridge solves are jitted batched linear
-solves; scoring is a dense factor matmul.
+TPU-native, SPARSE: observations stay in COO form end to end.  Each ALS
+half-step builds per-row normal equations with ``segment_sum`` over the
+nonzeros (chunked so nnz*k^2 never materialises beyond a fixed budget) and
+solves them with one vmapped ``linalg.solve`` — O(nnz k^2 + rows k^3) per
+sweep, never O(users x resources).  Implicit mode is Hu-Koren confidence
+weighting: the all-pairs term collapses to the k x k gram matrix V^T V, so
+unobserved pairs cost nothing.  Scoring uses hash-map index lookups and a
+factor dot per row.
 """
 from __future__ import annotations
 
@@ -18,34 +25,116 @@ import numpy as np
 from ..core import (ComplexParam, DataFrame, Estimator, Model, Param)
 from ..core.dataframe import _as_column
 
+_CHUNK_NNZ = 250_000  # caps the (chunk, k, k) outer-product buffer
 
-def _als(ratings: np.ndarray, mask: np.ndarray, rank: int, reg: float,
-         iters: int, seed: int) -> Tuple[np.ndarray, np.ndarray]:
-    """Masked ALS via jitted alternating ridge solves."""
-    import jax
+
+def _get_accumulate():
+    """Module-level jitted kernels so every half-sweep hits the jit cache
+    (fresh closures inside the sweep would recompile 2*iters times)."""
+    global _ACCUMULATE, _SOLVE_ALL
+    if _ACCUMULATE is None:
+        import functools
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("n_rows", "implicit"))
+        def accumulate(F, tgt, cf, seg, n_rows, implicit):
+            # implicit: A += (c-1) f f^T, b += c*t*f ; explicit: A += c f f^T
+            w_outer = cf - 1.0 if implicit else cf
+            outer = (F[:, :, None] * F[:, None, :]) * w_outer[:, None, None]
+            a = jax.ops.segment_sum(outer, seg, num_segments=n_rows)
+            b = jax.ops.segment_sum(F * (cf * tgt)[:, None], seg,
+                                    num_segments=n_rows)
+            return a, b
+
+        @jax.jit
+        def solve_all(A, B, base):
+            return jax.vmap(jnp.linalg.solve)(A + base, B)
+
+        _ACCUMULATE, _SOLVE_ALL = accumulate, solve_all
+    return _ACCUMULATE, _SOLVE_ALL
+
+
+_ACCUMULATE = _SOLVE_ALL = None
+
+
+def _solve_side(other: np.ndarray, row_idx: np.ndarray, col_idx: np.ndarray,
+                target: np.ndarray, conf: np.ndarray, n_rows: int,
+                reg: float, gram: Optional[np.ndarray]) -> np.ndarray:
+    """One ALS half-sweep from COO triples.
+
+    For each row r: solve (gram? + sum_nnz c f f^T + reg I) x = b with
+    segment-summed normal equations.  ``gram`` is the implicit-CF all-pairs
+    term V^T V (None for explicit mode, where only the nonzeros carry
+    weight and ``conf`` is the per-entry weight directly).
+    """
     import jax.numpy as jnp
 
-    n_u, n_i = ratings.shape
-    rng = np.random.default_rng(seed)
-    U = jnp.asarray(rng.normal(scale=0.1, size=(n_u, rank)).astype(np.float32))
-    V = jnp.asarray(rng.normal(scale=0.1, size=(n_i, rank)).astype(np.float32))
-    R = jnp.asarray(ratings, jnp.float32)
-    M = jnp.asarray(mask, jnp.float32)
+    accumulate, solve_all = _get_accumulate()
+    k = other.shape[1]
+    A = np.zeros((n_rows, k, k), np.float32)
+    B = np.zeros((n_rows, k), np.float32)
+    for s in range(0, len(row_idx), _CHUNK_NNZ):
+        e = s + _CHUNK_NNZ
+        a, b = accumulate(jnp.asarray(other[col_idx[s:e]]),
+                          jnp.asarray(target[s:e]), jnp.asarray(conf[s:e]),
+                          jnp.asarray(row_idx[s:e]), n_rows=n_rows,
+                          implicit=gram is not None)
+        A += np.asarray(a)
+        B += np.asarray(b)
+    base = (gram if gram is not None else 0.0) + reg * np.eye(k, dtype=np.float32)
+    return np.asarray(solve_all(jnp.asarray(A), jnp.asarray(B),
+                                jnp.asarray(base)))
 
-    @jax.jit
-    def solve_side(F_other, R_side, M_side):
-        # for each row r: (F^T diag(m) F + reg I)^-1 F^T diag(m) y
-        def one(m_row, y_row):
-            Fw = F_other * m_row[:, None]
-            A = Fw.T @ F_other + reg * jnp.eye(rank)
-            b = Fw.T @ y_row
-            return jnp.linalg.solve(A, b)
-        return jax.vmap(one)(M_side, R_side)
+
+def sparse_als(u_idx: np.ndarray, r_idx: np.ndarray, counts: np.ndarray,
+               n_u: int, n_i: int, rank: int, reg: float, iters: int,
+               seed: int, implicit: bool = True, alpha: float = 10.0,
+               neg_u: Optional[np.ndarray] = None,
+               neg_r: Optional[np.ndarray] = None,
+               neg_score: float = 0.0, neg_weight: float = 0.5,
+               pos_score: float = 1.0) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-tenant ALS over COO observations.
+
+    implicit=True: Hu-Koren implicit CF (reference
+    ``default_apply_implicit_cf``) — confidence c = 1 + alpha*count on
+    observed pairs, preference p = 1; unobserved pairs enter only through
+    the k x k gram term.
+    implicit=False: explicit ridge ALS over observed entries (target
+    ``pos_score`` scaled by count) plus the supplied complement-sampled
+    negatives at ``neg_score`` with weight ``neg_weight``.
+    """
+    rng = np.random.default_rng(seed)
+    U = rng.normal(scale=0.1, size=(n_u, rank)).astype(np.float32)
+    V = rng.normal(scale=0.1, size=(n_i, rank)).astype(np.float32)
+    counts = np.asarray(counts, np.float32)
+
+    if implicit:
+        conf = 1.0 + alpha * counts
+        tgt = np.ones_like(conf)
+        uu, rr = u_idx, r_idx
+    else:
+        tgt = np.maximum(counts, pos_score)
+        conf = np.ones_like(tgt)
+        uu, rr = u_idx, r_idx
+        if neg_u is not None and len(neg_u):
+            # exclude sampled pairs the user actually accessed — a collision
+            # would append a contradictory zero target for an observed cell
+            obs_keys = np.unique(u_idx.astype(np.int64) * n_i + r_idx)
+            neg_keys = neg_u.astype(np.int64) * n_i + neg_r
+            keep = ~np.isin(neg_keys, obs_keys)
+            neg_u, neg_r = neg_u[keep], neg_r[keep]
+            uu = np.concatenate([u_idx, neg_u])
+            rr = np.concatenate([r_idx, neg_r])
+            tgt = np.concatenate([tgt, np.full(len(neg_u), neg_score, np.float32)])
+            conf = np.concatenate([conf, np.full(len(neg_u), neg_weight, np.float32)])
 
     for _ in range(iters):
-        U = solve_side(V, R, M)
-        V = solve_side(U, R.T, M.T)
-    return np.asarray(U), np.asarray(V)
+        gram_v = (V.T @ V).astype(np.float32) if implicit else None
+        U = _solve_side(V, uu, rr, tgt, conf, n_u, reg, gram_v)
+        gram_u = (U.T @ U).astype(np.float32) if implicit else None
+        V = _solve_side(U, rr, uu, tgt, conf, n_i, reg, gram_u)
+    return U, V
 
 
 class ComplementAccessTransformer:
@@ -93,8 +182,12 @@ class AccessAnomaly(Estimator):
     rank_param = Param("rank", "latent factor rank", "int", default=10)
     max_iter = Param("max_iter", "ALS iterations", "int", default=10)
     reg_param = Param("reg_param", "ridge regularization", "float", default=0.1)
-    complementset_factor = Param("complementset_factor", "negatives per positive",
-                                 "int", default=2)
+    implicit_cf = Param("implicit_cf", "Hu-Koren implicit CF (reference "
+                        "default_apply_implicit_cf); False = explicit targets "
+                        "with sampled complement negatives", "bool", default=True)
+    alpha = Param("alpha", "implicit-CF confidence scale", "float", default=10.0)
+    complementset_factor = Param("complementset_factor", "negatives per positive "
+                                 "(explicit mode)", "int", default=2)
     neg_score = Param("neg_score", "implicit negative target", "float", default=0.0)
     pos_score = Param("pos_score", "observed access target", "float", default=1.0)
     seed = Param("seed", "random seed", "int", default=0)
@@ -110,24 +203,29 @@ class AccessAnomaly(Estimator):
             users, u_idx = np.unique(data[uc][sel].astype(str), return_inverse=True)
             ress, r_idx = np.unique(data[rc][sel].astype(str), return_inverse=True)
             n_u, n_i = len(users), len(ress)
-            R = np.full((n_u, n_i), self.get("neg_score"), np.float32)
             lc = self.get("likelihood_col")
-            vals = np.asarray(data[lc], np.float64)[sel] if lc and lc in data \
-                else np.full(sel.sum(), self.get("pos_score"))
-            R[u_idx, r_idx] = np.maximum(vals, self.get("pos_score"))
-            # observed pairs + sampled complement get mass in the mask
-            M = np.zeros((n_u, n_i), np.float32)
-            M[u_idx, r_idx] = 1.0
+            counts = np.asarray(data[lc], np.float64)[sel].astype(np.float32) \
+                if lc and lc in data else np.ones(int(sel.sum()), np.float32)
+            rank = min(self.get("rank"), min(n_u, n_i))
             rng = np.random.default_rng(self.get("seed"))
-            n_neg = min(self.get("complementset_factor") * int(sel.sum()), n_u * n_i)
-            neg_u = rng.integers(0, n_u, n_neg)
-            neg_r = rng.integers(0, n_i, n_neg)
-            M[neg_u, neg_r] = np.maximum(M[neg_u, neg_r], 0.5)
-            U, V = _als(R, M, min(self.get("rank"), min(n_u, n_i)),
-                        self.get("reg_param"), self.get("max_iter"),
-                        self.get("seed"))
-            scores = (U @ V.T)
-            obs = scores[u_idx, r_idx]
+            neg_u = neg_r = None
+            if not self.get("implicit_cf"):
+                n_neg = min(self.get("complementset_factor") * int(sel.sum()),
+                            n_u * n_i)
+                neg_u = rng.integers(0, n_u, n_neg).astype(np.int32)
+                neg_r = rng.integers(0, n_i, n_neg).astype(np.int32)
+            U, V = sparse_als(u_idx.astype(np.int32), r_idx.astype(np.int32),
+                              counts, n_u, n_i, rank,
+                              self.get("reg_param"), self.get("max_iter"),
+                              self.get("seed"),
+                              implicit=self.get("implicit_cf"),
+                              alpha=self.get("alpha"),
+                              neg_u=neg_u, neg_r=neg_r,
+                              neg_score=self.get("neg_score"),
+                              pos_score=self.get("pos_score"))
+            # standardisation stats over OBSERVED pairs only — a gather, not
+            # a dense (n_u, n_i) matmul
+            obs = np.einsum("nk,nk->n", U[u_idx], V[r_idx])
             mu, sd = float(obs.mean()), float(obs.std()) or 1.0
             factors[t] = {"users": users.tolist(), "ress": ress.tolist(),
                           "U": U, "V": V, "mean": mu, "std": sd}
@@ -146,8 +244,25 @@ class AccessAnomalyModel(Model):
                        default="anomaly_score")
     factors = ComplexParam("factors", "per-tenant factor matrices")
 
+    def _post_load(self):
+        self._lookup_cache = None
+
+    def _lookups(self, factors) -> Dict[str, Tuple[Dict, Dict]]:
+        """Hash-map index lookups built once per tenant (round-1 weak item
+        4: scoring did a Python list.index PER ROW — O(n*m)).  Keyed by the
+        factors object so a ``set("factors", ...)`` invalidates the cache."""
+        cached = getattr(self, "_lookup_cache", None)
+        if cached is not None and cached[0] is factors:
+            return cached[1]
+        maps = {t: ({u: i for i, u in enumerate(f["users"])},
+                    {r: i for i, r in enumerate(f["ress"])})
+                for t, f in factors.items()}
+        self._lookup_cache = (factors, maps)
+        return maps
+
     def _transform(self, df: DataFrame) -> DataFrame:
         factors = self.get_or_fail("factors")
+        lookups = self._lookups(factors)
         tc, uc, rc = self.get("tenant_col"), self.get("user_col"), self.get("res_col")
 
         def per_part(p):
@@ -159,14 +274,15 @@ class AccessAnomalyModel(Model):
                 if f is None:
                     out[i] = 0.0
                     continue
-                try:
-                    ui = f["users"].index(str(p[uc][i]))
-                    ri = f["ress"].index(str(p[rc][i]))
-                    score = float(f["U"][ui] @ f["V"][ri])
-                    # higher score = more expected => anomaly = negative z
-                    out[i] = -(score - f["mean"]) / f["std"]
-                except ValueError:  # unseen user/resource: max anomaly
+                umap, rmap = lookups[t]
+                ui = umap.get(str(p[uc][i]))
+                ri = rmap.get(str(p[rc][i]))
+                if ui is None or ri is None:  # unseen user/resource: max anomaly
                     out[i] = 3.0
+                    continue
+                score = float(f["U"][ui] @ f["V"][ri])
+                # higher score = more expected => anomaly = negative z
+                out[i] = -(score - f["mean"]) / f["std"]
             return {**p, self.get("output_col"): out}
 
         return df.map_partitions(per_part)

@@ -1,0 +1,72 @@
+"""Seed TestObjects for flagship stages — consumed both by the in-repo
+fuzzing sweep and by the GENERATED per-stage test files (the reference's
+per-suite ``testObjects()`` declarations feeding PyTestFuzzing,
+``Fuzzing.scala:47-172``)."""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from ..core import DataFrame
+from ..core.schema import vector_column
+from .fuzzing import TestObject
+
+
+def vec_frame(n=60, d=5, seed=0, label=True) -> DataFrame:
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d))
+    cols = {"features": vector_column(list(X))}
+    if label:
+        cols["label"] = (X[:, 0] > 0).astype(float)
+    return DataFrame.from_dict(cols, 2)
+
+
+def seed_objects() -> Dict[str, TestObject]:
+    """Qualname -> TestObject for every stage with a declared seed."""
+    from mmlspark_tpu.lightgbm import LightGBMClassifier, LightGBMRegressor
+    from mmlspark_tpu.vw import VowpalWabbitClassifier, VowpalWabbitFeaturizer
+    from mmlspark_tpu.featurize import CleanMissingData, ValueIndexer
+    from mmlspark_tpu.isolationforest import IsolationForest
+    from mmlspark_tpu.nn import KNN
+    from mmlspark_tpu.stages import (FixedMiniBatchTransformer, SummarizeData,
+                                     TextPreprocessor)
+    from mmlspark_tpu.opencv import ImageTransformer
+
+    vec = vec_frame()
+    rng = np.random.default_rng(1)
+    sp_col = np.empty(40, dtype=object)
+    for i in range(40):
+        sp_col[i] = {"indices": np.arange(5, dtype=np.int32),
+                     "values": rng.normal(size=5).astype(np.float32)}
+    sparse = DataFrame.from_dict({"features": sp_col,
+                                  "label": (rng.random(40) > 0.5).astype(float)}, 2)
+    txt = DataFrame.from_dict({"text": np.array(["Hello World", "FOO bar"],
+                                                dtype=object)})
+    imgs = np.empty(2, dtype=object)
+    for i in range(2):
+        imgs[i] = rng.uniform(0, 255, (8, 8, 3)).astype(np.float32)
+    img_df = DataFrame.from_dict({"image": imgs})
+    nan_df = DataFrame.from_dict({"x": np.array([1.0, np.nan, 5.0])})
+
+    objs = [
+        TestObject(LightGBMClassifier().set_params(num_iterations=5, min_data_in_leaf=2), vec),
+        TestObject(LightGBMRegressor().set_params(num_iterations=5, min_data_in_leaf=2), vec),
+        TestObject(VowpalWabbitClassifier().set_params(num_bits=8, num_passes=2), sparse),
+        TestObject(VowpalWabbitFeaturizer().set_params(input_cols=["text"], output_col="f"),
+                   transform_df=txt),
+        TestObject(CleanMissingData().set_params(input_cols=["x"]), nan_df),
+        TestObject(ValueIndexer().set_params(input_col="text", output_col="i"), txt),
+        TestObject(IsolationForest().set_params(num_estimators=10), vec.drop("label")),
+        TestObject(KNN().set_params(k=2, output_col="m"), vec.drop("label")),
+        TestObject(FixedMiniBatchTransformer().set_params(batch_size=3),
+                   transform_df=vec),
+        TestObject(SummarizeData(), transform_df=vec_frame(20, 2, label=False)
+                   .with_column("n", lambda p: np.arange(len(p["features"]), dtype=float))
+                   .drop("features")),
+        TestObject(TextPreprocessor().set_params(input_col="text", output_col="t"),
+                   transform_df=txt),
+        TestObject(ImageTransformer(input_col="image", output_col="o").resize(4, 4),
+                   transform_df=img_df),
+    ]
+    return {type(o.stage).__qualname__: o for o in objs}

@@ -1,0 +1,25 @@
+"""Generate and execute the per-stage binding tests.
+
+Reference: ``tools/pytest/run_all_tests.py:1-13`` runs the PyTestFuzzing
+output under xmlrunner; here the generated pytest files run under pytest.
+
+    python tools/run_generated_tests.py [out_dir]
+"""
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(out_dir: str = "generated/tests") -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from mmlspark_tpu.codegen import generate_tests
+    paths = generate_tests(out_dir)
+    print(f"generated {len(paths)} per-stage test files in {out_dir}")
+    return subprocess.call([sys.executable, "-m", "pytest", out_dir, "-q",
+                            "-p", "no:cacheprovider"])
+
+
+if __name__ == "__main__":
+    sys.exit(main(*sys.argv[1:]))
