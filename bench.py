@@ -154,6 +154,57 @@ def phase_ranker(n=200_000, f=50, group=100, iters_a=2, iters_b=8) -> None:
           flush=True)
 
 
+def phase_serving(n_requests=300) -> None:
+    """Serving p50 latency over real HTTP: a fitted GBDT pipeline behind the
+    continuous-mode server, single-row requests scored via the host-side
+    booster walk.  Pure host — no device involvement (reference claim:
+    ~1 ms continuous mode, docs/mmlspark-serving.md:10-11)."""
+    import json as _json
+    import urllib.request
+    import numpy as np
+    from mmlspark_tpu.core import DataFrame, Transformer
+    from mmlspark_tpu.core.schema import vector_column
+    from mmlspark_tpu.lightgbm import LightGBMClassifier
+    from mmlspark_tpu.serving import PipelineServer
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2000, 20))
+    y = (X[:, 0] > 0).astype(float)
+    df = DataFrame.from_dict({"features": vector_column(list(X)), "label": y})
+    model = LightGBMClassifier().set_params(num_iterations=30,
+                                            min_data_in_leaf=5).fit(df)
+
+    class Scorer(Transformer):
+        def _transform(self, frame):
+            def per_part(p):
+                feats = vector_column([np.asarray(v, np.float32)
+                                       for v in p["request"]])
+                out = model.transform(DataFrame.from_dict({"features": feats}))
+                return {**p, "reply": out.collect()["prediction"]}
+            return frame.map_partitions(per_part)
+
+        def transform_schema(self, schema):
+            return schema
+
+    srv = PipelineServer(Scorer(), port=0, mode="continuous").start()
+    try:
+        body = _json.dumps(list(np.asarray(X[0], float))).encode()
+        req = urllib.request.Request(srv.address, data=body,
+                                     headers={"Content-Type": "application/json"})
+        for _ in range(20):  # warm
+            urllib.request.urlopen(req, timeout=10).read()
+        lats = []
+        for _ in range(n_requests):
+            t0 = time.perf_counter()
+            urllib.request.urlopen(req, timeout=10).read()
+            lats.append(time.perf_counter() - t0)
+        lats.sort()
+        print(f"SERVING_P50_MS {1000 * lats[len(lats) // 2]} "
+              f"{1000 * lats[int(len(lats) * 0.95)]}", flush=True)
+    finally:
+        srv.stop()
+
+
 def phase_cpu(n=200_000, f=200) -> None:
     """CPU-executor baseline: identical trainer on the host CPU."""
     import numpy as np
@@ -264,8 +315,15 @@ def main() -> None:
                 round(got[0], 1)
         _emit()
 
-    # Phase 4 — collect the CPU baseline.
-    remaining = max(60.0, 840.0 - (time.perf_counter() - wall0))
+    # Phase 5 — serving latency (pure host, CPU platform).
+    got = _collect(_spawn("serving", _cpu_env()), "SERVING_P50_MS", 240)
+    if got:
+        RESULT["extras"]["serving_http_p50_ms"] = round(got[0], 2)
+        RESULT["extras"]["serving_http_p95_ms"] = round(got[1], 2)
+    _emit()
+
+    # Phase 6 — collect the CPU baseline.
+    remaining = max(60.0, 900.0 - (time.perf_counter() - wall0))
     got = _collect(cpu_proc, "CPU_RPS", remaining)
     if got:
         cpu_rps = got[0]
@@ -284,6 +342,7 @@ if __name__ == "__main__":
         for i in range(0, len(rest) - 1, 2):
             kw[rest[i].lstrip("-")] = int(rest[i + 1])
         {"health": phase_health, "gbdt": phase_gbdt, "ranker": phase_ranker,
-         "resnet": phase_resnet, "cpu": phase_cpu}[phase](**kw)
+         "resnet": phase_resnet, "cpu": phase_cpu,
+         "serving": phase_serving}[phase](**kw)
     else:
         main()

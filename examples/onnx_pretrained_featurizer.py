@@ -1,0 +1,70 @@
+"""Pretrained-model featurization via ONNX import — the ImageFeaturizer
+transfer-learning path (reference DeepLearning-TransferLearning notebook).
+
+A torch CNN's weights are packed into a real ONNX wire-format artifact,
+registered in the local model repo with the classifier head cut, and used to
+featurize an image column; features match the source runtime numerically.
+"""
+import numpy as np
+
+from _common import setup
+
+
+def main():
+    setup(force_cpu=True)
+    import torch
+    import torch.nn as tnn
+    from mmlspark_tpu.core import DataFrame
+    from mmlspark_tpu.dl import ImageFeaturizer, ModelDownloader
+    from mmlspark_tpu.dl.onnx_wire import build_model, encode_node
+
+    torch.manual_seed(0)
+    m = tnn.Sequential(tnn.Conv2d(3, 16, 3, stride=2, padding=1),
+                       tnn.BatchNorm2d(16), tnn.ReLU(),
+                       tnn.AdaptiveAvgPool2d(1), tnn.Flatten(),
+                       tnn.Linear(16, 10)).eval()
+    conv, bn, _, _, _, lin = m
+    t = lambda x: x.detach().numpy()  # noqa: E731
+    init = {"cw": t(conv.weight), "cb": t(conv.bias), "bs": t(bn.weight),
+            "bb": t(bn.bias), "bm": t(bn.running_mean),
+            "bv": t(bn.running_var), "fw": t(lin.weight), "fb": t(lin.bias)}
+    nodes = [
+        encode_node("Conv", ["x", "cw", "cb"], ["c"], kernel_shape=[3, 3],
+                    strides=[2, 2], pads=[1, 1, 1, 1]),
+        encode_node("BatchNormalization", ["c", "bs", "bb", "bm", "bv"], ["b"],
+                    epsilon=float(bn.eps)),
+        encode_node("Relu", ["b"], ["r"]),
+        encode_node("GlobalAveragePool", ["r"], ["g"]),
+        encode_node("Flatten", ["g"], ["feat"], axis=1),
+        encode_node("Gemm", ["feat", "fw", "fb"], ["y"], transB=1),
+    ]
+    onnx_bytes = build_model(nodes, init, [("x", [1, 3, 64, 64])],
+                             [("y", [1, 10])])
+
+    repo = "/tmp/mmlspark_tpu_zoo"
+    dl = ModelDownloader(local_cache=repo)
+    dl.import_onnx("DemoNet", onnx_bytes, cut_layers=1)  # cut Gemm -> features
+    payload = dl.download_by_name("DemoNet")             # pretrained weights
+    print("zoo models:", [s.name for s in dl.repo.list_models()])
+
+    rng = np.random.default_rng(0)
+    raw = rng.uniform(0, 1, (8, 64, 64, 3)).astype(np.float32)
+    imgs = np.empty(8, dtype=object)
+    for i in range(8):
+        imgs[i] = raw[i]
+    df = DataFrame.from_dict({"image": imgs})
+    feat = ImageFeaturizer(input_col="image", output_col="features",
+                           height=64, width=64, auto_convert=False,
+                           batch_size=8).set_model(payload=payload)
+    got = np.stack(list(feat.transform(df).to_pandas()["features"]))
+    with torch.no_grad():
+        trunc = tnn.Sequential(conv, bn, tnn.ReLU(), tnn.AdaptiveAvgPool2d(1),
+                               tnn.Flatten())
+        want = trunc(torch.from_numpy(raw.transpose(0, 3, 1, 2))).numpy()
+    err = float(np.abs(got - want).max())
+    print(f"features {got.shape}, max |err| vs torch = {err:.2e}")
+    assert err < 1e-4
+
+
+if __name__ == "__main__":
+    main()

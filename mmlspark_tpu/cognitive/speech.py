@@ -362,7 +362,8 @@ class SpeechServingModel(Transformer):
         super().__init__(uid)
         self.recognizer = recognizer or StreamingRecognizer()
         self.input_col, self.reply_col = input_col, reply_col
-        self._sessions: Dict[str, Tuple[float, RecognitionState]] = {}
+        self._sessions: Dict[str, Tuple[float, RecognitionState,
+                                        threading.Lock]] = {}
         self._lock = threading.Lock()
         self.session_ttl_s = session_ttl_s
 

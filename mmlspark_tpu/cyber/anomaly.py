@@ -206,6 +206,14 @@ class AccessAnomaly(Estimator):
             lc = self.get("likelihood_col")
             counts = np.asarray(data[lc], np.float64)[sel].astype(np.float32) \
                 if lc and lc in data else np.ones(int(sel.sum()), np.float32)
+            # aggregate duplicate (user, resource) observations so implicit
+            # confidence is c = 1 + alpha * TOTAL count per pair (Hu-Koren),
+            # not 1 + alpha per log line
+            keys = u_idx.astype(np.int64) * n_i + r_idx
+            uniq_keys, inv = np.unique(keys, return_inverse=True)
+            counts = np.bincount(inv, weights=counts).astype(np.float32)
+            u_idx = (uniq_keys // n_i).astype(np.int64)
+            r_idx = (uniq_keys % n_i).astype(np.int64)
             rank = min(self.get("rank"), min(n_u, n_i))
             rng = np.random.default_rng(self.get("seed"))
             neg_u = neg_r = None

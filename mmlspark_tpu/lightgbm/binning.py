@@ -38,6 +38,17 @@ class BinMapper:
             idx = np.random.default_rng(seed).choice(n, sample_cnt, replace=False)
             X = X[idx]
         B = self.max_bin
+        # threaded C++ edge finding when the data plane is available AND
+        # there are cores to thread over — the reference keeps this loop
+        # native too (LightGBM BinMapper); single-core, vectorized numpy
+        # quantiles win over the scalar C++ sort loop
+        import multiprocessing
+        if X.shape[0] * F >= 1 << 16 and multiprocessing.cpu_count() >= 4:
+            from ..utils.native_loader import bin_edges_native
+            native = bin_edges_native(X, B)
+            if native is not None:
+                self.edges = native
+                return self
         edges = np.full((F, B - 1), np.inf, np.float32)
         qs = np.linspace(0, 1, B + 1)[1:-1]  # B-1 interior quantiles
         for f in range(F):
@@ -59,23 +70,31 @@ class BinMapper:
         self.edges = edges
         return self
 
-    def transform(self, X: np.ndarray, device: bool = True) -> np.ndarray:
-        """(n, F) raw -> (n, F) uint8 bins.  bin = #edges < x; NaN -> 0."""
+    def transform(self, X: np.ndarray, device: bool = False) -> np.ndarray:
+        """(n, F) raw -> (n, F) uint8 bins.  bin = #edges < x; NaN -> 0.
+
+        Default is HOST binning: the uint8 result is 4x smaller than the
+        float32 input, so binning before the host->device transfer quarters
+        the interconnect traffic (decisive through a device relay/DCN).
+        Threaded C++ when the data plane + cores exist, vectorized numpy
+        per-column searchsorted otherwise; ``device=True`` digitizes on the
+        accelerator for data already device-resident.
+        """
         if self.edges is None:
             raise RuntimeError("BinMapper not fitted")
         X = np.asarray(X, np.float32)
         if device:
-            import jax
             import jax.numpy as jnp
-            # binary search (log B steps) instead of the (n, F, B) broadcast
-            # compare — 30x less work at max_bin=255
-            @jax.jit
-            def digitize(xt, edges):
-                return jax.vmap(lambda col, e: jnp.searchsorted(e, col, side="left"))(
-                    xt, edges).astype(jnp.uint8)
-            Xn = np.nan_to_num(X, nan=-np.inf)
-            out = digitize(jnp.asarray(Xn.T), jnp.asarray(self.edges))
-            return np.asarray(out).T
+            from ..ops.histogram import bin_matrix  # module-level jit cache
+            out = bin_matrix(jnp.asarray(X), jnp.asarray(self.edges),
+                             self.max_bin)
+            return np.asarray(out)
+        import multiprocessing
+        if X.size >= 1 << 16 and multiprocessing.cpu_count() >= 4:
+            from ..utils.native_loader import bin_apply_native
+            native = bin_apply_native(X, self.edges, self.max_bin)
+            if native is not None:
+                return native
         out = np.empty(X.shape, np.uint8)
         for f in range(X.shape[1]):
             finite_edges = self.edges[f][np.isfinite(self.edges[f])]

@@ -149,16 +149,19 @@ def make_lambdarank_grad_fn(y: np.ndarray, group_ptr: np.ndarray,
     M_np = np.zeros((q, gmax), np.float32)
     row_q = np.zeros(n, np.int32)              # row -> (group, slot)
     row_slot = np.zeros(n, np.int32)
+    covered_np = np.zeros(n, bool)             # rows outside group_ptr get 0
     for i in range(q):
         a, b = group_ptr[i], group_ptr[i + 1]
         pack_idx[i, : b - a] = np.arange(a, b)
         M_np[i, : b - a] = 1.0
         row_q[a:b] = i
         row_slot[a:b] = np.arange(b - a)
+        covered_np[a:b] = True
     Y = jnp.asarray(np.asarray(y, np.float32)[pack_idx] * M_np)
     M = jnp.asarray(M_np)
     pack = jnp.asarray(pack_idx)
     rq, rs = jnp.asarray(row_q), jnp.asarray(row_slot)
+    covered = jnp.asarray(covered_np)
 
     @jax.jit
     def fn(scores):
@@ -181,8 +184,11 @@ def make_lambdarank_grad_fn(y: np.ndarray, group_ptr: np.ndarray,
         hess_ij = jnp.where(better, sigmoid * sigmoid * rho * (1 - rho) * delta_ndcg, 0.0)
         G = jnp.sum(lam_ij, axis=2) - jnp.sum(lam_ij, axis=1)
         H = jnp.maximum(jnp.sum(hess_ij, axis=2) + jnp.sum(hess_ij, axis=1), 1e-16)
-        # unpack by gather: row -> its (group, slot) cell
-        return G[rq, rs][:, None], H[rq, rs][:, None]
+        # unpack by gather: row -> its (group, slot) cell; rows not covered
+        # by group_ptr stay inert (g=0, h~0), matching the scatter unpack
+        g_row = jnp.where(covered, G[rq, rs], 0.0)
+        h_row = jnp.where(covered, H[rq, rs], 1e-16)
+        return g_row[:, None], h_row[:, None]
 
     return fn
 

@@ -93,15 +93,84 @@ def histogram_subtraction(parent_hist: jnp.ndarray, child_hist: jnp.ndarray) -> 
 
 @partial(jax.jit, static_argnames=("num_bins",))
 def bin_matrix(x: jnp.ndarray, edges: jnp.ndarray, num_bins: int) -> jnp.ndarray:
-    """Digitize raw features on device: bin = #edges < x (vectorized
-    searchsorted).  edges: (F, num_bins-1) ascending with +inf padding."""
-    # (n, F, 1) > (1, F, B-1) -> sum over last axis
-    return jnp.sum(x[:, :, None] > edges[None, :, :], axis=-1).astype(jnp.uint8)
+    """Digitize raw features on device: bin = #edges < x.  edges:
+    (F, num_bins-1) ascending with +inf padding.
+
+    Per-feature binary search (vmapped ``searchsorted``), O(n*F*log B) time
+    and O(n*F) memory — the old broadcast compare materialized an
+    (n, F, B-1) boolean (~50GB logical at 1M x 200 x 255; round-1 weak
+    item 10).  NaNs bin to 0, matching the comparison semantics.
+    """
+    def per_feature(e, xf):
+        return jnp.searchsorted(e, xf, side="left")
+
+    bins = jax.vmap(per_feature, in_axes=(0, 1), out_axes=1)(edges, x)
+    return jnp.where(jnp.isnan(x), 0, bins).astype(jnp.uint8)
 
 
 # ---------------------------------------------------------------------------
 # MXU histogram backend
 # ---------------------------------------------------------------------------
+
+def _node_pure_layout(binned, grad, hess, node_ids, num_nodes, R,
+                      sample_weight=None):
+    """Shared host/device prep for the MXU + Pallas histogram backends:
+    sort rows by node and pad so every R-row block is node-pure, then build
+    the bf16x2-decomposed weight channels.
+
+    Returns (bb_all (N_pad, F) u8, w5 (5, N_pad) f32, node_blk (NB,) i32,
+    NB).  Masked rows (node < 0) land in dummy node P whose buffer is
+    dropped by the caller.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n, F = binned.shape
+    P = num_nodes
+    g = grad.astype(jnp.float32)
+    h = hess.astype(jnp.float32)
+    c = jnp.ones_like(g)  # counts stay unweighted (min_data_in_leaf semantics)
+    if sample_weight is not None:
+        g, h = g * sample_weight, h * sample_weight
+
+    node_s = jnp.where(node_ids < 0, P, node_ids).astype(jnp.int32)
+    order = jnp.argsort(node_s)                     # stable
+    ns = node_s[order]
+    counts = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), node_s,
+                                 num_segments=P + 1)
+    start = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                             jnp.cumsum(counts)[:-1]])
+    # every node gets AT LEAST one (possibly all-padding) block: the Pallas
+    # backend zero-initialises a node's output buffer on its first block
+    # visit, so an empty node with no blocks would keep uninitialized memory
+    padded_counts = jnp.maximum(((counts + R - 1) // R) * R, R)
+    padded_off = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                  jnp.cumsum(padded_counts)[:-1]])
+    N_pad = ((n + R - 1) // R + P + 1) * R           # static upper bound, R-aligned
+    rank = jnp.arange(n, dtype=jnp.int32) - start[ns]
+    pos = padded_off[ns] + rank
+    padded_idx = jnp.full((N_pad,), -1, jnp.int32).at[pos].set(order)
+
+    NB = N_pad // R
+    block_starts = jnp.arange(NB, dtype=jnp.int32) * R
+    node_blk = jnp.searchsorted(padded_off, block_starts, side="right").astype(jnp.int32) - 1
+    node_blk = jnp.clip(node_blk, 0, P)
+    # blocks past a node's real (padded) rows are all -1 ids -> zero weights
+
+    valid = (padded_idx >= 0)
+    safe_idx = jnp.maximum(padded_idx, 0)
+    bb_all = binned[safe_idx]                        # (N_pad, F) uint8
+    # bf16x2 decomposition for the MXU inputs: grad/hess are signed and
+    # cancellation-sensitive, so each carries a bf16 residual channel; counts
+    # (small ints) are exact in bf16.  Accumulation itself is f32 on the MXU.
+    gp = g[safe_idx] * valid
+    hp = h[safe_idx] * valid
+    cp = c[safe_idx] * valid
+    g_hi = gp.astype(jnp.bfloat16).astype(jnp.float32)
+    h_hi = hp.astype(jnp.bfloat16).astype(jnp.float32)
+    w5 = jnp.stack([g_hi, gp - g_hi, h_hi, hp - h_hi, cp], axis=0)  # (5, N_pad)
+    return bb_all, w5, node_blk, NB
+
 
 def build_histograms_matmul(binned: jnp.ndarray, grad: jnp.ndarray,
                             hess: jnp.ndarray, node_ids: jnp.ndarray,
@@ -137,46 +206,8 @@ def build_histograms_matmul(binned: jnp.ndarray, grad: jnp.ndarray,
     P = num_nodes
     R = block_rows
 
-    g = grad.astype(jnp.float32)
-    h = hess.astype(jnp.float32)
-    c = jnp.ones_like(g)  # counts stay unweighted (min_data_in_leaf semantics)
-    if sample_weight is not None:
-        g, h = g * sample_weight, h * sample_weight
-
-    # ---- node-pure padded layout ------------------------------------------
-    node_s = jnp.where(node_ids < 0, P, node_ids).astype(jnp.int32)
-    order = jnp.argsort(node_s)                     # stable
-    ns = node_s[order]
-    counts = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), node_s,
-                                 num_segments=P + 1)
-    start = jnp.concatenate([jnp.zeros((1,), jnp.int32),
-                             jnp.cumsum(counts)[:-1]])
-    padded_counts = ((counts + R - 1) // R) * R
-    padded_off = jnp.concatenate([jnp.zeros((1,), jnp.int32),
-                                  jnp.cumsum(padded_counts)[:-1]])
-    N_pad = ((n + R - 1) // R + P + 1) * R           # static upper bound, R-aligned
-    rank = jnp.arange(n, dtype=jnp.int32) - start[ns]
-    pos = padded_off[ns] + rank
-    padded_idx = jnp.full((N_pad,), -1, jnp.int32).at[pos].set(order)
-
-    NB = N_pad // R
-    block_starts = jnp.arange(NB, dtype=jnp.int32) * R
-    node_blk = jnp.searchsorted(padded_off, block_starts, side="right").astype(jnp.int32) - 1
-    node_blk = jnp.clip(node_blk, 0, P)
-    # blocks past a node's real (padded) rows are all -1 ids -> zero weights
-
-    valid = (padded_idx >= 0)
-    safe_idx = jnp.maximum(padded_idx, 0)
-    bb_all = binned[safe_idx]                        # (N_pad, F) uint8
-    # bf16x2 decomposition for the MXU inputs: grad/hess are signed and
-    # cancellation-sensitive, so each carries a bf16 residual channel; counts
-    # (small ints) are exact in bf16.  Accumulation itself is f32 on the MXU.
-    gp = g[safe_idx] * valid
-    hp = h[safe_idx] * valid
-    cp = c[safe_idx] * valid
-    g_hi = gp.astype(jnp.bfloat16).astype(jnp.float32)
-    h_hi = hp.astype(jnp.bfloat16).astype(jnp.float32)
-    w5 = jnp.stack([g_hi, gp - g_hi, h_hi, hp - h_hi, cp], axis=0)  # (5, N_pad)
+    bb_all, w5, node_blk, NB = _node_pure_layout(binned, grad, hess, node_ids,
+                                                 num_nodes, R, sample_weight)
 
     hi_iota = jnp.arange(HI, dtype=jnp.int32)
     lo_iota = jnp.arange(LO, dtype=jnp.int32)
@@ -213,9 +244,18 @@ def build(binned, grad, hess, node_ids, num_nodes, num_bins,
           sample_weight=None, backend: str = "auto"):
     """Backend dispatcher.  'auto' picks the MXU matmul build on accelerator
     platforms (13x faster than scatter on v5e, measured) and the scatter
-    build on CPU (where one-hot matmuls lose)."""
+    build on CPU (where one-hot matmuls lose).  'pallas' selects the fused
+    VMEM kernel (``pallas_histogram.py``; interpret-mode on CPU); override
+    via MMLSPARK_TPU_HIST_BACKEND."""
+    import os
+    backend = os.environ.get("MMLSPARK_TPU_HIST_BACKEND", backend)
     if backend == "auto":
         backend = "scatter" if jax.default_backend() == "cpu" else "matmul"
+    if backend == "pallas":
+        from .pallas_histogram import build_histograms_pallas
+        return build_histograms_pallas(
+            binned, grad, hess, node_ids, num_nodes, num_bins, sample_weight,
+            interpret=jax.default_backend() == "cpu")
     if backend == "matmul":
         return build_histograms_matmul(binned, grad, hess, node_ids,
                                        num_nodes, num_bins, sample_weight)

@@ -1,0 +1,118 @@
+"""Pallas TPU kernel for the GBDT histogram build — the make-or-break op.
+
+Reference hot loop: LightGBM's C++ ``ConstructHistograms`` inside
+``updateOneIteration`` (``booster/LightGBMBooster.scala:351`` dispatches into
+the native engine).  SURVEY §7 names the histogram build as the framework's
+hardest kernel; ``build_histograms_matmul`` (histogram.py) already reformulates
+it as MXU one-hot contractions, but each scan step round-trips its block
+one-hots and the (P+1, F, 5*HI, 16) accumulator through HBM.
+
+This kernel fuses the whole pipeline per block — nibble split, one-hot
+construction, weight channel broadcast, MXU contraction, and accumulation —
+in VMEM.  Layout mirrors the matmul backend (shared ``_node_pure_layout``):
+
+- rows sorted by node, padded so each R-row block is node-pure;
+- grid = one step per block, sequential on TPU;
+- the OUTPUT BlockSpec's index map routes each step to its node's histogram
+  buffer via a scalar-prefetched ``node_blk`` array; consecutive blocks of
+  the same node hit the same VMEM-resident buffer (Pallas only writes back
+  on index change), and ``pl.when(first-visit)`` zero-initialises it;
+- inside, a ``fori_loop`` over features issues (5*HI, R) x (R, 16) MXU dots
+  in bf16 with f32 accumulation (the bf16x2 residual channels keep grad/hess
+  exact to ~f32).
+
+Numerics are identical to the matmul backend by construction.  On CPU the
+kernel runs under ``interpret=True`` (pure-jax semantics) for tests; real
+Mosaic lowering is exercised on the TPU platform.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .histogram import _node_pure_layout
+
+
+@partial(jax.jit, static_argnames=("num_nodes", "num_bins", "block_rows",
+                                   "interpret"))
+def build_histograms_pallas(binned: jnp.ndarray, grad: jnp.ndarray,
+                            hess: jnp.ndarray, node_ids: jnp.ndarray,
+                            num_nodes: int, num_bins: int,
+                            sample_weight: Optional[jnp.ndarray] = None,
+                            block_rows: int = 512,
+                            interpret: bool = False) -> jnp.ndarray:
+    """(num_nodes, F, num_bins, 3) histogram of (grad, hess, count)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, F = binned.shape
+    B = num_bins
+    if B > 256:
+        raise ValueError("pallas backend supports max_bin <= 256")
+    HI = (B + 15) // 16
+    LO = 16
+    P = num_nodes
+    R = block_rows
+
+    bb_all, w5, node_blk, NB = _node_pure_layout(binned, grad, hess, node_ids,
+                                                 P, R, sample_weight)
+    bb_blocks = bb_all.reshape(NB, R, F)
+    w_blocks = jnp.moveaxis(w5.reshape(5, NB, R), 1, 0)   # (NB, 5, R)
+
+    def kernel(nb_ref, bb_ref, w_ref, out_ref):
+        i = pl.program_id(0)
+        prev = nb_ref[jnp.maximum(i - 1, 0)]
+        first = (i == 0) | (nb_ref[i] != prev)
+
+        @pl.when(first)
+        def _init():
+            out_ref[...] = jnp.zeros(out_ref.shape, out_ref.dtype)
+
+        b32 = bb_ref[0].astype(jnp.int32)             # (R, F)
+        w = w_ref[0].astype(jnp.bfloat16)             # (5, R)
+        hi = b32 >> 4
+        lo = b32 & 15
+        lo_iota = jnp.arange(LO, dtype=jnp.int32)
+        hi_iota = jnp.arange(HI, dtype=jnp.int32)
+
+        def per_feature(f, carry):
+            onehot_lo = (lo[:, f][:, None] == lo_iota).astype(jnp.bfloat16)
+            onehot_hi = (hi[:, f][:, None] == hi_iota).astype(jnp.bfloat16)
+            # channel-weighted hi one-hots on the MXU M axis, (5, HI) order
+            # matching the matmul backend's channel flattening;
+            # (5*HI, R) x (R, 16) -> (5*HI, 16) f32
+            a = jnp.transpose(w[:, :, None] * onehot_hi[None, :, :],
+                              (0, 2, 1)).reshape(5 * HI, R)
+            blk = jax.lax.dot(a, onehot_lo,
+                              preferred_element_type=jnp.float32)
+            out_ref[0, f] = out_ref[0, f] + blk
+            return carry
+
+        jax.lax.fori_loop(0, F, per_feature, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,                         # node_blk
+        grid=(NB,),
+        in_specs=[
+            pl.BlockSpec((1, R, F), lambda i, nb: (i, 0, 0)),
+            pl.BlockSpec((1, 5, R), lambda i, nb: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, F, 5 * HI, LO),
+                               lambda i, nb: (nb[i], 0, 0, 0)),
+    )
+
+    acc = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((P + 1, F, 5 * HI, LO), jnp.float32),
+        interpret=interpret,
+    )(node_blk, bb_blocks, w_blocks)
+
+    acc = acc[:P].reshape(P, F, 5, HI, LO)
+    acc3 = jnp.stack([acc[:, :, 0] + acc[:, :, 1],
+                      acc[:, :, 2] + acc[:, :, 3], acc[:, :, 4]], axis=0)
+    hist = acc3.reshape(3, P, F, HI * LO)[..., :B]      # (3, P, F, B)
+    return jnp.moveaxis(hist, 0, -1)                    # (P, F, B, 3)

@@ -33,15 +33,21 @@ def load_native() -> Optional[ctypes.CDLL]:
         _TRIED = True
         d = _native_dir()
         so = os.path.join(d, "libmmlspark_native.so")
-        if not os.path.exists(so):
-            src = os.path.join(d, "mmlspark_native.cpp")
+        src = os.path.join(d, "mmlspark_native.cpp")
+        stale = (os.path.exists(so) and os.path.exists(src)
+                 and os.path.getmtime(src) > os.path.getmtime(so))
+        if not os.path.exists(so) or stale:
             if not os.path.exists(src):
                 return None
             try:
-                subprocess.run(["make", "-C", d], check=True,
+                # rebuild BEFORE the first dlopen — reloading the same path
+                # after a rebuild would serve the cached stale handle
+                subprocess.run(["make", "-C", d, "-B"] if stale else
+                               ["make", "-C", d], check=True,
                                capture_output=True, timeout=120)
             except Exception:  # noqa: BLE001 — no compiler: numpy fallback
-                return None
+                if not os.path.exists(so):
+                    return None
         try:
             lib = ctypes.CDLL(so)
         except OSError:
@@ -58,6 +64,9 @@ def load_native() -> Optional[ctypes.CDLL]:
                                        ctypes.c_int64]
         lib.mm_chunked_coalesce.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
         lib.mm_chunked_free.argtypes = [ctypes.c_void_p]
+        if hasattr(lib, "mm_bin_edges"):
+            lib.mm_bin_edges.restype = None
+            lib.mm_bin_apply.restype = None
         _LIB = lib
         return _LIB
 
@@ -97,6 +106,44 @@ def csv_to_matrix_native(text: bytes, skip_header: bool = True):
     if got < 0:
         return None
     return out[:got]
+
+
+def bin_edges_native(X, max_bin: int, n_threads: int = 0):
+    """(n, F) float32 -> (F, max_bin-1) quantile edges via the threaded C++
+    kernel (BinMapper.fit hot path); None if no lib."""
+    import numpy as np
+    lib = load_native()
+    if lib is None or not hasattr(lib, "mm_bin_edges"):
+        return None
+    X = np.ascontiguousarray(X, np.float32)
+    n, F = X.shape
+    edges = np.empty((F, max_bin - 1), np.float32)
+    lib.mm_bin_edges(X.ctypes.data_as(ctypes.c_void_p),
+                     ctypes.c_int64(n), ctypes.c_int64(F),
+                     ctypes.c_int(max_bin),
+                     edges.ctypes.data_as(ctypes.c_void_p),
+                     ctypes.c_int(n_threads))
+    return edges
+
+
+def bin_apply_native(X, edges, max_bin: int, n_threads: int = 0):
+    """(n, F) raw -> (n, F) uint8 bins via the threaded C++ binary search;
+    None if no lib."""
+    import numpy as np
+    lib = load_native()
+    if lib is None or not hasattr(lib, "mm_bin_apply"):
+        return None
+    X = np.ascontiguousarray(X, np.float32)
+    edges = np.ascontiguousarray(edges, np.float32)
+    n, F = X.shape
+    out = np.empty((n, F), np.uint8)
+    lib.mm_bin_apply(X.ctypes.data_as(ctypes.c_void_p),
+                     ctypes.c_int64(n), ctypes.c_int64(F),
+                     edges.ctypes.data_as(ctypes.c_void_p),
+                     ctypes.c_int(max_bin),
+                     out.ctypes.data_as(ctypes.c_void_p),
+                     ctypes.c_int(n_threads))
+    return out
 
 
 class ChunkedArray:

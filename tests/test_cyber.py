@@ -109,3 +109,27 @@ def test_access_anomaly_explicit_mode():
         "res": np.array([f"r{(1 % 4) * 10 + 3}", "r35"])})  # seen-block vs far
     out = model.transform(probe).collect()["anomaly_score"]
     assert out[1] > out[0]
+
+
+def test_access_anomaly_aggregates_duplicate_pairs():
+    """d accesses of the same (user, resource) must behave as ONE observation
+    with count d (Hu-Koren c = 1 + alpha*count), not d separate entries."""
+    rows = {"tenant": [], "user": [], "res": []}
+    for _ in range(5):          # u0->r0 five times
+        rows["tenant"].append("t"); rows["user"].append("u0"); rows["res"].append("r0")
+    for u, r in [("u0", "r1"), ("u1", "r0"), ("u1", "r1")]:
+        rows["tenant"].append("t"); rows["user"].append(u); rows["res"].append(r)
+    df = DataFrame.from_dict({k: np.array(v) for k, v in rows.items()})
+    m1 = AccessAnomaly().set_params(rank=2, max_iter=3, seed=1).fit(df)
+
+    # pre-aggregated equivalent with likelihood counts
+    agg = DataFrame.from_dict({
+        "tenant": np.array(["t"] * 4),
+        "user": np.array(["u0", "u0", "u1", "u1"]),
+        "res": np.array(["r0", "r1", "r0", "r1"]),
+        "cnt": np.array([5.0, 1.0, 1.0, 1.0])})
+    m2 = AccessAnomaly().set_params(rank=2, max_iter=3, seed=1,
+                                    likelihood_col="cnt").fit(agg)
+    f1, f2 = m1.get("factors")["t"], m2.get("factors")["t"]
+    np.testing.assert_allclose(f1["U"], f2["U"], atol=1e-5)
+    np.testing.assert_allclose(f1["V"], f2["V"], atol=1e-5)

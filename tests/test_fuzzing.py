@@ -10,7 +10,6 @@ import pytest
 from mmlspark_tpu.codegen import all_stage_classes, instantiate_default
 from mmlspark_tpu.core import DataFrame, Estimator, Transformer
 from mmlspark_tpu.core.serialize import load_stage, save_stage
-from mmlspark_tpu.core.schema import vector_column
 from mmlspark_tpu.testing import (TestObject, ExperimentFuzzing,
                                   SerializationFuzzing)
 
@@ -106,3 +105,26 @@ def test_benchmarks_harness(tmp_path):
     b3.add("m2", 1.2, 0.1, False)
     with pytest.raises(AssertionError):
         b3.verify()
+
+
+def test_r_binding_generation(tmp_path):
+    """Second-language binding surface (reference generateRClasses,
+    CodeGen.scala:34): one R constructor per stage, package files, exports."""
+    from mmlspark_tpu.codegen import all_stage_classes, generate_r_classes
+    paths = generate_r_classes(str(tmp_path))
+    assert len(paths) == len(all_stage_classes()) + 1  # + core bridge
+    ns = (tmp_path / "NAMESPACE").read_text()
+    assert "export(mt_light_gbm_classifier)" in ns
+    assert "export(ml_fit)" in ns
+    assert (tmp_path / "DESCRIPTION").read_text().startswith("Package: mmlsparktpu")
+    gbm = (tmp_path / "R" / "mt_light_gbm_classifier.R").read_text()
+    assert "num_iterations = 100" in gbm          # default carried over
+    assert 'stage$set("learning_rate"' in gbm     # setter wiring
+    assert "reticulate" in (tmp_path / "R" / "mmlspark_tpu_core.R").read_text()
+    # balanced parens/braces in the CODE of every generated file (comment
+    # text may legally contain stray parens)
+    for p in (tmp_path / "R").iterdir():
+        code = "\n".join(l for l in p.read_text().splitlines()
+                          if not l.lstrip().startswith("#"))
+        assert code.count("(") == code.count(")"), p
+        assert code.count("{") == code.count("}"), p

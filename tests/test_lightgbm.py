@@ -259,3 +259,85 @@ def test_tree_shap_exact_vs_bruteforce():
     # additivity: contributions sum to the raw score
     raw = b.raw_scores(x[None, :])[0, 0]
     assert abs(phi.sum() - raw) < 1e-4
+
+
+def test_bin_matrix_matches_host_binning():
+    """Device digitize (vmapped searchsorted, O(n*F) memory) must agree with
+    the host BinMapper, including tie-on-edge and NaN rows."""
+    import jax.numpy as jnp
+    from mmlspark_tpu.lightgbm.binning import BinMapper
+    from mmlspark_tpu.ops.histogram import bin_matrix
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(500, 7)).astype(np.float32)
+    X[::50, 0] = np.nan
+    mapper = BinMapper(31).fit(np.nan_to_num(X, nan=0.0))
+    X[5, 1] = mapper.edges[1][3]  # exact tie on an edge
+    host = mapper.transform(np.nan_to_num(X, nan=np.nan))
+    dev = np.asarray(bin_matrix(jnp.asarray(X), jnp.asarray(mapper.edges),
+                                mapper.num_bins))
+    finite = ~np.isnan(X)
+    np.testing.assert_array_equal(dev[finite], host[finite])
+    assert (dev[~finite] == 0).all()
+
+
+def test_native_binning_matches_numpy():
+    """C++ data-plane binning (mm_bin_edges/mm_bin_apply) must byte-match
+    the numpy path, NaN and few-distinct features included."""
+    from mmlspark_tpu.utils.native_loader import (bin_apply_native,
+                                                  bin_edges_native,
+                                                  load_native)
+    if load_native() is None:
+        import pytest
+        pytest.skip("no native toolchain")
+    from mmlspark_tpu.lightgbm.binning import BinMapper
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(5000, 12)).astype(np.float32)
+    X[::31, 2] = np.nan
+    X[:, 5] = np.round(X[:, 5])          # few distinct values
+    X[:, 9] = 1.25                        # constant feature
+    B = 31
+    nat_edges = bin_edges_native(X, B)
+    m = BinMapper(B)
+    # numpy reference path (force it regardless of core count)
+    n, F = X.shape
+    edges = np.full((F, B - 1), np.inf, np.float32)
+    qs = np.linspace(0, 1, B + 1)[1:-1]
+    for f in range(F):
+        col = X[:, f]
+        col = col[~np.isnan(col)]
+        uniq = np.unique(col)
+        if uniq.size <= 1:
+            continue
+        if uniq.size <= B:
+            mids = (uniq[:-1] + uniq[1:]) / 2.0
+            edges[f, :mids.size] = mids
+        else:
+            e = np.unique(np.quantile(col, qs).astype(np.float32))
+            edges[f, :e.size] = e
+    np.testing.assert_allclose(np.nan_to_num(nat_edges, posinf=1e30),
+                               np.nan_to_num(edges, posinf=1e30), atol=1e-5)
+    nat_bins = bin_apply_native(X, edges, B)
+    host = np.empty(X.shape, np.uint8)
+    for f in range(F):
+        fe = edges[f][np.isfinite(edges[f])]
+        host[:, f] = np.searchsorted(fe, np.nan_to_num(X[:, f], nan=-np.inf),
+                                     side="left")
+    np.testing.assert_array_equal(nat_bins, host)
+
+
+def test_lambdarank_uncovered_rows_are_inert():
+    """Rows outside group_ptr must receive zero gradients (the old scatter
+    unpack left them at zero; the gather unpack must mask them), so a
+    group_ptr that doesn't cover the tail doesn't skew training."""
+    from mmlspark_tpu.lightgbm.core import lambdarank_grads
+    rng = np.random.default_rng(0)
+    n, g_sz = 103, 25  # 4 groups of 25 + 3 uncovered tail rows
+    scores = rng.normal(size=(n, 1)).astype(np.float32)
+    y = rng.integers(0, 3, n).astype(np.float32)
+    gp = np.arange(0, 101, g_sz)  # covers rows [0, 100)
+    g, h = lambdarank_grads(scores, y, gp)
+    assert np.all(g[100:] == 0.0), g[100:]
+    assert np.all(h[100:] <= 1e-10)
+    assert np.abs(g[:100]).sum() > 0
