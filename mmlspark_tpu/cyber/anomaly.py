@@ -206,12 +206,17 @@ class AccessAnomaly(Estimator):
             lc = self.get("likelihood_col")
             counts = np.asarray(data[lc], np.float64)[sel].astype(np.float32) \
                 if lc and lc in data else np.ones(int(sel.sum()), np.float32)
-            # aggregate duplicate (user, resource) observations so implicit
-            # confidence is c = 1 + alpha * TOTAL count per pair (Hu-Koren),
-            # not 1 + alpha per log line
+            # aggregate duplicate (user, resource) observations: implicit CF
+            # SUMS counts (c = 1 + alpha * total accesses, Hu-Koren);
+            # explicit mode AVERAGES the rating (d log lines at rating v are
+            # one observation of v, matching the old dense assignment)
             keys = u_idx.astype(np.int64) * n_i + r_idx
             uniq_keys, inv = np.unique(keys, return_inverse=True)
-            counts = np.bincount(inv, weights=counts).astype(np.float32)
+            sums = np.bincount(inv, weights=counts)
+            if self.get("implicit_cf"):
+                counts = sums.astype(np.float32)
+            else:
+                counts = (sums / np.bincount(inv)).astype(np.float32)
             u_idx = (uniq_keys // n_i).astype(np.int64)
             r_idx = (uniq_keys % n_i).astype(np.int64)
             rank = min(self.get("rank"), min(n_u, n_i))

@@ -1,12 +1,13 @@
 from .http import (HTTPRequestData, HTTPResponseData, HTTPClient,
                    AsyncHTTPClient, HTTPTransformer, SimpleHTTPTransformer,
                    REQUEST_BINDING, RESPONSE_BINDING)
-from .binary import read_binary_files, list_files
+from .binary import read_binary_files, list_files, BinaryFileStream
 from .image import read_images, decode_image, images_to_bytes_column
 from . import powerbi
 
 __all__ = ["HTTPRequestData", "HTTPResponseData", "HTTPClient",
            "AsyncHTTPClient", "HTTPTransformer", "SimpleHTTPTransformer",
            "REQUEST_BINDING", "RESPONSE_BINDING", "read_binary_files",
+           "BinaryFileStream",
            "list_files", "read_images", "decode_image",
            "images_to_bytes_column", "powerbi"]

@@ -190,11 +190,14 @@ void mm_chunked_free(void* handle) {
 // Quantile binning (BinMapper hot path).  The reference bins inside LightGBM
 // C++ before any training touches the data; here edge FINDING and bin
 // APPLICATION run multithreaded over features so the 1M x 200 ingest fixed
-// cost stops being a Python/numpy bottleneck.  Semantics byte-match the
-// numpy path in lightgbm/binning.py: per-feature sorted-unique midpoints
-// when distinct values <= B, else linear-interpolated quantiles (np.quantile
+// cost stops being a Python/numpy bottleneck.  Semantics match the numpy
+// path in lightgbm/binning.py: per-feature sorted-unique midpoints when
+// distinct values <= B, else linear-interpolated quantiles (np.quantile
 // default), deduped as float32, +inf padding; NaN ignored at fit, bin 0 at
-// transform (missing-goes-left).
+// transform (missing-goes-left).  Interpolation here runs in double and is
+// stored float32 — an edge may differ from numpy's by 1 ulp, which can flip
+// the bin of a value EXACTLY on that edge (the parity test covers real data
+// at atol=1e-5; exact-tie behavior across the two paths is not guaranteed).
 // ---------------------------------------------------------------------------
 
 static void bin_edges_feature(const float* X, int64_t n, int64_t F, int64_t f,

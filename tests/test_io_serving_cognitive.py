@@ -231,3 +231,28 @@ def test_binary_and_image_io(tmp_path):
     imgs = read_images(str(tmp_path), pattern="*.png")
     arr = imgs.collect()["image"][0]
     assert arr.shape == (4, 6, 3)
+
+
+def test_binary_file_stream(tmp_path):
+    """New files under a directory become micro-batch frames exactly once
+    (reference BinaryFileFormat streaming)."""
+    import time
+    from mmlspark_tpu.io.binary import BinaryFileStream
+
+    (tmp_path / "a.bin").write_bytes(b"alpha")
+    stream = BinaryFileStream(str(tmp_path), poll_interval_s=0.05)
+    b1 = stream.get_batch()
+    assert sorted(p.split("/")[-1] for p in b1.collect()["path"]) == ["a.bin"]
+    assert stream.get_batch() is None  # no new files -> no batch
+
+    got = []
+    handle = stream.for_each_batch(
+        lambda df: got.extend(bytes(b) for b in df.collect()["bytes"]))
+    (tmp_path / "b.bin").write_bytes(b"beta")
+    (tmp_path / "c.bin").write_bytes(b"gamma")
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and len(got) < 2:
+        time.sleep(0.05)
+    handle.stop()
+    assert sorted(got) == [b"beta", b"gamma"]  # a.bin already delivered
+    assert handle.last_error is None
