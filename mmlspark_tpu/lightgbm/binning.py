@@ -21,11 +21,16 @@ class BinMapper:
     """Per-feature quantile bin edges.  edges[f] has length (max_bin - 1),
     padded with +inf for features with fewer distinct values."""
 
-    def __init__(self, max_bin: int = 255):
+    def __init__(self, max_bin: int = 255, categorical_features=None):
         if not 2 <= max_bin <= 256:
             raise ValueError("max_bin must be in [2, 256]")
         self.max_bin = max_bin
         self.edges: Optional[np.ndarray] = None  # (F, max_bin - 1) float32
+        # categorical features bin by CATEGORY CODE (bin = clip(round(x),
+        # 0, max_bin-1)); no quantile edges exist for them (reference
+        # categorical handling, LightGBMBase.getCategoricalIndexes:168)
+        self.categorical_features = sorted(int(i) for i in
+                                           (categorical_features or []))
 
     @property
     def num_bins(self) -> int:
@@ -47,11 +52,16 @@ class BinMapper:
             from ..utils.native_loader import bin_edges_native
             native = bin_edges_native(X, B)
             if native is not None:
+                if self.categorical_features:  # code-binned: no edges
+                    native[self.categorical_features] = np.inf
                 self.edges = native
                 return self
         edges = np.full((F, B - 1), np.inf, np.float32)
         qs = np.linspace(0, 1, B + 1)[1:-1]  # B-1 interior quantiles
+        cats = set(self.categorical_features)
         for f in range(F):
+            if f in cats:
+                continue  # code-binned: no numerical edges
             col = X[:, f]
             col = col[~np.isnan(col)]
             if col.size == 0:
@@ -86,20 +96,40 @@ class BinMapper:
         if device:
             import jax.numpy as jnp
             from ..ops.histogram import bin_matrix  # module-level jit cache
-            out = bin_matrix(jnp.asarray(X), jnp.asarray(self.edges),
-                             self.max_bin)
-            return np.asarray(out)
+            out = np.asarray(bin_matrix(jnp.asarray(X),
+                                        jnp.asarray(self.edges),
+                                        self.max_bin))
+            return self._overwrite_cat_bins(X, out)
         import multiprocessing
         if X.size >= 1 << 16 and multiprocessing.cpu_count() >= 4:
             from ..utils.native_loader import bin_apply_native
             native = bin_apply_native(X, self.edges, self.max_bin)
             if native is not None:
-                return native
+                return self._overwrite_cat_bins(X, native)
         out = np.empty(X.shape, np.uint8)
+        cats = set(self.categorical_features)
         for f in range(X.shape[1]):
+            if f in cats:
+                # NaN maps to the LAST bin (reserve it as the missing/other
+                # category; encode real categories as 0..max_bin-2)
+                codes = np.nan_to_num(X[:, f], nan=float(self.max_bin - 1))
+                out[:, f] = np.clip(np.round(codes), 0, self.max_bin - 1) \
+                    .astype(np.uint8)
+                continue
             finite_edges = self.edges[f][np.isfinite(self.edges[f])]
             out[:, f] = np.searchsorted(finite_edges, np.nan_to_num(X[:, f], nan=-np.inf),
                                         side="left")
+        return out
+
+    def _overwrite_cat_bins(self, X: np.ndarray, out: np.ndarray) -> np.ndarray:
+        """Fast paths bin everything numerically; categorical columns are
+        then overwritten with their vectorized code binning, so ONE
+        categorical feature doesn't demote the whole matrix to the scalar
+        loop (NaN -> reserved last bin)."""
+        for f in self.categorical_features:
+            codes = np.nan_to_num(X[:, f], nan=float(self.max_bin - 1))
+            out[:, f] = np.clip(np.round(codes), 0, self.max_bin - 1) \
+                .astype(np.uint8)
         return out
 
     def bin_upper_value(self) -> np.ndarray:

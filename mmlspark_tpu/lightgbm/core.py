@@ -67,6 +67,10 @@ class GBDTParams:
     metric: str = ""
     seed: int = 0
     verbosity: int = -1
+    # one-vs-rest categorical splits (reference getCategoricalIndexes,
+    # LightGBMBase.scala:168): these feature indices bin by CATEGORY CODE
+    # and split as code == c vs rest (LightGBM's max_cat_to_onehot mode)
+    categorical_features: Optional[Tuple[int, ...]] = None
 
     def resolve(self) -> "GBDTParams":
         p = dataclasses.replace(self)
@@ -215,7 +219,8 @@ def _params_sig(p: "GBDTParams") -> tuple:
             p.learning_rate, p.lambda_l1, p.lambda_l2, p.min_data_in_leaf,
             p.min_sum_hessian_in_leaf, p.min_gain_to_split, p.max_delta_step,
             p.sigmoid, p.alpha, p.top_rate, p.other_rate, p.feature_fraction,
-            p.bagging_fraction, p.bagging_freq)
+            p.bagging_fraction, p.bagging_freq,
+            tuple(p.categorical_features or ()))
 
 
 def _cached(key, builder):
@@ -252,6 +257,10 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
     D, F, B = max_depth, num_features, num_bins
     I = 2 ** D - 1     # internal nodes
     L = 2 ** D         # leaves
+    cat_np = np.zeros((F,), bool)
+    if params.categorical_features:
+        cat_np[list(params.categorical_features)] = True
+    has_cat = bool(cat_np.any())
     l1, l2 = params.lambda_l1, params.lambda_l2
     min_data = float(params.min_data_in_leaf)
     min_hess = params.min_sum_hessian_in_leaf
@@ -280,8 +289,17 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
         internal_value = jnp.zeros((I,), jnp.float32)
         internal_count = jnp.zeros((I,), jnp.float32)
 
+        cat_b = jnp.asarray(cat_np)
         edge_finite = jnp.concatenate(
             [jnp.isfinite(edges), jnp.zeros((F, 1), bool)], axis=1)[None, :, :]
+        if has_cat:
+            # every bin of a categorical feature is a candidate code EXCEPT
+            # the last: BinMapper reserves bin max_bin-1 for NaN/overflow,
+            # and a split on it would route missing rows left at train but
+            # right at predict (x != code with NaN -> right)
+            cat_cand = cat_b[None, :, None] & \
+                (jnp.arange(B) != B - 1)[None, None, :]
+            edge_finite = edge_finite | cat_cand
         prev_hist = None
         best_stats = None
         for d in range(D):
@@ -300,10 +318,14 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
                     .reshape(nodes_d, F, B, 3)
             prev_hist = hist_d
 
-            # (nodes, F, B, 3) -> cumulative over bins
+            # (nodes, F, B, 3) -> cumulative over bins.  LEFT-child stats:
+            # numerical split at t takes bins <= t (the cumsum); categorical
+            # one-vs-rest at code c takes bin c alone (the histogram itself)
             cum = jnp.cumsum(hist_d, axis=2)
             tot = cum[:, :1, -1, :]                 # (nodes,1,3) totals (feature 0 = any)
-            GL, HL, CL = cum[..., 0], cum[..., 1], cum[..., 2]
+            left3 = jnp.where(cat_b[None, :, None, None], hist_d, cum) \
+                if has_cat else cum
+            GL, HL, CL = left3[..., 0], left3[..., 1], left3[..., 2]
             Gp, Hp, Cp = tot[..., 0], tot[..., 1], tot[..., 2]
             GR, HR, CR = Gp[:, :, None] - GL, Hp[:, :, None] - HL, Cp[:, :, None] - CL
             gain = (leaf_score(GL, HL) + leaf_score(GR, HR)
@@ -324,7 +346,10 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
             idx = off + jnp.arange(nodes_d)
             split_feature = split_feature.at[idx].set(jnp.where(do_split, bf, -1))
             threshold_bin = threshold_bin.at[idx].set(bb)
-            threshold = threshold.at[idx].set(edges[bf, jnp.clip(bb, 0, B - 2)])
+            thr_raw = edges[bf, jnp.clip(bb, 0, B - 2)]
+            if has_cat:  # categorical: the raw threshold IS the category code
+                thr_raw = jnp.where(cat_b[bf], bb.astype(jnp.float32), thr_raw)
+            threshold = threshold.at[idx].set(thr_raw)
             split_gain = split_gain.at[idx].set(jnp.where(do_split, best_gain, 0.0))
             internal_value = internal_value.at[idx].set(leaf_output(Gp[:, 0], Hp[:, 0]))
             internal_count = internal_count.at[idx].set(Cp[:, 0])
@@ -343,7 +368,12 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
             t_of_row = bb[node]
             s_of_row = do_split[node]
             row_bin = binned[jnp.arange(n), jnp.maximum(f_of_row, 0)].astype(jnp.int32)
-            go_right = s_of_row & (row_bin > t_of_row)
+            if has_cat:
+                right_dec = jnp.where(cat_b[jnp.maximum(f_of_row, 0)],
+                                      row_bin != t_of_row, row_bin > t_of_row)
+            else:
+                right_dec = row_bin > t_of_row
+            go_right = s_of_row & right_dec
             node = 2 * node + go_right.astype(jnp.int32)
 
         # leaves: children of the last level's nodes
@@ -362,20 +392,29 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
 # binned tree walk (for incremental valid scoring / DART drop replay)
 # ---------------------------------------------------------------------------
 
-def make_binned_walker(max_depth: int):
+def make_binned_walker(max_depth: int,
+                       categorical_features: Optional[Tuple[int, ...]] = None):
     import jax
     import jax.numpy as jnp
     D = max_depth
+    cats = frozenset(categorical_features or ())
 
     @jax.jit
     def walk(binned, split_feature, threshold_bin):
         n = binned.shape[0]
         node = jnp.zeros((n,), jnp.int32)
+        F = binned.shape[1]
+        cat_b = jnp.asarray(np.isin(np.arange(F), list(cats))) if cats else None
         for _ in range(D):
             f = split_feature[node]
             t = threshold_bin[node]
             row_bin = binned[jnp.arange(n), jnp.maximum(f, 0)].astype(jnp.int32)
-            go_right = (f >= 0) & (row_bin > t)
+            if cat_b is not None:
+                dec = jnp.where(cat_b[jnp.maximum(f, 0)], row_bin != t,
+                                row_bin > t)
+            else:
+                dec = row_bin > t
+            go_right = (f >= 0) & dec
             node = 2 * node + 1 + go_right.astype(jnp.int32)
         return node - (2 ** D - 1)
 
@@ -480,7 +519,14 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
     K = p.num_class if p.objective == "multiclass" else 1
     w = np.ones(n, np.float32) if sample_weight is None else np.asarray(sample_weight, np.float32)
 
-    mapper = BinMapper(p.max_bin).fit(X)
+    if p.categorical_features:
+        bad = [i for i in p.categorical_features if not 0 <= int(i) < F]
+        if bad:
+            raise ValueError(f"categorical_features indices {bad} out of "
+                             f"range [0, {F}) — negative indices are not "
+                             f"interpreted pythonically")
+    mapper = BinMapper(p.max_bin,
+                       categorical_features=p.categorical_features).fit(X)
     binned_np = mapper.transform(X)
     edges = jnp.asarray(mapper.edges)
     B = mapper.num_bins
@@ -539,7 +585,8 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
                                            "split_gain", "internal_value", "internal_count",
                                            "leaf_value", "leaf_count")}
     tree_weights: List[float] = []
-    walker = _cached(("walker", D), lambda: make_binned_walker(D))
+    walker = _cached(("walker", D, tuple(p.categorical_features or ())),
+                     lambda: make_binned_walker(D, p.categorical_features))
     if init_booster is not None:
         assert init_booster.max_depth == D and init_booster.num_features == F
         for t in range(init_booster.num_trees):
@@ -863,5 +910,6 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
         np.asarray(tree_weights, np.float32),
         max_depth=D, num_features=F, objective=p.objective, num_class=K,
         init_score=init_score, average_output=(p.boosting_type == "rf"),
-        feature_names=feature_names, best_iteration=best_iter, sigmoid=p.sigmoid)
+        feature_names=feature_names, best_iteration=best_iter, sigmoid=p.sigmoid,
+        categorical_features=list(p.categorical_features or []))
     return TrainResult(booster=booster, evals=evals, bin_mapper=mapper)

@@ -58,6 +58,9 @@ def _shared_params(cls):
         ("parallelism", "data_parallel|voting_parallel|serial (accepted for "
                         "parity; all map to histogram psum)", "string", "data_parallel"),
         ("shard_rows", "shard rows over the active device mesh", "bool", False),
+        ("categorical_features", "feature indices treated as categorical "
+         "(one-vs-rest code==c splits; reference getCategoricalIndexes, "
+         "LightGBMBase.scala:168)", "list", None),
     ]
     for name, doc, dtype, default in specs:
         setattr(cls, name, Param(name, doc, dtype, default))
@@ -95,7 +98,9 @@ class _LightGBMBase(Estimator, HasFeaturesCol, HasLabelCol, HasWeightCol):
             skip_drop=self.get("skip_drop"),
             max_delta_step=self.get("max_delta_step"),
             early_stopping_round=self.get("early_stopping_round"),
-            metric=self.get("metric"), seed=self.get("seed"))
+            metric=self.get("metric"), seed=self.get("seed"),
+            categorical_features=tuple(self.get("categorical_features") or ())
+            or None)
         return p
 
     def _collect_xyw(self, df: DataFrame):

@@ -54,7 +54,8 @@ class GBDTBooster(Saveable):
                  objective: str = "regression", num_class: int = 1,
                  init_score: float = 0.0, average_output: bool = False,
                  feature_names: Optional[List[str]] = None,
-                 best_iteration: int = -1, sigmoid: float = 1.0):
+                 best_iteration: int = -1, sigmoid: float = 1.0,
+                 categorical_features: Optional[List[int]] = None):
         self.split_feature = np.asarray(split_feature, np.int32)
         self.threshold = np.asarray(threshold, np.float32)
         self.threshold_bin = np.asarray(threshold_bin, np.int32)
@@ -73,6 +74,15 @@ class GBDTBooster(Saveable):
         self.feature_names = feature_names or [f"f{i}" for i in range(num_features)]
         self.best_iteration = int(best_iteration)
         self.sigmoid = float(sigmoid)
+        # one-vs-rest categorical splits: for these features, threshold holds
+        # the CATEGORY CODE and the decision is x == code -> left (reference
+        # categorical support, LightGBMBase.getCategoricalIndexes:168; NaN
+        # matches no category and routes right)
+        self.categorical_features = sorted(int(i) for i in
+                                           (categorical_features or []))
+        self._is_cat = np.zeros(self.num_features, bool)
+        if self.categorical_features:
+            self._is_cat[self.categorical_features] = True
 
     # ------------------------------------------------------------------ shape
     @property
@@ -107,15 +117,20 @@ class GBDTBooster(Saveable):
             node = np.zeros((n_rows, T), np.int64)
             t_idx = np.arange(T)[None, :]
             r_idx = np.arange(n_rows)[:, None]
+            isc_all = self._is_cat
             for _ in range(D):
                 f = sf[t_idx, node]
                 thr = th[t_idx, node]
                 xv = Xn[r_idx, np.maximum(f, 0)]
-                node = 2 * node + 1 + ((f >= 0) & (xv > thr))
+                isc = isc_all[np.maximum(f, 0)]
+                # categorical codes compare after rounding, matching the
+                # round() used at binning time (2.9999 trains as code 3)
+                go_right = np.where(isc, np.round(xv) != thr, xv > thr)
+                node = 2 * node + 1 + ((f >= 0) & go_right)
             return (node - (2 ** D - 1)).astype(np.int64)
 
         @partial(jax.jit, static_argnames=())
-        def walk(X, sf, th):
+        def walk(X, sf, th, cat):
             n = X.shape[0]
             Xn = jnp.nan_to_num(X, nan=-jnp.inf)  # missing routes left
 
@@ -126,7 +141,9 @@ class GBDTBooster(Saveable):
                     f = sf_t[node]
                     thr = th_t[node]
                     x = Xn[jnp.arange(n), jnp.maximum(f, 0)]
-                    go_right = (f >= 0) & (x > thr)
+                    go_right = (f >= 0) & jnp.where(cat[jnp.maximum(f, 0)],
+                                                    jnp.round(x) != thr,
+                                                    x > thr)
                     return 2 * node + 1 + go_right.astype(jnp.int32)
 
                 node = jax.lax.fori_loop(0, D, body, node)
@@ -135,7 +152,7 @@ class GBDTBooster(Saveable):
             return jax.vmap(one_tree)(sf, th).T  # (n, T)
 
         return np.asarray(walk(jnp.asarray(X, jnp.float32), jnp.asarray(sf),
-                               jnp.asarray(th)))
+                               jnp.asarray(th), jnp.asarray(self._is_cat)))
 
     def predict_leaf(self, X: np.ndarray) -> np.ndarray:
         """Reference ``predictLeaf`` (LightGBMBooster.scala:403)."""
@@ -201,7 +218,10 @@ class GBDTBooster(Saveable):
             for d in range(D):
                 f = self.split_feature[t, node]
                 thr = self.threshold[t, node]
-                go_right = (f >= 0) & (Xn[np.arange(n), np.maximum(f, 0)] > thr)
+                xv = Xn[np.arange(n), np.maximum(f, 0)]
+                isc = self._is_cat[np.maximum(f, 0)]
+                go_right = (f >= 0) & np.where(isc, np.round(xv) != thr,
+                                               xv > thr)
                 nxt = 2 * node + 1 + go_right
                 is_leaf_level = d == D - 1
                 if is_leaf_level:
@@ -232,6 +252,7 @@ class GBDTBooster(Saveable):
     def merge(self, other: "GBDTBooster") -> "GBDTBooster":
         """Concatenate trees (reference ``mergeBooster:252`` batch training)."""
         assert self.max_depth == other.max_depth and self.num_class == other.num_class
+        assert self.categorical_features == other.categorical_features
         cat = lambda a, b: np.concatenate([a, b], axis=0)
         return GBDTBooster(
             cat(self.split_feature, other.split_feature),
@@ -246,11 +267,13 @@ class GBDTBooster(Saveable):
             max_depth=self.max_depth, num_features=self.num_features,
             objective=self.objective, num_class=self.num_class,
             init_score=self.init_score, average_output=self.average_output,
-            feature_names=self.feature_names, sigmoid=self.sigmoid)
+            feature_names=self.feature_names, sigmoid=self.sigmoid,
+            categorical_features=self.categorical_features)
 
     # ------------------------------------------------------------------ serde
     _META = ("max_depth", "num_features", "objective", "num_class", "init_score",
-             "average_output", "feature_names", "best_iteration", "sigmoid")
+             "average_output", "feature_names", "best_iteration", "sigmoid",
+             "categorical_features")
     _ARRAYS = ("split_feature", "threshold", "threshold_bin", "split_gain",
                "internal_value", "internal_count", "leaf_value", "leaf_count",
                "tree_weight")
@@ -375,7 +398,10 @@ def _tree_shap_one(x, phi, t, booster: "GBDTBooster"):
             recurse(left, path, 1.0, 1.0, -2)
             return
         xv = x[f]
-        goes_left = not (xv > th[j])        # NaN compares False -> left
+        if booster._is_cat[f]:
+            goes_left = round(xv) == th[j] if np.isfinite(xv) else False
+        else:
+            goes_left = not (xv > th[j])    # NaN compares False -> left
         hot, cold = (left, right) if goes_left else (right, left)
         rj = max(cover(j), 1e-12)
         hz, cz = cover(hot) / rj, cover(cold) / rj

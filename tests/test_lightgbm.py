@@ -341,3 +341,99 @@ def test_lambdarank_uncovered_rows_are_inert():
     assert np.all(g[100:] == 0.0), g[100:]
     assert np.all(h[100:] <= 1e-10)
     assert np.abs(g[:100]).sum() > 0
+
+
+def test_categorical_one_vs_rest_splits():
+    """Categorical features split as code == c vs rest (the reference's
+    categorical support, getCategoricalIndexes LightGBMBase.scala:168).
+    Membership in a scattered code set is learnable at a depth where
+    numerical thresholds on the same codes are not."""
+    from mmlspark_tpu.lightgbm import GBDTParams, train
+    rng = np.random.default_rng(0)
+    n = 3000
+    codes = rng.integers(0, 24, n).astype(np.float32)
+    noise = rng.normal(size=(n, 2)).astype(np.float32)
+    X = np.column_stack([codes, noise])
+    hot = {2.0, 7.0, 11.0, 19.0}
+    y = np.isin(codes, list(hot)).astype(np.float32)
+
+    p_cat = GBDTParams(num_iterations=12, objective="binary", max_depth=3,
+                       min_data_in_leaf=5, categorical_features=(0,))
+    res_cat = train(X, y, p_cat)
+    acc_cat = float(((res_cat.booster.predict(X) > 0.5) == y).mean())
+
+    p_num = GBDTParams(num_iterations=12, objective="binary", max_depth=3,
+                       min_data_in_leaf=5)
+    acc_num = float(((train(X, y, p_num).booster.predict(X) > 0.5) == y).mean())
+    assert acc_cat > 0.97, acc_cat
+    assert acc_cat > acc_num + 0.01, (acc_cat, acc_num)
+
+    b = res_cat.booster
+    # the model must actually use == splits on the categorical feature, with
+    # thresholds that ARE category codes
+    cat_splits = b.split_feature == 0  # -1 sentinel excluded by ==
+    assert cat_splits.any()
+    thr = b.threshold[cat_splits]
+    assert np.allclose(thr, np.round(thr))
+    assert set(np.unique(thr)) <= set(np.arange(24, dtype=np.float32))
+
+    # serde round-trips the categorical metadata and predictions
+    from mmlspark_tpu.models.gbdt import GBDTBooster
+    b2 = GBDTBooster.from_string(b.to_string())
+    assert b2.categorical_features == [0]
+    np.testing.assert_allclose(b2.predict(X[:100]), b.predict(X[:100]),
+                               rtol=1e-6)
+
+    # TreeSHAP stays additive with categorical splits
+    contrib = b.predict_contrib(X[:20])
+    raw = b.raw_scores(X[:20])[:, 0]
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, atol=1e-4)
+
+
+def test_categorical_estimator_surface():
+    from mmlspark_tpu.lightgbm import LightGBMClassifier
+    from mmlspark_tpu.core import DataFrame
+    from mmlspark_tpu.core.schema import vector_column
+    rng = np.random.default_rng(1)
+    codes = rng.integers(0, 8, 400).astype(np.float64)
+    y = np.isin(codes, [1, 4, 6]).astype(np.float64)
+    X = np.column_stack([codes, rng.normal(size=400)])
+    df = DataFrame.from_dict({"features": vector_column(list(X)), "label": y})
+    clf = LightGBMClassifier().set_params(num_iterations=10, max_depth=3,
+                                          min_data_in_leaf=3,
+                                          categorical_features=[0])
+    model = clf.fit(df)
+    pred = model.transform(df).collect()["prediction"]
+    assert float((pred == y).mean()) > 0.97
+
+
+def test_categorical_nan_and_validation():
+    """NaN categorical values bin to the reserved last bin, never become a
+    split code, and route RIGHT consistently at train and predict time;
+    out-of-range categorical indices raise."""
+    from mmlspark_tpu.lightgbm import GBDTParams, train
+    rng = np.random.default_rng(2)
+    n = 1200
+    codes = rng.integers(0, 6, n).astype(np.float32)
+    codes[::7] = np.nan  # missingness correlates with the label
+    y = (np.nan_to_num(codes, nan=99) == 3).astype(np.float32)
+    X = np.column_stack([codes, rng.normal(size=n).astype(np.float32)])
+    p = GBDTParams(num_iterations=8, objective="binary", max_depth=3,
+                   min_data_in_leaf=3, max_bin=16, categorical_features=(0,))
+    res = train(X, y, p)
+    b = res.booster
+    cat_thr = b.threshold[b.split_feature == 0]
+    assert not np.any(cat_thr == 15), "reserved NaN bin must never be a code"
+    # training-time fit and predict-time walk agree on the NaN rows
+    pred = b.predict(X)
+    acc = float(((pred > 0.5) == y).mean())
+    assert acc > 0.95, acc
+    # non-integer codes round consistently with binning
+    Xq = X.copy()
+    Xq[:, 0] = np.where(np.isnan(Xq[:, 0]), np.nan, Xq[:, 0] + 0.001)
+    np.testing.assert_allclose(b.predict(Xq), pred, rtol=1e-6)
+
+    import pytest as _pt
+    with _pt.raises(ValueError, match="out of range"):
+        train(X, y, GBDTParams(num_iterations=1, objective="binary",
+                               categorical_features=(-1,)))
