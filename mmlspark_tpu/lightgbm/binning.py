@@ -110,24 +110,26 @@ class BinMapper:
         cats = set(self.categorical_features)
         for f in range(X.shape[1]):
             if f in cats:
-                # NaN maps to the LAST bin (reserve it as the missing/other
-                # category; encode real categories as 0..max_bin-2)
-                codes = np.nan_to_num(X[:, f], nan=float(self.max_bin - 1))
-                out[:, f] = np.clip(np.round(codes), 0, self.max_bin - 1) \
-                    .astype(np.uint8)
-                continue
+                continue  # filled by _overwrite_cat_bins (single code path)
             finite_edges = self.edges[f][np.isfinite(self.edges[f])]
             out[:, f] = np.searchsorted(finite_edges, np.nan_to_num(X[:, f], nan=-np.inf),
                                         side="left")
-        return out
+        return self._overwrite_cat_bins(X, out)
 
     def _overwrite_cat_bins(self, X: np.ndarray, out: np.ndarray) -> np.ndarray:
-        """Fast paths bin everything numerically; categorical columns are
-        then overwritten with their vectorized code binning, so ONE
-        categorical feature doesn't demote the whole matrix to the scalar
-        loop (NaN -> reserved last bin)."""
+        """The ONE categorical code-binning path (all transform variants end
+        here): NaN -> reserved last bin; codes must be non-negative ints
+        (clip+round would otherwise silently alias negatives onto code 0
+        while predict-time walks compare the raw value)."""
         for f in self.categorical_features:
-            codes = np.nan_to_num(X[:, f], nan=float(self.max_bin - 1))
+            col = X[:, f]
+            finite = col[~np.isnan(col)]
+            if finite.size and finite.min() < 0:
+                raise ValueError(
+                    f"categorical feature {f} holds negative codes "
+                    f"(min {finite.min()}); encode categories as "
+                    f"non-negative integers (e.g. via ValueIndexer)")
+            codes = np.nan_to_num(col, nan=float(self.max_bin - 1))
             out[:, f] = np.clip(np.round(codes), 0, self.max_bin - 1) \
                 .astype(np.uint8)
         return out

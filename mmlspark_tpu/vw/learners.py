@@ -14,8 +14,9 @@ weights and optimizer accumulators (``_allreduce_pass_end``) — the
 spanning-tree replacement (SURVEY.md §2.12).
 
 The update rule follows VW's ``--adaptive --normalized`` defaults: AdaGrad
-per-weight step sizes with per-weight scale normalization; ``--bfgs`` errors
-(use more passes instead).  TrainingStats diagnostics mirror the reference's
+per-weight step sizes with per-weight scale normalization; ``--bfgs``
+switches to full-batch L-BFGS over the cached examples (optax.lbfgs with
+line search — the batch-mode reduction).  TrainingStats diagnostics mirror the reference's
 per-partition stats DataFrame (``VowpalWabbitBase.scala:27-49``).
 """
 from __future__ import annotations
@@ -126,6 +127,27 @@ def _interaction_features(part: Dict, base_col: np.ndarray, specs: List[str],
     return out
 
 
+def _loss_values(loss: str, quantile_tau: float):
+    """Loss VALUES (for the --bfgs batch objective; grads below for SGD)."""
+    import jax.numpy as jnp
+
+    def logistic(pred, y):
+        return jnp.logaddexp(0.0, -y * pred)
+
+    def squared(pred, y):
+        return 0.5 * (pred - y) ** 2
+
+    def hinge(pred, y):
+        return jnp.maximum(0.0, 1.0 - y * pred)
+
+    def quantile(pred, y):
+        e = y - pred
+        return jnp.maximum(quantile_tau * e, (quantile_tau - 1.0) * e)
+
+    return {"logistic": logistic, "squared": squared, "hinge": hinge,
+            "quantile": quantile}[loss]
+
+
 def _loss_grads(loss: str, quantile_tau: float):
     import jax.numpy as jnp
 
@@ -159,6 +181,10 @@ class _VWBase(Estimator, HasFeaturesCol, HasLabelCol, HasWeightCol):
     normalized = Param("normalized", "scale-normalized updates (VW --normalized)", "bool", default=True)
     batch_size = Param("batch_size", "device minibatch size", "int", default=256)
     initial_model = Param("initial_model", "warm-start model bytes", "object")
+    optimizer = Param("optimizer", "sgd (online adaptive/normalized updates) "
+                      "| bfgs (full-batch L-BFGS, the VW --bfgs batch mode)",
+                      "string", default="sgd",
+                      validator=lambda v: v in ("sgd", "bfgs"))
     args = Param("args", "VW-style passthrough arg string (subset parsed: "
                          "-b -l --l1 --l2 --passes --loss_function --power_t "
                          "--initial_t --(no)adaptive --(no)normalized -q "
@@ -221,8 +247,7 @@ class _VWBase(Estimator, HasFeaturesCol, HasLabelCol, HasWeightCol):
             elif t == "--quiet":
                 pass
             elif t == "--bfgs":
-                raise NotImplementedError("--bfgs is not supported on the TPU "
-                                          "backend; increase --passes instead")
+                self.set("optimizer", "bfgs")
             i += 1
 
     def _make_trainer(self, loss_name: str):
@@ -265,11 +290,91 @@ class _VWBase(Estimator, HasFeaturesCol, HasLabelCol, HasWeightCol):
 
         return step, D
 
+    def _fit_bfgs(self, df: DataFrame, loss_name: str, y_transform):
+        """VW ``--bfgs``: batch optimization over the cached examples
+        (reference: VW's bfgs reduction runs L-BFGS passes over the cache
+        file).  One padded (n, k) gather turns the hashed-sparse model into
+        a dense objective; ``optax.lbfgs`` with line search drives it."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        D = 1 << self.get("num_bits")
+        mask = D - 1
+        fc, lc = self.get("features_col"), self.get("label_col")
+        wc = self.get("weight_col")
+        specs = self.get("interactions") or []
+        sw = StopWatch()
+        parts_idx, ys, ws = [], [], []
+        max_nnz = 1
+        with sw.measure("ingest"):
+            for part in df.partitions:
+                if fc not in part or len(part[fc]) == 0:
+                    continue
+                feats = part[fc]
+                if specs:
+                    feats = _interaction_features(part, feats, specs, mask)
+                max_nnz = max(max_nnz, max((len(v["indices"]) for v in feats),
+                                           default=1))
+                parts_idx.append(feats)
+                ys.append(y_transform(np.asarray(part[lc], np.float64)))
+                ws.append(np.asarray(part[wc], np.float32) if wc
+                          else np.ones(len(feats), np.float32))
+            cols = np.concatenate([np.asarray(c, dtype=object) for c in parts_idx]) \
+                if parts_idx else np.empty(0, dtype=object)
+            idx, val = pack_sparse_column(cols, max_nnz=max_nnz, mask=mask)
+            y = np.concatenate(ys).astype(np.float32) if ys else np.zeros(0, np.float32)
+            w = np.concatenate(ws) if ws else np.zeros(0, np.float32)
+        n = len(y)
+        loss_vals = _loss_values(loss_name, 0.5)
+        l1, l2 = self.get("l1"), self.get("l2")
+        idx_d, val_d = jnp.asarray(idx), jnp.asarray(val)
+        y_d, w_d = jnp.asarray(y), jnp.asarray(w)
+
+        def objective(weights):
+            pred = jnp.sum(weights[idx_d] * val_d, axis=1)
+            base = jnp.sum(loss_vals(pred, y_d) * w_d) / jnp.maximum(w_d.sum(), 1e-9)
+            return base + 0.5 * l2 * jnp.sum(weights * weights) \
+                + l1 * jnp.sum(jnp.abs(weights))
+
+        init = self.get("initial_model")
+        w0 = jnp.asarray(VowpalWabbitModelBase.bytes_to_weights(init, D)
+                         if init is not None else np.zeros(D, np.float32))
+        opt = optax.lbfgs()
+        value_and_grad = optax.value_and_grad_from_state(objective)
+
+        @jax.jit
+        def lbfgs_step(weights, opt_state):
+            value, grad = value_and_grad(weights, state=opt_state)
+            updates, opt_state = opt.update(grad, opt_state, weights,
+                                            value=value, grad=grad,
+                                            value_fn=objective)
+            return optax.apply_updates(weights, updates), opt_state
+
+        # respect an explicit --passes; default to 20 L-BFGS iterations when
+        # the user didn't set one (num_passes' online default of 1 would be
+        # a single line-search step)
+        iters = self.get("num_passes") if "num_passes" in self._paramMap else 20
+        with sw.measure("learn"):
+            opt_state = opt.init(w0)
+            weights = w0
+            for _ in range(iters):
+                weights, opt_state = lbfgs_step(weights, opt_state)
+        state = _allreduce_pass_end((weights, jnp.zeros(D), jnp.zeros(D)))
+        stats = [TrainingStats(partition_id=0, rows=n,
+                               features_per_example=float((val != 0).sum() / max(n, 1)),
+                               passes=iters, total_time_s=sw.total_elapsed(),
+                               ingest_time_s=sw.elapsed("ingest"),
+                               learn_time_s=sw.elapsed("learn"))]
+        return np.asarray(state[0]), stats
+
     def _fit_weights(self, df: DataFrame, loss_name: str, y_transform):
         import jax
         import jax.numpy as jnp
 
         self._parse_args()
+        if self.get("optimizer") == "bfgs":
+            return self._fit_bfgs(df, loss_name, y_transform)
         step, D = self._make_trainer(loss_name)
         fc, lc = self.get("features_col"), self.get("label_col")
         wc = self.get("weight_col")
@@ -475,6 +580,10 @@ class VowpalWabbitContextualBandit(_VWBase):
     def _fit(self, df: DataFrame) -> "VowpalWabbitContextualBanditModel":
         import jax.numpy as jnp
         self._parse_args()
+        if self.get("optimizer") == "bfgs":
+            raise NotImplementedError(
+                "--bfgs is not supported for the contextual bandit (the IPS "
+                "objective is trained online); use the default sgd optimizer")
         step, D = self._make_trainer("squared")
         sw = StopWatch()
         shared_c = self.get("shared_col")

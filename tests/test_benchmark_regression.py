@@ -106,7 +106,7 @@ def test_vw_regressor_benchmarks():
     from mmlspark_tpu.vw import VowpalWabbitRegressor
     bench = Benchmarks(os.path.join(RES, "benchmarks_VerifyVowpalWabbitRegressor.csv"))
     for ds_name, (X, y) in _datasets_regression().items():
-        for args in ["", "--adaptive off"]:
+        for args in ["", "--adaptive off", "--bfgs"]:
             Xtr, Xte, ytr, yte = _split(X, y)
 
             def sdf(Xs, ys):
@@ -117,11 +117,14 @@ def test_vw_regressor_benchmarks():
                 return DataFrame.from_dict({"features": c, "label": ys}, 2)
 
             reg = VowpalWabbitRegressor().set_params(num_bits=10, num_passes=10)
-            if args:
+            if args == "--adaptive off":
                 reg.set("adaptive", False)
+            elif args == "--bfgs":
+                reg.set("args", "--bfgs")
             model = reg.fit(sdf(Xtr, ytr))
             pred = model.transform(sdf(Xte, yte)).collect()["prediction"]
             loss = float(np.mean((pred - yte) ** 2))
-            tag = "default" if not args else "no_adaptive"
+            tag = {"": "default", "--adaptive off": "no_adaptive",
+                   "--bfgs": "bfgs"}[args]
             bench.add(f"VowpalWabbitRegressor_{ds_name}_{tag}", loss, 1.0, False)
     _run_or_verify(bench)

@@ -437,3 +437,13 @@ def test_categorical_nan_and_validation():
     with _pt.raises(ValueError, match="out of range"):
         train(X, y, GBDTParams(num_iterations=1, objective="binary",
                                categorical_features=(-1,)))
+
+
+def test_categorical_negative_codes_raise():
+    from mmlspark_tpu.lightgbm import GBDTParams, train
+    X = np.array([[-1.0, 0.5], [2.0, 0.1], [1.0, 0.3]] * 20, np.float32)
+    y = np.array([0, 1, 0] * 20, np.float32)
+    import pytest as _pt
+    with _pt.raises(ValueError, match="negative codes"):
+        train(X, y, GBDTParams(num_iterations=1, objective="binary",
+                               min_data_in_leaf=1, categorical_features=(0,)))

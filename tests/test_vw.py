@@ -99,8 +99,9 @@ def test_vw_args_string():
     assert reg.get("num_bits") == 12
     assert reg.get("learning_rate") == 0.1
     assert reg.get("num_passes") == 3
-    with pytest.raises(NotImplementedError):
-        VowpalWabbitRegressor().set_params(args="--bfgs")._parse_args()
+    bf = VowpalWabbitRegressor().set_params(args="--bfgs")
+    bf._parse_args()
+    assert bf.get("optimizer") == "bfgs"  # batch L-BFGS mode
 
 
 def test_vw_save_load(tmp_path):
@@ -188,3 +189,52 @@ def test_csv_reader(tmp_path):
     p2.write_text("a,b\n1,2\n3,4\n")
     df2 = read_csv(str(p2), numeric_only=True)
     assert df2.collect()["b"].tolist() == [2.0, 4.0]
+
+
+def test_bfgs_batch_mode():
+    """VW --bfgs: full-batch L-BFGS matches (or beats) the online SGD path
+    on a linear target, parsed from the arg string like the reference's
+    batch mode (VowpalWabbitBase args passthrough)."""
+    from mmlspark_tpu.vw import VowpalWabbitClassifier, VowpalWabbitRegressor
+    rng = np.random.default_rng(0)
+    n = 600
+    x1, x2 = rng.normal(size=n), rng.normal(size=n)
+    y = 2.0 * x1 - 1.0 * x2 + rng.normal(scale=0.1, size=n)
+    col = np.empty(n, dtype=object)
+    for i in range(n):
+        col[i] = {"indices": np.array([3, 9], np.int32),
+                  "values": np.array([x1[i], x2[i]], np.float32)}
+    df = DataFrame.from_dict({"features": col, "label": y}, 2)
+
+    bfgs = VowpalWabbitRegressor().set_params(args="--bfgs --passes 20",
+                                              num_bits=10).fit(df)
+    sgd = VowpalWabbitRegressor().set_params(num_passes=20, num_bits=10).fit(df)
+    p_b = bfgs.transform(df).collect()["prediction"]
+    p_s = sgd.transform(df).collect()["prediction"]
+    mse_b = float(np.mean((p_b - y) ** 2))
+    mse_s = float(np.mean((p_s - y) ** 2))
+    assert mse_b < 0.05, mse_b
+    assert mse_b <= mse_s * 1.5
+    # classifier surface too
+    yc = (y > 0).astype(np.float64)
+    dfc = DataFrame.from_dict({"features": col, "label": yc}, 2)
+    clf = VowpalWabbitClassifier().set_params(args="--bfgs", num_bits=10).fit(dfc)
+    acc = float((clf.transform(dfc).collect()["prediction"] == yc).mean())
+    assert acc > 0.95, acc
+
+
+def test_bandit_rejects_bfgs_and_optimizer_validates():
+    from mmlspark_tpu.vw import VowpalWabbitContextualBandit, VowpalWabbitRegressor
+    with pytest.raises(Exception):
+        VowpalWabbitRegressor().set_params(optimizer="lbfgs")  # whitelist
+    cb = VowpalWabbitContextualBandit().set_params(args="--bfgs")
+    acts = np.empty(4, dtype=object)
+    for i in range(4):
+        acts[i] = [{"indices": np.array([1], np.int32),
+                    "values": np.array([1.0], np.float32)}] * 2
+    df = DataFrame.from_dict({"action_features": acts,
+                              "chosen_action": np.ones(4),
+                              "cost": np.zeros(4),
+                              "probability": np.full(4, 0.5)})
+    with pytest.raises(NotImplementedError, match="contextual bandit"):
+        cb.fit(df)
