@@ -40,4 +40,4 @@ Layout mirrors the reference's module map (SURVEY.md §1-2):
 - ``codegen``   — stage reflection, stub/doc generation (ref ``codegen/``)
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
