@@ -197,10 +197,11 @@ class Featurize(Estimator, HasOutputCol):
             kind = ColumnType.of(col)
             if kind in (ColumnType.DOUBLE, ColumnType.LONG, ColumnType.BOOL):
                 fill = float(np.nanmean(col.astype(float))) if self.get("impute_missing") else 0.0
-                plan.append({"col": c, "kind": "numeric",
+                plan.append({"col": c, "kind": "numeric", "width": 1,
                              "fill": 0.0 if not np.isfinite(fill) else fill})
             elif kind == ColumnType.VECTOR:
-                plan.append({"col": c, "kind": "vector"})
+                plan.append({"col": c, "kind": "vector",
+                             "width": int(np.asarray(col[0]).size) if len(col) else 0})
             else:
                 values = [str(v) for v in col if v is not None]
                 levels = sorted(set(values))
@@ -208,9 +209,11 @@ class Featurize(Estimator, HasOutputCol):
                     plan.append({"col": c, "kind": "hash",
                                  "dims": self.get("num_features")})
                 elif self.get("one_hot_encode_categoricals"):
-                    plan.append({"col": c, "kind": "onehot", "levels": levels})
+                    plan.append({"col": c, "kind": "onehot", "width": len(levels),
+                                 "levels": levels})
                 else:
-                    plan.append({"col": c, "kind": "index", "levels": levels})
+                    plan.append({"col": c, "kind": "index", "width": 1,
+                                 "levels": levels})
         m = FeaturizeModel()
         m.set("plan", plan)
         m.set("output_col", self.get("output_col") or "features")
@@ -219,6 +222,18 @@ class Featurize(Estimator, HasOutputCol):
 
 class FeaturizeModel(Model, HasOutputCol):
     plan = Param("plan", "per-column featurization plan", "list")
+
+    def categorical_slots(self):
+        """Assembled-vector slot indices holding CATEGORY CODES (the
+        ``index``-kind plan entries) — the schema metadata the reference's
+        ``getCategoricalIndexes`` (LightGBMBase.scala:168) reads off the
+        assembled vector, used to auto-wire LightGBM categorical splits."""
+        slots, pos = [], 0
+        for spec in self.get_or_fail("plan"):
+            if spec["kind"] == "index":
+                slots.append(pos)
+            pos += spec.get("width", spec.get("dims", 1))
+        return slots
 
     def _transform(self, df):
         plan = self.get_or_fail("plan")

@@ -16,12 +16,30 @@ from ..core import (ComplexParam, DataFrame, Estimator, HasFeaturesCol,
 from ..featurize import Featurize, ValueIndexer
 
 
+def _wire_categorical_slots(learner, featurizer) -> None:
+    """Auto-pass index-encoded slots as LightGBM categorical features — the
+    reference reads categorical slot metadata off the assembled vector
+    (``getCategoricalIndexes``, LightGBMBase.scala:168).  Only fires when
+    the learner HAS the param and the user hasn't set it explicitly."""
+    if "categorical_features" not in type(learner)._params:
+        return
+    if learner.is_set("categorical_features"):
+        return  # respect an explicit user setting, even an empty list
+    slots = featurizer.categorical_slots()
+    if slots:
+        learner.set("categorical_features", slots)
+
+
 class TrainClassifier(Estimator, HasLabelCol):
     model = ComplexParam("model", "underlying classifier estimator")
     features_col = Param("features_col", "assembled features column", "string",
                          default="TrainClassifier_features")
     number_of_features = Param("number_of_features", "hash dims for text", "int",
                                default=2 ** 8)
+    one_hot_encode_categoricals = Param(
+        "one_hot_encode_categoricals", "one-hot string columns; False = index"
+        "-encode and auto-wire LightGBM categorical splits", "bool",
+        default=True)
     reindex_label = Param("reindex_label", "index labels to 0..K-1", "bool", default=True)
 
     def __init__(self, model=None, uid=None, **kwargs):
@@ -49,12 +67,14 @@ class TrainClassifier(Estimator, HasLabelCol):
         feat_cols = [c for c in df.columns if c != lc]
         featurizer = Featurize().set_params(
             input_cols=feat_cols, output_col=fc,
+            one_hot_encode_categoricals=self.get("one_hot_encode_categoricals"),
             num_features=self.get("number_of_features")).fit(work)
         work = featurizer.transform(work)
 
         learner = learner.copy()
         learner.set("features_col", fc)
         learner.set("label_col", label_for_fit)
+        _wire_categorical_slots(learner, featurizer)
         fitted = learner.fit(work)
 
         out = TrainedClassifierModel()
@@ -96,6 +116,10 @@ class TrainRegressor(Estimator, HasLabelCol):
                          default="TrainRegressor_features")
     number_of_features = Param("number_of_features", "hash dims for text", "int",
                                default=2 ** 8)
+    one_hot_encode_categoricals = Param(
+        "one_hot_encode_categoricals", "one-hot string columns; False = index"
+        "-encode and auto-wire LightGBM categorical splits", "bool",
+        default=True)
 
     def __init__(self, model=None, uid=None, **kwargs):
         super().__init__(uid)
@@ -111,11 +135,13 @@ class TrainRegressor(Estimator, HasLabelCol):
         feat_cols = [c for c in df.columns if c != lc]
         featurizer = Featurize().set_params(
             input_cols=feat_cols, output_col=fc,
+            one_hot_encode_categoricals=self.get("one_hot_encode_categoricals"),
             num_features=self.get("number_of_features")).fit(df)
         work = featurizer.transform(df)
         learner = learner.copy()
         learner.set("features_col", fc)
         learner.set("label_col", lc)
+        _wire_categorical_slots(learner, featurizer)
         fitted = learner.fit(work)
         out = TrainedRegressorModel()
         out.set("featurizer", featurizer)

@@ -138,3 +138,29 @@ def test_train_regressor_end_to_end():
     per = ComputePerInstanceStatistics().set_params(label_col="label") \
         .transform(scored).collect()
     assert "L2_loss" in per
+
+
+def test_train_classifier_auto_wires_categorical_slots():
+    """getCategoricalIndexes parity: with one_hot_encode_categoricals=False,
+    TrainClassifier passes the index-encoded slots to LightGBM as
+    categorical_features automatically (schema-driven, no manual indices)."""
+    from mmlspark_tpu.lightgbm import LightGBMClassifier
+    from mmlspark_tpu.train import TrainClassifier
+
+    rng = np.random.default_rng(0)
+    n = 900
+    city = np.array(["ulm", "pau", "ely", "ube", "obi", "aix"], dtype=object)[
+        rng.integers(0, 6, n)]
+    y = np.isin(city, ["pau", "obi"]).astype(np.float64)
+    df = DataFrame.from_dict({"city": city,
+                              "noise": rng.normal(size=n),
+                              "label": y})
+    tc = TrainClassifier(LightGBMClassifier().set_params(
+        num_iterations=10, max_depth=3, min_data_in_leaf=3)) \
+        .set_params(label_col="label", one_hot_encode_categoricals=False)
+    model = tc.fit(df)
+    inner = model.get("inner_model")
+    booster = inner.get("booster")
+    assert booster.categorical_features == [0], booster.categorical_features
+    pred = model.transform(df).collect()["prediction"]
+    assert float((pred == y).mean()) > 0.97
