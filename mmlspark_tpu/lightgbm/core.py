@@ -71,6 +71,12 @@ class GBDTParams:
     # LightGBMBase.scala:168): these feature indices bin by CATEGORY CODE
     # and split as code == c vs rest (LightGBM's max_cat_to_onehot mode)
     categorical_features: Optional[Tuple[int, ...]] = None
+    # voting-parallel (reference parallelism=voting_parallel + topK,
+    # TrainParams.scala:11-12): each shard votes its local top-k features
+    # per node; only the global top-2k features' histograms are allreduced,
+    # cutting ICI traffic from O(F*B) to O(k*B) per node on wide data.
+    # 0 = full histogram psum (data_parallel).
+    voting_k: int = 0
 
     def resolve(self) -> "GBDTParams":
         p = dataclasses.replace(self)
@@ -220,7 +226,7 @@ def _params_sig(p: "GBDTParams") -> tuple:
             p.min_sum_hessian_in_leaf, p.min_gain_to_split, p.max_delta_step,
             p.sigmoid, p.alpha, p.top_rate, p.other_rate, p.feature_fraction,
             p.bagging_fraction, p.bagging_freq,
-            tuple(p.categorical_features or ()))
+            tuple(p.categorical_features or ()), p.voting_k)
 
 
 def _cached(key, builder):
@@ -300,47 +306,107 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
             cat_cand = cat_b[None, :, None] & \
                 (jnp.arange(B) != B - 1)[None, None, :]
             edge_finite = edge_finite | cat_cand
-        prev_hist = None
-        best_stats = None
-        for d in range(D):
-            nodes_d = 2 ** d
-            off = nodes_d - 1                       # BFS offset of this level
-            if d == 0:
-                hist_d = hist(binned, grad, hess,
-                              jnp.where(hist_mask, node, -1), 1)
-            else:
-                # sibling-subtraction (LightGBM's histogram halving): scatter
-                # only rows in LEFT children, derive right = parent - left
-                left_node = jnp.where(hist_mask & (node % 2 == 0), node // 2, -1)
-                hist_left = hist(binned, grad, hess, left_node, nodes_d // 2)
-                hist_right = prev_hist - hist_left
-                hist_d = jnp.stack([hist_left, hist_right], axis=1) \
-                    .reshape(nodes_d, F, B, 3)
-            prev_hist = hist_d
-
-            # (nodes, F, B, 3) -> cumulative over bins.  LEFT-child stats:
-            # numerical split at t takes bins <= t (the cumsum); categorical
-            # one-vs-rest at code c takes bin c alone (the histogram itself)
+        def split_gains(hist_d, fmask2, edge3, catm2):
+            """(nodes, Fs, B, 3) histograms -> (gain, left-stat pick, node
+            totals).  LEFT-child stats: numerical split at t takes bins <= t
+            (the cumsum); categorical one-vs-rest at code c takes bin c alone
+            (the histogram itself).  ``fmask2``/``catm2`` broadcast over
+            (nodes, Fs); ``edge3`` over (nodes, Fs, B)."""
             cum = jnp.cumsum(hist_d, axis=2)
-            tot = cum[:, :1, -1, :]                 # (nodes,1,3) totals (feature 0 = any)
-            left3 = jnp.where(cat_b[None, :, None, None], hist_d, cum) \
+            tot = cum[:, :1, -1, :]                 # (nodes,1,3) totals
+            left3 = jnp.where(catm2[:, :, None, None], hist_d, cum) \
                 if has_cat else cum
             GL, HL, CL = left3[..., 0], left3[..., 1], left3[..., 2]
             Gp, Hp, Cp = tot[..., 0], tot[..., 1], tot[..., 2]
-            GR, HR, CR = Gp[:, :, None] - GL, Hp[:, :, None] - HL, Cp[:, :, None] - CL
+            GR, HR, CR = (Gp[:, :, None] - GL, Hp[:, :, None] - HL,
+                          Cp[:, :, None] - CL)
             gain = (leaf_score(GL, HL) + leaf_score(GR, HR)
                     - leaf_score(Gp, Hp)[:, :, None])
             # split at bin t => left: bins<=t, right: bins>t; needs a finite
             # edge (last bin and inf-padded pseudo-bins can't split)
             valid = ((CL >= min_data) & (CR >= min_data)
                      & (HL >= min_hess) & (HR >= min_hess)
-                     & feat_mask[None, :, None] & edge_finite)
+                     & fmask2[:, :, None] & edge3)
             gain = jnp.where(valid, gain, -jnp.inf)
-            flat = gain.reshape(nodes_d, F * B)
+            pick = jnp.stack([GL, HL, CL], axis=-1)  # (nodes,Fs,B,3)
+            return gain, pick, (Gp[:, 0], Hp[:, 0], Cp[:, 0])
+
+        voting_k = params.voting_k
+        # voting engages whenever it's requested and meaningful (F > k);
+        # when 2k >= F the vote selects every feature — zero comm saving but
+        # identical results, which the equality test exploits
+        use_voting = axis_name is not None and 0 < voting_k < F
+        prev_hist = None
+        best_stats = None
+        for d in range(D):
+            nodes_d = 2 ** d
+            off = nodes_d - 1                       # BFS offset of this level
+            if use_voting:
+                # voting-parallel (reference voting_parallel + topK): each
+                # shard ranks features by LOCAL gain, shards vote, and only
+                # the global top-2k features' histograms cross the mesh —
+                # O(k*B) comm instead of O(F*B).  Sibling subtraction stays
+                # valid on the PRE-psum local histograms (local_right =
+                # local_parent - local_left).
+                if d == 0:
+                    local = hist_ops.build(binned, grad, hess,
+                                           jnp.where(hist_mask, node, -1), 1,
+                                           num_bins, backend=backend)
+                else:
+                    left_node = jnp.where(hist_mask & (node % 2 == 0),
+                                          node // 2, -1)
+                    left_local = hist_ops.build(binned, grad, hess, left_node,
+                                                nodes_d // 2, num_bins,
+                                                backend=backend)
+                    local = jnp.stack([left_local, prev_hist - left_local],
+                                      axis=1).reshape(nodes_d, F, B, 3)
+                prev_hist = local
+                gain_l, _, _ = split_gains(local, feat_mask[None, :],
+                                           edge_finite, cat_b[None, :])
+                per_feat = gain_l.max(axis=2)        # (nodes, F) local best
+                top_gain, top_local = jax.lax.top_k(per_feat, voting_k)
+                # a shard with fewer than k locally-valid candidates must not
+                # cast spurious ballots for the tie-broken low-index features
+                ballot = (top_gain > -jnp.inf).astype(jnp.float32)
+                votes = jnp.zeros((nodes_d, F)).at[
+                    jnp.arange(nodes_d)[:, None], top_local].add(ballot)
+                votes = jax.lax.psum(votes, axis_name)
+                k2 = min(2 * voting_k, F)
+                _, sel = jax.lax.top_k(votes, k2)    # (nodes, k2) global pick
+                sel_hist = jnp.take_along_axis(
+                    local, sel[:, :, None, None], axis=1)
+                sel_hist = jax.lax.psum(sel_hist, axis_name)
+                edge3 = jnp.take_along_axis(
+                    jnp.broadcast_to(edge_finite, (nodes_d, F, B)),
+                    sel[:, :, None], axis=1)
+                gain, pick, (Gp0, Hp0, Cp0) = split_gains(
+                    sel_hist, feat_mask[sel], edge3, cat_b[sel])
+                Fs = k2
+            else:
+                if d == 0:
+                    hist_d = hist(binned, grad, hess,
+                                  jnp.where(hist_mask, node, -1), 1)
+                else:
+                    # sibling-subtraction (LightGBM's histogram halving):
+                    # scatter only rows in LEFT children, right = parent - left
+                    left_node = jnp.where(hist_mask & (node % 2 == 0),
+                                          node // 2, -1)
+                    hist_left = hist(binned, grad, hess, left_node, nodes_d // 2)
+                    hist_right = prev_hist - hist_left
+                    hist_d = jnp.stack([hist_left, hist_right], axis=1) \
+                        .reshape(nodes_d, F, B, 3)
+                prev_hist = hist_d
+                gain, pick, (Gp0, Hp0, Cp0) = split_gains(
+                    hist_d, feat_mask[None, :], edge_finite, cat_b[None, :])
+                sel = None
+                Fs = F
+
+            flat = gain.reshape(nodes_d, Fs * B)
             best = jnp.argmax(flat, axis=1)
             best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
-            bf = (best // B).astype(jnp.int32)
+            bf_local = (best // B).astype(jnp.int32)
             bb = (best % B).astype(jnp.int32)
+            bf = sel[jnp.arange(nodes_d), bf_local] if sel is not None else bf_local
             do_split = best_gain > min_gain
 
             idx = off + jnp.arange(nodes_d)
@@ -351,14 +417,13 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
                 thr_raw = jnp.where(cat_b[bf], bb.astype(jnp.float32), thr_raw)
             threshold = threshold.at[idx].set(thr_raw)
             split_gain = split_gain.at[idx].set(jnp.where(do_split, best_gain, 0.0))
-            internal_value = internal_value.at[idx].set(leaf_output(Gp[:, 0], Hp[:, 0]))
-            internal_count = internal_count.at[idx].set(Cp[:, 0])
+            internal_value = internal_value.at[idx].set(leaf_output(Gp0, Hp0))
+            internal_count = internal_count.at[idx].set(Cp0)
 
             # left/right child stats at the chosen split -> leaf values at the
             # last level come straight from here (no extra leaf pass)
-            pick = jnp.stack([GL, HL, CL], axis=-1)          # (nodes,F,B,3)
-            bsel = pick[jnp.arange(nodes_d), bf, bb, :]      # (nodes,3) left stats
-            tot3 = jnp.stack([Gp[:, 0], Hp[:, 0], Cp[:, 0]], axis=-1)
+            bsel = pick[jnp.arange(nodes_d), bf_local, bb, :]  # (nodes,3) left
+            tot3 = jnp.stack([Gp0, Hp0, Cp0], axis=-1)
             left_stats = jnp.where(do_split[:, None], bsel, tot3)
             right_stats = tot3 - left_stats
             best_stats = (left_stats, right_stats, do_split, tot3)

@@ -55,8 +55,11 @@ def _shared_params(cls):
         ("num_batches", "split training into sequential batches "
                         "(LightGBMBase.scala:46-61)", "int", 0),
         ("seed", "random seed", "int", 0),
-        ("parallelism", "data_parallel|voting_parallel|serial (accepted for "
-                        "parity; all map to histogram psum)", "string", "data_parallel"),
+        ("parallelism", "data_parallel (full histogram psum) | "
+                        "voting_parallel (top-k feature voting, O(k*B) comm) "
+                        "| serial", "string", "data_parallel"),
+        ("top_k", "voting_parallel: local top-k features voted per node "
+                  "(reference TrainParams topK)", "int", 20),
         ("shard_rows", "shard rows over the active device mesh", "bool", False),
         ("categorical_features", "feature indices treated as categorical "
          "(one-vs-rest code==c splits; reference getCategoricalIndexes, "
@@ -100,7 +103,9 @@ class _LightGBMBase(Estimator, HasFeaturesCol, HasLabelCol, HasWeightCol):
             early_stopping_round=self.get("early_stopping_round"),
             metric=self.get("metric"), seed=self.get("seed"),
             categorical_features=tuple(self.get("categorical_features") or ())
-            or None)
+            or None,
+            voting_k=self.get("top_k")
+            if self.get("parallelism") == "voting_parallel" else 0)
         return p
 
     def _collect_xyw(self, df: DataFrame):

@@ -43,3 +43,57 @@ def test_ppermute_ring(mesh8):
     out = shard_mapped(shift, mesh8, in_specs=P("data"), out_specs=P("data"))(x)
     expect = np.roll(np.arange(8), 1).reshape(8, 1)
     assert np.allclose(np.asarray(out), expect)
+
+
+def test_voting_parallel_matches_full_psum_when_k_covers_features():
+    """voting_parallel with 2k >= F selects every feature, so the grown
+    trees must match the full-histogram-psum path up to float associativity
+    (the two paths psum in different orders: global-parent minus global-left
+    vs psum of local-parent minus local-left), reference
+    parallelism=voting_parallel + topK, TrainParams.scala:11-12."""
+    import numpy as np
+    from mmlspark_tpu.lightgbm import GBDTParams, train
+    from mmlspark_tpu.parallel import make_mesh, active_mesh
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(512, 10)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 3] > 0).astype(np.float32)
+    mesh = make_mesh({"data": 8})
+    base = dict(num_iterations=3, objective="binary", max_depth=3,
+                min_data_in_leaf=2)
+    with active_mesh(mesh):
+        full = train(X, y, GBDTParams(**base), shard_rows=True)
+        vote = train(X, y, GBDTParams(**base, voting_k=5), shard_rows=True)
+    # tree 0 consumes identical inputs -> identical structure; later trees
+    # may flip exact-tie splits from last-ulp histogram differences
+    np.testing.assert_array_equal(vote.booster.split_feature[0],
+                                  full.booster.split_feature[0])
+    np.testing.assert_array_equal(vote.booster.threshold_bin[0],
+                                  full.booster.threshold_bin[0])
+    np.testing.assert_allclose(vote.booster.raw_scores(X),
+                               full.booster.raw_scores(X), atol=5e-3)
+    agree = float(((vote.booster.predict(X) > 0.5)
+                   == (full.booster.predict(X) > 0.5)).mean())
+    assert agree > 0.999, agree
+
+
+def test_voting_parallel_small_k_still_learns():
+    """With k far below F, voting restricts the allreduced features per node
+    yet informative features win the vote: accuracy stays high."""
+    import numpy as np
+    from mmlspark_tpu.lightgbm import GBDTParams, train
+    from mmlspark_tpu.parallel import make_mesh, active_mesh
+
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(1024, 40)).astype(np.float32)
+    y = (X[:, 7] + 0.7 * X[:, 23] > 0).astype(np.float32)
+    mesh = make_mesh({"data": 8})
+    with active_mesh(mesh):
+        res = train(X, y, GBDTParams(num_iterations=10, objective="binary",
+                                     max_depth=4, min_data_in_leaf=2,
+                                     voting_k=3),
+                    shard_rows=True)
+    acc = float(((res.booster.predict(X) > 0.5) == y).mean())
+    assert acc > 0.93, acc
+    used = set(res.booster.split_feature[res.booster.split_feature >= 0].tolist())
+    assert 7 in used and 23 in used
