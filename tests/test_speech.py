@@ -7,6 +7,7 @@ import numpy as np
 import pytest
 
 from mmlspark_tpu.core import DataFrame
+from mmlspark_tpu.cognitive.speech import DEFAULT_ALPHABET
 from mmlspark_tpu.cognitive import (ConversationTranscription,
                                     SpeechServingModel, SpeechToTextSDK,
                                     StreamingRecognizer)
@@ -212,3 +213,79 @@ def test_blocking_queue_put_after_close_raises():
     q.close()
     with pytest.raises(RuntimeError):
         q.put(1)
+
+
+def test_onnx_lstm_drives_streaming_recognizer():
+    """Pretrained-acoustic-model story end to end: a torch LSTM+head exported
+    to ONNX wire format becomes the StreamingRecognizer's apply_fn (ONNX
+    LSTM's initial_h/initial_c inputs ARE the streaming carry), and chunked
+    streaming decode matches the torch full-utterance argmax decode."""
+    torch = pytest.importorskip("torch")
+    import torch.nn as tnn
+    from mmlspark_tpu.dl.onnx_wire import build_model, encode_node
+    from mmlspark_tpu.dl.onnx_import import onnx_to_jax
+
+    n_mels, hidden, n_sym = 40, 16, 29
+    torch.manual_seed(0)
+    lstm = tnn.LSTM(input_size=n_mels, hidden_size=hidden).eval()
+    head = tnn.Linear(hidden, n_sym).eval()
+
+    def reorder(w):  # torch ifgo -> ONNX iofc
+        i, f, g, o = np.split(w.detach().numpy(), 4, axis=0)
+        return np.concatenate([i, o, f, g], axis=0)
+
+    init = {
+        "W": reorder(lstm.weight_ih_l0)[None].astype(np.float32),
+        "R": reorder(lstm.weight_hh_l0)[None].astype(np.float32),
+        "B": np.concatenate([reorder(lstm.bias_ih_l0[:, None])[:, 0],
+                             reorder(lstm.bias_hh_l0[:, None])[:, 0]])[None]
+        .astype(np.float32),
+        "hw": head.weight.detach().numpy(), "hb": head.bias.detach().numpy(),
+    }
+    nodes = [
+        encode_node("LSTM", ["x", "W", "R", "B", "", "h0", "c0"],
+                    ["Y", "Y_h", "Y_c"], hidden_size=hidden),
+        encode_node("Squeeze", ["Y"], ["Ys"], axes=[1]),       # (seq,batch,H)
+        encode_node("Gemm", ["Ys_2d", "hw", "hb"], ["logits2d"], transB=1),
+    ]
+    # Squeeze dirs then flatten (seq*batch, H) for the Gemm
+    nodes.insert(2, encode_node("Reshape", ["Ys", "shape2d"], ["Ys_2d"]))
+    init["shape2d"] = np.asarray([-1, hidden], np.int64)
+    data = build_model(nodes, init,
+                       [("x", [8, 1, n_mels]), ("h0", [1, 1, hidden]),
+                        ("c0", [1, 1, hidden])],
+                       [("logits2d", [8, n_sym]), ("Y_h", [1, 1, hidden]),
+                        ("Y_c", [1, 1, hidden])])
+    onnx_fn, onnx_vars = onnx_to_jax(data)
+
+    import jax.numpy as jnp
+
+    def apply_fn(variables, carry, feats):
+        # recognizer feeds (1, T, n_mels); ONNX LSTM wants (T, 1, n_mels)
+        h0, c0 = carry
+        logits2d, yh, yc = onnx_fn(variables, jnp.transpose(feats, (1, 0, 2)),
+                                   h0, c0)
+        return (yh, yc), logits2d[None]            # (1, T, n_sym)
+
+    rec = StreamingRecognizer(apply_fn=apply_fn, variables=onnx_vars,
+                              chunk_s=0.2)
+    rec.init_carry = lambda batch=1: (jnp.zeros((1, 1, hidden), jnp.float32),
+                                      jnp.zeros((1, 1, hidden), jnp.float32))
+    audio = np.concatenate([_tone(250, 0.45), _tone(1100, 0.45)])
+    state = rec.new_state()
+    for chunk in PullAudioStream(audio, SR).chunks(rec.chunk_samples):
+        rec.process_chunk(state, chunk)
+    streamed = rec.finish(state)["text"]
+
+    # torch reference: full-utterance forward + identical CTC collapse
+    feats_full = log_mel(audio, SR, n_mels)
+    with torch.no_grad():
+        y, _ = lstm(torch.from_numpy(feats_full[:, None, :]))
+        ids = head(y[:, 0]).argmax(dim=1).numpy()
+    prev, out = 0, []
+    for i in ids:
+        if i != prev and i != 0:
+            out.append(DEFAULT_ALPHABET[i])
+        prev = int(i)
+    assert streamed == "".join(out)
+    assert len(streamed) > 0
