@@ -24,14 +24,19 @@ def vec_frame(n=60, d=5, seed=0, label=True) -> DataFrame:
 
 def seed_objects() -> Dict[str, TestObject]:
     """Qualname -> TestObject for every stage with a declared seed."""
-    from mmlspark_tpu.lightgbm import LightGBMClassifier, LightGBMRegressor
+    from mmlspark_tpu.lightgbm import (LightGBMClassifier, LightGBMRanker,
+                                       LightGBMRegressor)
     from mmlspark_tpu.vw import VowpalWabbitClassifier, VowpalWabbitFeaturizer
     from mmlspark_tpu.featurize import CleanMissingData, ValueIndexer
     from mmlspark_tpu.isolationforest import IsolationForest
     from mmlspark_tpu.nn import KNN
     from mmlspark_tpu.stages import (FixedMiniBatchTransformer, SummarizeData,
                                      TextPreprocessor)
-    from mmlspark_tpu.opencv import ImageTransformer
+    from mmlspark_tpu.opencv import ImageTransformer, ImageSetAugmenter
+    from mmlspark_tpu.recommendation import SAR
+    from mmlspark_tpu.cognitive import SpeechToTextSDK
+    from mmlspark_tpu.featurize.text import MultiNGram
+    from mmlspark_tpu.io.audio import write_wav
 
     vec = vec_frame()
     rng = np.random.default_rng(1)
@@ -68,5 +73,43 @@ def seed_objects() -> Dict[str, TestObject]:
                    transform_df=txt),
         TestObject(ImageTransformer(input_col="image", output_col="o").resize(4, 4),
                    transform_df=img_df),
+        TestObject(ImageSetAugmenter().set_params(input_col="image",
+                                                  output_col="aug"),
+                   transform_df=img_df),
     ]
+
+    # SAR: three users x five items, every pair seen twice
+    sar_df = DataFrame.from_rows(
+        [{"user": f"u{i % 3}", "item": f"i{(i * 7) % 5}", "rating": 1.0}
+         for i in range(30)])
+    objs.append(TestObject(SAR().set_params(support_threshold=1), sar_df))
+
+    # ranker: grouped queries
+    gsize, nq = 8, 6
+    Xr = rng.normal(size=(gsize * nq, 4))
+    rank_df = DataFrame.from_dict({
+        "features": vector_column(list(Xr)),
+        "label": (Xr[:, 0] > 0).astype(float),
+        "group": np.repeat(np.arange(nq), gsize).astype(float)}, 1)
+    objs.append(TestObject(LightGBMRanker().set_params(
+        num_iterations=3, min_data_in_leaf=2), rank_df))
+
+    # streaming speech over a wav column
+    t = np.arange(4000) / 16000.0
+    wavs = np.empty(1, dtype=object)
+    wavs[0] = write_wav((0.3 * np.sin(2 * np.pi * 440 * t)).astype(np.float32),
+                        16000)
+    stt_df = DataFrame.from_dict({"audio": wavs})
+    objs.append(TestObject(SpeechToTextSDK(input_col="audio",
+                                           output_col="events", chunk_s=0.1),
+                           transform_df=stt_df))
+
+    # n-grams over TOKENIZED text (the stage's contract: list column)
+    toks = np.empty(2, dtype=object)
+    toks[0] = ["the", "quick", "brown", "fox"]
+    toks[1] = ["hello", "world"]
+    tok_df = DataFrame.from_dict({"text": toks})
+    objs.append(TestObject(MultiNGram().set_params(input_col="text",
+                                                   output_col="ngrams"),
+                           transform_df=tok_df))
     return {type(o.stage).__qualname__: o for o in objs}
