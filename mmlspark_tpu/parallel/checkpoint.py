@@ -3,8 +3,17 @@
 Reference checkpointing (SURVEY.md §5.4) covers stage persistence, native
 warm starts, and streaming checkpoints; for DNN training the TPU framework
 adds proper train-state checkpoints: params + optimizer state + step +
-batch_stats, saved via orbax when available (sharding-aware) with an NPZ
-fallback.
+batch_stats.  Two backends:
+
+- ``npz`` (default): NPZ arrays + pickled optimizer state — exact pytree
+  fidelity with zero dependencies, fine for single-host states.
+- ``orbax``: ``orbax.checkpoint.StandardCheckpointer`` — the TPU-ecosystem
+  standard.  Restore takes a TEMPLATE TrainState (e.g. a freshly-built
+  ``trainer.init_state``) whose array shardings drive a sharding-aware
+  restore: each host reads only its shards, and tuples/namedtuples in the
+  optimizer state keep their exact structure (a raw orbax restore without a
+  target flattens them to lists, breaking the compiled step's structure
+  match).
 """
 from __future__ import annotations
 
@@ -16,11 +25,23 @@ import numpy as np
 from .trainer import TrainState
 
 
-def save_train_state(state: TrainState, path: str) -> None:
-    # NPZ arrays + pickled optimizer state: exact pytree fidelity (orbax's
-    # StandardCheckpointer restores tuples as lists without a target tree,
-    # which breaks the compiled step's structure match)
+def _state_tree(state: TrainState):
+    return {"params": state.params, "opt_state": state.opt_state,
+            "step": state.step, "batch_stats": state.batch_stats or {}}
+
+
+def save_train_state(state: TrainState, path: str,
+                     backend: str = "npz") -> None:
     import jax
+    if backend not in ("npz", "orbax"):
+        raise ValueError(f"backend must be 'npz' or 'orbax', got {backend!r}")
+    if backend == "orbax":
+        import orbax.checkpoint as ocp
+        target = os.path.join(os.path.abspath(path), "orbax")
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(target, _state_tree(state), force=True)
+        return
+    # NPZ arrays + pickled optimizer state: exact pytree fidelity
     from flax import traverse_util
     os.makedirs(path, exist_ok=True)
     tree = jax.device_get({"params": state.params,
@@ -34,11 +55,44 @@ def save_train_state(state: TrainState, path: str) -> None:
         pickling.dump(jax.device_get(state.opt_state), f)
 
 
-def load_train_state(path: str, trainer=None) -> TrainState:
-    """Load a checkpoint; with `trainer` given, re-shard onto its mesh."""
+def load_train_state(path: str, trainer=None,
+                     template: Optional[TrainState] = None) -> TrainState:
+    """Load a checkpoint; with ``trainer`` given, re-shard onto its mesh.
+    Orbax checkpoints additionally need ``template`` (structure + shardings
+    to restore into)."""
     import jax
-    state = None
-    if os.path.exists(os.path.join(path, "state.npz")):
+    orbax_dir = os.path.join(os.path.abspath(path), "orbax")
+    npz_path = os.path.join(path, "state.npz")
+    if os.path.exists(orbax_dir) and os.path.exists(npz_path):
+        # both backends wrote here: take the newer artifact, never silently
+        # shadow a fresher save with a stale one
+        use_orbax = os.path.getmtime(orbax_dir) >= os.path.getmtime(npz_path)
+    else:
+        use_orbax = os.path.exists(orbax_dir)
+    if use_orbax:
+        if template is None:
+            raise ValueError(
+                "orbax restore needs template= (a TrainState with the target "
+                "structure/shardings, e.g. trainer.init_state(...))")
+        import orbax.checkpoint as ocp
+
+        def abstract(x):
+            if hasattr(x, "shape") and hasattr(x, "dtype"):
+                sharding = getattr(x, "sharding", None)
+                return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                            sharding=sharding)
+            return x
+
+        tpl = jax.tree.map(abstract, _state_tree(template))
+        with ocp.StandardCheckpointer() as ckptr:
+            tree = ckptr.restore(orbax_dir, tpl)
+        state = TrainState(params=tree["params"], opt_state=tree["opt_state"],
+                           step=tree["step"],
+                           batch_stats=tree.get("batch_stats") or None)
+        if trainer is not None:
+            state = trainer.shard_state(state)
+        return state
+    if os.path.exists(npz_path):
         from flax import traverse_util
         with np.load(os.path.join(path, "state.npz"), allow_pickle=False) as z:
             flat = {k: z[k] for k in z.files}
