@@ -63,6 +63,7 @@ class GBDTParams:
     max_delta_step: float = 0.0
     sigmoid: float = 1.0
     alpha: float = 0.9               # huber / quantile
+    tweedie_variance_power: float = 1.5  # tweedie: 1 (poisson) .. 2 (gamma)
     early_stopping_round: int = 0
     metric: str = ""
     seed: int = 0
@@ -129,8 +130,28 @@ def make_objective(params: GBDTParams) -> Callable:
         g = jnp.where(d >= 0, 1.0 - alpha, -alpha)
         return (g * w)[:, None], (w * jnp.ones_like(g))[:, None]
 
+    def poisson(scores, y, w):
+        # log link: raw score s models log(mean); nll grad = exp(s) - y
+        mu = jnp.exp(jnp.clip(scores[:, 0], -30.0, 30.0))
+        g = mu - y
+        h = jnp.maximum(mu, 1e-16)
+        return (g * w)[:, None], (h * w)[:, None]
+
+    rho = params.tweedie_variance_power
+
+    def tweedie(scores, y, w):
+        # compound-Poisson deviance with log link, variance power rho in
+        # (1, 2): grad = -y*e^{(1-rho)s} + e^{(2-rho)s}
+        sarr = jnp.clip(scores[:, 0], -30.0, 30.0)
+        a = jnp.exp((1.0 - rho) * sarr)
+        b = jnp.exp((2.0 - rho) * sarr)
+        g = -y * a + b
+        h = jnp.maximum(-(1.0 - rho) * y * a + (2.0 - rho) * b, 1e-16)
+        return (g * w)[:, None], (h * w)[:, None]
+
     table = {"binary": binary, "multiclass": multiclass, "regression": l2,
-             "regression_l1": l1, "huber": huber, "quantile": quantile}
+             "regression_l1": l1, "huber": huber, "quantile": quantile,
+             "poisson": poisson, "tweedie": tweedie}
     if obj not in table and obj != "lambdarank":
         raise ValueError(f"unknown objective {obj!r}")
     return table.get(obj)
@@ -224,7 +245,8 @@ def _params_sig(p: "GBDTParams") -> tuple:
     return (p.max_depth, p.max_bin, p.objective, p.num_class, p.boosting_type,
             p.learning_rate, p.lambda_l1, p.lambda_l2, p.min_data_in_leaf,
             p.min_sum_hessian_in_leaf, p.min_gain_to_split, p.max_delta_step,
-            p.sigmoid, p.alpha, p.top_rate, p.other_rate, p.feature_fraction,
+            p.sigmoid, p.alpha, p.tweedie_variance_power,
+            p.top_rate, p.other_rate, p.feature_fraction,
             p.bagging_fraction, p.bagging_freq,
             tuple(p.categorical_features or ()), p.voting_k)
 
@@ -535,7 +557,21 @@ def _metric_l1(y, raw, w=None):
     return float(np.average(np.abs(raw[:, 0] - y), weights=w))
 
 
+def _metric_poisson_nll(y, raw, w=None):
+    mu = np.exp(np.clip(raw[:, 0], -30, 30))
+    return float(np.average(mu - y * np.log(np.maximum(mu, 1e-12)), weights=w))
+
+
+def _metric_tweedie_nll(y, raw, rho, w=None):
+    """Tweedie deviance NLL with log link (raw = log mean), 1 < rho < 2."""
+    s_ = np.clip(raw[:, 0], -30, 30)
+    nll = (-y * np.exp((1.0 - rho) * s_) / (1.0 - rho)
+           + np.exp((2.0 - rho) * s_) / (2.0 - rho))
+    return float(np.average(nll, weights=w))
+
+
 METRICS = {"binary_logloss": (_metric_binary_logloss, False),
+           "poisson_nll": (_metric_poisson_nll, False),
            "auc": (_metric_auc, True),
            "multi_logloss": (_metric_multi_logloss, False),
            "l2": (_metric_l2, False), "mse": (_metric_l2, False),
@@ -546,7 +582,8 @@ METRICS = {"binary_logloss": (_metric_binary_logloss, False),
 def default_metric(objective: str) -> str:
     return {"binary": "binary_logloss", "multiclass": "multi_logloss",
             "regression": "l2", "regression_l1": "l1", "huber": "l2",
-            "quantile": "l2", "lambdarank": "l2"}.get(objective, "l2")
+            "quantile": "l2", "lambdarank": "l2", "poisson": "poisson_nll",
+            "tweedie": "tweedie_nll"}.get(objective, "l2")
 
 
 # ---------------------------------------------------------------------------
@@ -590,6 +627,9 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
             raise ValueError(f"categorical_features indices {bad} out of "
                              f"range [0, {F}) — negative indices are not "
                              f"interpreted pythonically")
+    if p.objective in ("poisson", "tweedie") and (y < 0).any():
+        raise ValueError(f"objective {p.objective!r} requires non-negative "
+                         f"labels (min label {float(y.min())})")
     mapper = BinMapper(p.max_bin,
                        categorical_features=p.categorical_features).fit(X)
     binned_np = mapper.transform(X)
@@ -637,6 +677,8 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
         init_score = math.log(pbar / (1 - pbar)) / p.sigmoid
     elif p.objective in ("regression", "huber"):
         init_score = float(np.average(y, weights=w))
+    elif p.objective in ("poisson", "tweedie"):  # log link: boost from log-mean
+        init_score = float(np.log(max(np.average(y, weights=w), 1e-9)))
     elif p.objective == "regression_l1":
         init_score = float(np.median(y))
 
@@ -669,7 +711,14 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
         init_score = init_booster.init_score
 
     metric_name = p.metric or default_metric(p.objective)
-    metric_fn, larger_better = METRICS.get(metric_name, METRICS[default_metric(p.objective)])
+    if metric_name == "tweedie_nll":  # needs the variance power closure
+        rho_m = p.tweedie_variance_power
+        metric_fn, larger_better = (
+            lambda y_, raw_, w_=None: _metric_tweedie_nll(y_, raw_, rho_m, w_),
+            False)
+    else:
+        metric_fn, larger_better = METRICS.get(
+            metric_name, METRICS[default_metric(p.objective)])
     evals: List[Dict[str, float]] = []
     has_valid = valid is not None
     if has_valid:

@@ -279,18 +279,24 @@ class LightGBMClassificationModel(_LightGBMModelBase, HasProbabilityCol,
 @_shared_params
 class LightGBMRegressor(_LightGBMBase, HasPredictionCol):
     """GBDT regressor (ref ``LightGBMRegressor.scala``); objectives:
-    regression (L2), regression_l1, huber, quantile."""
+    regression (L2), regression_l1, huber, quantile, poisson, tweedie
+    (log-link count/compound-Poisson targets, as native LightGBM)."""
 
-    objective = Param("objective", "regression|regression_l1|huber|quantile",
-                      "string", "regression")
+    objective = Param("objective", "regression|regression_l1|huber|quantile"
+                      "|poisson|tweedie", "string", "regression")
     alpha = Param("alpha", "huber delta / quantile level", "float", 0.9)
+    tweedie_variance_power = Param("tweedie_variance_power",
+                                   "tweedie variance power in (1, 2)",
+                                   "float", 1.5)
 
     def _fit(self, df: DataFrame) -> "LightGBMRegressionModel":
         self._objective = self.get("objective")
         X, y, w, data = self._collect_xyw(df)
         Xt, yt, wt, valid = self._split_valid(X, y, w, data)
         params = self._gbdt_params(1)
-        params = dataclasses.replace(params, alpha=self.get("alpha"))
+        params = dataclasses.replace(
+            params, alpha=self.get("alpha"),
+            tweedie_variance_power=self.get("tweedie_variance_power"))
         ms = self.get("model_string")
         init_booster = GBDTBooster.from_string(ms) if ms else None
         result = gbdt_core.train(Xt, yt, params, sample_weight=wt, valid=valid,

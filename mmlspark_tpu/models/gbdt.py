@@ -181,7 +181,8 @@ class GBDTBooster(Saveable):
 
     def predict(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
         """Transformed scores: prob for binary (n,), softmax (n,K) for
-        multiclass, raw for regression/ranking."""
+        multiclass, exp(raw) for log-link objectives (poisson/tweedie),
+        raw for regression/ranking."""
         raw = self.raw_scores(X, num_iteration)
         if self.objective == "binary":
             return _sigmoid(self.sigmoid * raw[:, 0])
@@ -189,6 +190,8 @@ class GBDTBooster(Saveable):
             z = raw - raw.max(axis=1, keepdims=True)
             e = np.exp(z)
             return e / e.sum(axis=1, keepdims=True)
+        if self.objective in ("poisson", "tweedie"):
+            return np.exp(np.clip(raw[:, 0], -30, 30))
         return raw[:, 0]
 
     def predict_contrib(self, X: np.ndarray, method: str = "tree_shap") -> np.ndarray:

@@ -447,3 +447,56 @@ def test_categorical_negative_codes_raise():
     with _pt.raises(ValueError, match="negative codes"):
         train(X, y, GBDTParams(num_iterations=1, objective="binary",
                                min_data_in_leaf=1, categorical_features=(0,)))
+
+
+def test_poisson_and_tweedie_objectives():
+    """Log-link objectives (native-LightGBM parity: the reference passes
+    objective strings straight through): predictions come back on the MEAN
+    scale and beat the constant-mean baseline on count data."""
+    from mmlspark_tpu.lightgbm import GBDTParams, train
+    rng = np.random.default_rng(0)
+    n = 2000
+    X = rng.normal(size=(n, 6)).astype(np.float32)
+    lam = np.exp(0.8 * X[:, 0] - 0.5 * X[:, 1])
+    y = rng.poisson(lam).astype(np.float32)
+
+    for obj in ("poisson", "tweedie"):
+        res = train(X, y, GBDTParams(num_iterations=40, objective=obj,
+                                     max_depth=4, min_data_in_leaf=10,
+                                     learning_rate=0.1))
+        pred = res.booster.predict(X)
+        assert (pred >= 0).all(), obj  # mean scale, never negative
+        dev = float(np.mean((pred - lam) ** 2))
+        base = float(np.mean((y.mean() - lam) ** 2))
+        assert dev < base * 0.35, (obj, dev, base)
+
+    # estimator surface
+    from mmlspark_tpu.lightgbm import LightGBMRegressor
+    from mmlspark_tpu.core import DataFrame
+    from mmlspark_tpu.core.schema import vector_column
+    df = DataFrame.from_dict({"features": vector_column(list(X)),
+                              "label": y.astype(np.float64)})
+    m = LightGBMRegressor().set_params(objective="poisson", num_iterations=20,
+                                       min_data_in_leaf=10).fit(df)
+    p2 = m.transform(df).collect()["prediction"]
+    assert (np.asarray(p2) >= 0).all()
+
+
+def test_poisson_rejects_negative_labels_and_tweedie_early_stops():
+    from mmlspark_tpu.lightgbm import GBDTParams, train
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(200, 4)).astype(np.float32)
+    import pytest as _pt
+    with _pt.raises(ValueError, match="non-negative"):
+        train(X, rng.normal(size=200).astype(np.float32),
+              GBDTParams(num_iterations=1, objective="poisson"))
+    # tweedie early stopping evaluates on the MEAN scale (tweedie_nll)
+    lam = np.exp(0.6 * X[:, 0])
+    y = rng.poisson(lam).astype(np.float32)
+    res = train(X[:150], y[:150],
+                GBDTParams(num_iterations=60, objective="tweedie", max_depth=3,
+                           min_data_in_leaf=5, early_stopping_round=5),
+                valid=(X[150:], y[150:]))
+    assert res.evals and "tweedie_nll" in res.evals[0]
+    vals = [e["tweedie_nll"] for e in res.evals]
+    assert vals[min(len(vals) - 1, 5)] <= vals[0]  # the metric improves
