@@ -579,6 +579,26 @@ METRICS = {"binary_logloss": (_metric_binary_logloss, False),
            "mae": (_metric_l1, False)}
 
 
+def resolve_metric(metric_name: str, p: "GBDTParams"):
+    """(metric_fn, larger_better) for a requested or default metric name.
+    tweedie_nll is parameterized by the variance power, so it resolves to a
+    closure here instead of living in METRICS; unknown names fall back to
+    the objective's default (and that fallback handles tweedie too)."""
+    def tweedie_closure():
+        rho_m = p.tweedie_variance_power
+        return (lambda y_, raw_, w_=None: _metric_tweedie_nll(y_, raw_, rho_m, w_),
+                False)
+
+    if metric_name == "tweedie_nll":
+        return tweedie_closure()
+    if metric_name in METRICS:
+        return METRICS[metric_name]
+    fallback = default_metric(p.objective)
+    if fallback == "tweedie_nll":
+        return tweedie_closure()
+    return METRICS.get(fallback, METRICS["l2"])
+
+
 def default_metric(objective: str) -> str:
     return {"binary": "binary_logloss", "multiclass": "multi_logloss",
             "regression": "l2", "regression_l1": "l1", "huber": "l2",
@@ -630,6 +650,11 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
     if p.objective in ("poisson", "tweedie") and (y < 0).any():
         raise ValueError(f"objective {p.objective!r} requires non-negative "
                          f"labels (min label {float(y.min())})")
+    if p.objective == "tweedie" and not 1.0 < p.tweedie_variance_power < 2.0:
+        raise ValueError(
+            f"tweedie_variance_power must be in (1, 2), got "
+            f"{p.tweedie_variance_power}; use objective='poisson' for the "
+            f"rho=1 limit")
     mapper = BinMapper(p.max_bin,
                        categorical_features=p.categorical_features).fit(X)
     binned_np = mapper.transform(X)
@@ -711,14 +736,7 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
         init_score = init_booster.init_score
 
     metric_name = p.metric or default_metric(p.objective)
-    if metric_name == "tweedie_nll":  # needs the variance power closure
-        rho_m = p.tweedie_variance_power
-        metric_fn, larger_better = (
-            lambda y_, raw_, w_=None: _metric_tweedie_nll(y_, raw_, rho_m, w_),
-            False)
-    else:
-        metric_fn, larger_better = METRICS.get(
-            metric_name, METRICS[default_metric(p.objective)])
+    metric_fn, larger_better = resolve_metric(metric_name, p)
     evals: List[Dict[str, float]] = []
     has_valid = valid is not None
     if has_valid:

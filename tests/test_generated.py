@@ -26,3 +26,16 @@ def test_generated_stage_tests_execute(tmp_path):
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
     assert " passed" in proc.stdout
+
+
+@pytest.mark.slow
+def test_examples_runner_smoke():
+    """The E2E example runner (nbtest analogue) executes a real example
+    end to end; the full sweep is `python tools/run_examples.py`."""
+    import subprocess
+    proc = subprocess.run(
+        [sys.executable, "tools/run_examples.py", "vw_twitter*"],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, text=True, timeout=700)  # > runner's inner 600
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-1000:]
+    assert "PASS vw_twitter_sentiment.py" in proc.stdout

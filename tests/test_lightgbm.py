@@ -500,3 +500,21 @@ def test_poisson_rejects_negative_labels_and_tweedie_early_stops():
     assert res.evals and "tweedie_nll" in res.evals[0]
     vals = [e["tweedie_nll"] for e in res.evals]
     assert vals[min(len(vals) - 1, 5)] <= vals[0]  # the metric improves
+
+
+def test_tweedie_metric_fallback_and_rho_validation():
+    from mmlspark_tpu.lightgbm import GBDTParams, train
+    from mmlspark_tpu.lightgbm.core import resolve_metric
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(150, 3)).astype(np.float32)
+    y = rng.poisson(1.0, 150).astype(np.float32)
+    # unknown metric name with tweedie falls back instead of KeyError
+    p = GBDTParams(num_iterations=2, objective="tweedie", metric="logloss",
+                   min_data_in_leaf=5)
+    fn, lb = resolve_metric("logloss", p)
+    assert lb is False and np.isfinite(fn(y, np.zeros((150, 1))))
+    train(X[:100], y[:100], p, valid=(X[100:], y[100:]))  # no crash
+    import pytest as _pt
+    with _pt.raises(ValueError, match="tweedie_variance_power"):
+        train(X, y, GBDTParams(num_iterations=1, objective="tweedie",
+                               tweedie_variance_power=1.0))

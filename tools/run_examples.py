@@ -1,0 +1,43 @@
+"""E2E example runner — the notebook-test analogue.
+
+Reference: ``core/src/test/.../nbtest/`` uploads every notebook to a
+Databricks pool and polls them to completion (``DatabricksUtilities.scala:
+26-43``, CI job E2E).  Zero-egress equivalent: run every script in
+``examples/`` as its own process on the CPU mesh and report pass/fail.
+
+    python tools/run_examples.py [pattern]
+"""
+import fnmatch
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(pattern: str = "*.py", timeout_s: float = 600.0) -> int:
+    ex_dir = os.path.join(ROOT, "examples")
+    scripts = sorted(f for f in os.listdir(ex_dir)
+                     if f.endswith(".py") and not f.startswith("_")
+                     and fnmatch.fnmatch(f, pattern))
+    env = dict(os.environ)
+    env["MMLSPARK_TPU_EXAMPLES_CPU"] = "1"
+    failures = []
+    for script in scripts:
+        t0 = time.time()
+        proc = subprocess.run([sys.executable, script], cwd=ex_dir, env=env,
+                              capture_output=True, text=True,
+                              timeout=timeout_s)
+        status = "PASS" if proc.returncode == 0 else "FAIL"
+        print(f"{status} {script} ({time.time() - t0:.0f}s)")
+        if proc.returncode != 0:
+            failures.append(script)
+            print(proc.stdout[-1500:])
+            print(proc.stderr[-1500:])
+    print(f"{len(scripts) - len(failures)}/{len(scripts)} examples passed")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(*sys.argv[1:]))
