@@ -149,9 +149,16 @@ def make_objective(params: GBDTParams) -> Callable:
         h = jnp.maximum(-(1.0 - rho) * y * a + (2.0 - rho) * b, 1e-16)
         return (g * w)[:, None], (h * w)[:, None]
 
+    def gamma(scores, y, w):
+        # gamma nll with log link: grad = 1 - y*e^{-s}, hess = y*e^{-s}
+        e = jnp.exp(-jnp.clip(scores[:, 0], -30.0, 30.0))
+        g = 1.0 - y * e
+        h = jnp.maximum(y * e, 1e-16)
+        return (g * w)[:, None], (h * w)[:, None]
+
     table = {"binary": binary, "multiclass": multiclass, "regression": l2,
              "regression_l1": l1, "huber": huber, "quantile": quantile,
-             "poisson": poisson, "tweedie": tweedie}
+             "poisson": poisson, "tweedie": tweedie, "gamma": gamma}
     if obj not in table and obj != "lambdarank":
         raise ValueError(f"unknown objective {obj!r}")
     return table.get(obj)
@@ -562,6 +569,17 @@ def _metric_poisson_nll(y, raw, w=None):
     return float(np.average(mu - y * np.log(np.maximum(mu, 1e-12)), weights=w))
 
 
+def _metric_gamma_nll(y, raw, w=None):
+    s_ = np.clip(raw[:, 0], -30, 30)
+    return float(np.average(s_ + y * np.exp(-s_), weights=w))
+
+
+def _metric_pinball(y, raw, alpha, w=None):
+    e = y - raw[:, 0]
+    return float(np.average(np.maximum(alpha * e, (alpha - 1.0) * e),
+                            weights=w))
+
+
 def _metric_tweedie_nll(y, raw, rho, w=None):
     """Tweedie deviance NLL with log link (raw = log mean), 1 < rho < 2."""
     s_ = np.clip(raw[:, 0], -30, 30)
@@ -572,6 +590,7 @@ def _metric_tweedie_nll(y, raw, rho, w=None):
 
 METRICS = {"binary_logloss": (_metric_binary_logloss, False),
            "poisson_nll": (_metric_poisson_nll, False),
+           "gamma_nll": (_metric_gamma_nll, False),
            "auc": (_metric_auc, True),
            "multi_logloss": (_metric_multi_logloss, False),
            "l2": (_metric_l2, False), "mse": (_metric_l2, False),
@@ -584,26 +603,35 @@ def resolve_metric(metric_name: str, p: "GBDTParams"):
     tweedie_nll is parameterized by the variance power, so it resolves to a
     closure here instead of living in METRICS; unknown names fall back to
     the objective's default (and that fallback handles tweedie too)."""
-    def tweedie_closure():
-        rho_m = p.tweedie_variance_power
-        return (lambda y_, raw_, w_=None: _metric_tweedie_nll(y_, raw_, rho_m, w_),
-                False)
+    def closures(name):
+        if name == "tweedie_nll":
+            rho_m = p.tweedie_variance_power
+            return (lambda y_, raw_, w_=None:
+                    _metric_tweedie_nll(y_, raw_, rho_m, w_), False)
+        if name == "pinball":
+            a_m = p.alpha
+            return (lambda y_, raw_, w_=None:
+                    _metric_pinball(y_, raw_, a_m, w_), False)
+        return None
 
-    if metric_name == "tweedie_nll":
-        return tweedie_closure()
+    got = closures(metric_name)
+    if got is not None:
+        return got
     if metric_name in METRICS:
         return METRICS[metric_name]
     fallback = default_metric(p.objective)
-    if fallback == "tweedie_nll":
-        return tweedie_closure()
+    got = closures(fallback)
+    if got is not None:
+        return got
     return METRICS.get(fallback, METRICS["l2"])
 
 
 def default_metric(objective: str) -> str:
     return {"binary": "binary_logloss", "multiclass": "multi_logloss",
             "regression": "l2", "regression_l1": "l1", "huber": "l2",
-            "quantile": "l2", "lambdarank": "l2", "poisson": "poisson_nll",
-            "tweedie": "tweedie_nll"}.get(objective, "l2")
+            "quantile": "pinball", "lambdarank": "l2",
+            "poisson": "poisson_nll", "tweedie": "tweedie_nll",
+            "gamma": "gamma_nll"}.get(objective, "l2")
 
 
 # ---------------------------------------------------------------------------
@@ -649,6 +677,9 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
                              f"interpreted pythonically")
     if p.objective in ("poisson", "tweedie") and (y < 0).any():
         raise ValueError(f"objective {p.objective!r} requires non-negative "
+                         f"labels (min label {float(y.min())})")
+    if p.objective == "gamma" and (y <= 0).any():
+        raise ValueError("objective 'gamma' requires strictly positive "
                          f"labels (min label {float(y.min())})")
     if p.objective == "tweedie" and not 1.0 < p.tweedie_variance_power < 2.0:
         raise ValueError(
@@ -702,7 +733,7 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
         init_score = math.log(pbar / (1 - pbar)) / p.sigmoid
     elif p.objective in ("regression", "huber"):
         init_score = float(np.average(y, weights=w))
-    elif p.objective in ("poisson", "tweedie"):  # log link: boost from log-mean
+    elif p.objective in ("poisson", "tweedie", "gamma"):  # log link
         init_score = float(np.log(max(np.average(y, weights=w), 1e-9)))
     elif p.objective == "regression_l1":
         init_score = float(np.median(y))

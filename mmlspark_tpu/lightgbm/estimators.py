@@ -283,7 +283,7 @@ class LightGBMRegressor(_LightGBMBase, HasPredictionCol):
     (log-link count/compound-Poisson targets, as native LightGBM)."""
 
     objective = Param("objective", "regression|regression_l1|huber|quantile"
-                      "|poisson|tweedie", "string", "regression")
+                      "|poisson|tweedie|gamma", "string", "regression")
     alpha = Param("alpha", "huber delta / quantile level", "float", 0.9)
     tweedie_variance_power = Param("tweedie_variance_power",
                                    "tweedie variance power in (1, 2)",

@@ -190,7 +190,7 @@ class GBDTBooster(Saveable):
             z = raw - raw.max(axis=1, keepdims=True)
             e = np.exp(z)
             return e / e.sum(axis=1, keepdims=True)
-        if self.objective in ("poisson", "tweedie"):
+        if self.objective in ("poisson", "tweedie", "gamma"):
             return np.exp(np.clip(raw[:, 0], -30, 30))
         return raw[:, 0]
 

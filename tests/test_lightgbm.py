@@ -518,3 +518,34 @@ def test_tweedie_metric_fallback_and_rho_validation():
     with _pt.raises(ValueError, match="tweedie_variance_power"):
         train(X, y, GBDTParams(num_iterations=1, objective="tweedie",
                                tweedie_variance_power=1.0))
+
+
+def test_gamma_objective_and_pinball_metric():
+    from mmlspark_tpu.lightgbm import GBDTParams, train
+    rng = np.random.default_rng(3)
+    n = 1500
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    mu = np.exp(0.6 * X[:, 0])
+    y = rng.gamma(shape=2.0, scale=mu / 2.0, size=n).astype(np.float32) + 1e-3
+    res = train(X, y, GBDTParams(num_iterations=40, objective="gamma",
+                                 max_depth=4, min_data_in_leaf=10))
+    pred = res.booster.predict(X)
+    assert (pred > 0).all()
+    assert float(np.mean((pred - mu) ** 2)) < float(np.mean((y.mean() - mu) ** 2)) * 0.4
+    import pytest as _pt
+    with _pt.raises(ValueError, match="strictly positive"):
+        train(X, np.zeros(n, np.float32),
+              GBDTParams(num_iterations=1, objective="gamma"))
+
+    # quantile objective now early-stops on its own pinball loss
+    yq = (2 * X[:, 0] + rng.normal(scale=0.5, size=n)).astype(np.float32)
+    res_q = train(X[:1200], yq[:1200],
+                  GBDTParams(num_iterations=30, objective="quantile",
+                             alpha=0.9, max_depth=3, min_data_in_leaf=10),
+                  valid=(X[1200:], yq[1200:]))
+    assert res_q.evals and "pinball" in res_q.evals[0]
+    # alpha=0.9 predictions skew toward the upper conditional percentile
+    # (well above the ~0.5 coverage a median/L2 fit would give; exact 0.9
+    # needs more iterations than this smoke budget)
+    frac_below = float((yq <= res_q.booster.predict(X)).mean())
+    assert 0.7 < frac_below <= 1.0, frac_below

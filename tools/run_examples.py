@@ -17,24 +17,34 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def main(pattern: str = "*.py", timeout_s: float = 600.0) -> int:
+    timeout_s = float(timeout_s)  # CLI args arrive as strings
     ex_dir = os.path.join(ROOT, "examples")
     scripts = sorted(f for f in os.listdir(ex_dir)
                      if f.endswith(".py") and not f.startswith("_")
                      and fnmatch.fnmatch(f, pattern))
+    if not scripts:
+        print(f"no examples match {pattern!r}")
+        return 1
     env = dict(os.environ)
     env["MMLSPARK_TPU_EXAMPLES_CPU"] = "1"
     failures = []
     for script in scripts:
         t0 = time.time()
-        proc = subprocess.run([sys.executable, script], cwd=ex_dir, env=env,
-                              capture_output=True, text=True,
-                              timeout=timeout_s)
-        status = "PASS" if proc.returncode == 0 else "FAIL"
+        try:
+            proc = subprocess.run([sys.executable, script], cwd=ex_dir,
+                                  env=env, capture_output=True, text=True,
+                                  timeout=timeout_s)
+            rc, out, err = proc.returncode, proc.stdout, proc.stderr
+        except subprocess.TimeoutExpired as e:  # one hang must not end the sweep
+            rc = -1
+            out = (e.stdout or b"").decode("utf-8", "replace")                 if isinstance(e.stdout, bytes) else (e.stdout or "")
+            err = f"timed out after {timeout_s:.0f}s"
+        status = "PASS" if rc == 0 else "FAIL"
         print(f"{status} {script} ({time.time() - t0:.0f}s)")
-        if proc.returncode != 0:
+        if rc != 0:
             failures.append(script)
-            print(proc.stdout[-1500:])
-            print(proc.stderr[-1500:])
+            print(out[-1500:])
+            print(err[-1500:])
     print(f"{len(scripts) - len(failures)}/{len(scripts)} examples passed")
     return 1 if failures else 0
 
