@@ -32,7 +32,7 @@ import shutil
 
 from ..utils import pickling as pickle
 import numpy as np
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 from .params import Params, ServiceValue
 
@@ -208,11 +208,15 @@ def save_dataframe(df, path: str) -> None:
         json.dump(manifest, f)
 
 
-def load_dataframe(path: str, safe: bool = False):
+def load_dataframe(path: str, safe: Optional[bool] = None):
     """``safe=True`` loads arrays with ``allow_pickle=False`` — object-dtype
-    columns (sparse dicts, nested arrays) then raise instead of unpickling."""
+    columns (sparse dicts, nested arrays) then raise instead of unpickling.
+    Default resolves MMLSPARK_TPU_SAFE_LOAD like ``load_stage``/``load`` do,
+    so the documented env opt-in covers direct calls too."""
     from .dataframe import DataFrame
     from .schema import Schema
+    if safe is None:
+        safe = _default_safe()
     with open(os.path.join(path, "manifest.json")) as f:
         manifest = json.load(f)
     parts = []

@@ -248,6 +248,10 @@ def _lstm(node: Node, ins, jnp, jax):
         raise NotImplementedError(
             "LSTM sequence_lens: variable-length batches are not supported; "
             "pad to equal length and drop the sequence_lens input")
+    if len(ins) > 7 and ins[7] is not None:
+        raise NotImplementedError(
+            "LSTM peephole weights (input P) are not supported; importing "
+            "would silently drop them and produce wrong outputs")
     H = node.attr_i("hidden_size", R.shape[-1])
     direction = node.attr_s("direction", "forward")
     dirs = 2 if direction == "bidirectional" else 1

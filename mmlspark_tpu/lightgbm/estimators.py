@@ -30,8 +30,11 @@ def _shared_params(cls):
     specs = [
         ("num_iterations", "number of boosting iterations", "int", 100),
         ("learning_rate", "shrinkage rate", "float", 0.1),
-        ("num_leaves", "max leaves per tree (sets depth=ceil(log2))", "int", 31),
-        ("max_depth", "max tree depth (overrides num_leaves if set)", "int", None),
+        ("num_leaves", "max leaves per tree (leaf-wise best-first growth, "
+                       "LightGBM numLeaves semantics)", "int", 31),
+        ("max_depth", "max tree depth; set alone it selects level-wise "
+                      "depth growth, with num_leaves it caps leaf-wise depth",
+         "int", None),
         ("max_bin", "max histogram bins per feature", "int", 255),
         ("boosting_type", "gbdt|rf|dart|goss", "string", "gbdt"),
         ("lambda_l1", "L1 regularization", "float", 0.0),
@@ -54,6 +57,9 @@ def _shared_params(cls):
         ("model_string", "warm-start model string", "string", None),
         ("num_batches", "split training into sequential batches "
                         "(LightGBMBase.scala:46-61)", "int", 0),
+        ("growth", "tree growth strategy: leaf (LightGBM best-first) | "
+                   "level (depth-wise) | auto (leaf unless only max_depth "
+                   "is set)", "string", "auto"),
         ("seed", "random seed", "int", 0),
         ("parallelism", "data_parallel (full histogram psum) | "
                         "voting_parallel (top-k feature voting, O(k*B) comm) "
@@ -80,11 +86,19 @@ class _LightGBMBase(Estimator, HasFeaturesCol, HasLabelCol, HasWeightCol):
 
     def _gbdt_params(self, num_class: int = 1) -> GBDTParams:
         max_depth = self.get("max_depth")
+        growth = self.get("growth")
+        if growth == "auto" and max_depth and not self.is_set("num_leaves"):
+            # max_depth ALONE selects level-wise growth (the fast bench
+            # mode); an explicitly set num_leaves keeps LightGBM leaf-wise
+            # growth with max_depth as the depth cap, and the default
+            # num_leaves=31 without a depth is leaf-wise too
+            growth = "level"
         p = GBDTParams(
             num_iterations=self.get("num_iterations"),
             learning_rate=self.get("learning_rate"),
-            num_leaves=None if max_depth else self.get("num_leaves"),
-            max_depth=max_depth or 5,
+            num_leaves=self.get("num_leaves"),
+            max_depth=max_depth or 0,
+            growth=growth,
             max_bin=self.get("max_bin"),
             objective=self._objective,
             num_class=num_class,

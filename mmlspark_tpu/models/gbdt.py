@@ -5,15 +5,20 @@ native model: ``score:390``, ``predictLeaf:403``, ``featuresShap:414``,
 ``saveNativeModel:454``, ``getFeatureImportances:491``, ``mergeBooster:252``).
 
 TPU-native redesign: a booster is a *pytree of fixed-shape arrays* — every
-tree is a perfect binary tree of depth D (level-wise growth, XLA-static
-shapes; nodes that stop splitting early carry ``split_feature = -1`` and
-route rows left).  Prediction is a vectorised gather-walk: ``vmap`` over
-trees, ``lax.fori_loop`` over depth — no recursion, no dynamic shapes, so
-XLA tiles it onto the VPU and fuses the final reduction.
+tree is an array-of-nodes with explicit child pointers, sized for
+``num_leaves`` leaves and ``num_leaves - 1`` internal nodes.  This holds
+LightGBM's leaf-wise (best-first) trees exactly (non-perfect shapes, nodes
+in creation order) and level-wise perfect trees as the special case where
+children follow BFS order.  Prediction is a vectorised pointer-chase:
+``vmap`` over trees, ``lax.fori_loop`` over a static depth bound — leaves
+self-loop, so no recursion or dynamic shapes, and XLA tiles the gathers
+onto the VPU and fuses the final reduction.
 
-Indexing: internal nodes in BFS order 0..2^D-2 (children of i at 2i+1, 2i+2);
-leaves 0..2^D-1 (leaf id = final node - (2^D - 1)).  Multiclass stores trees
-round-robin: tree t scores class t % num_class (LightGBM convention).
+Indexing: ``left_child[i] >= 0`` is an internal-node index; negative values
+encode leaves as ``~leaf_id`` (LightGBM's own convention).  ``max_depth`` is
+the walk bound: the deepest internal-node chain over all trees.  Multiclass
+stores trees round-robin: tree t scores class t % num_class (LightGBM
+convention).
 """
 from __future__ import annotations
 
@@ -34,15 +39,52 @@ def _sigmoid(z):
     return 1.0 / (1.0 + np.exp(-z))
 
 
-class GBDTBooster(Saveable):
-    """Immutable fitted booster.  Arrays:
+def perfect_tree_children(max_depth: int) -> tuple:
+    """(left, right) child arrays of a perfect depth-D tree in BFS order:
+    children of internal node i at 2i+1 / 2i+2; positions >= 2^D - 1 are
+    leaves encoded ``~leaf_id``.  Level-wise trees and pre-round-3 saved
+    artifacts (which had no child arrays) use exactly this layout."""
+    I = 2 ** max_depth - 1
+    lc = np.empty(I, np.int32)
+    rc = np.empty(I, np.int32)
+    for i in range(I):
+        l, r = 2 * i + 1, 2 * i + 2
+        lc[i] = l if l < I else ~(l - I)
+        rc[i] = r if r < I else ~(r - I)
+    return lc, rc
 
-    - split_feature: (T, I) int32, -1 where the node doesn't split
-    - threshold:     (T, I) float32 raw-value threshold (x <= thr goes left)
-    - threshold_bin: (T, I) int32 binned threshold (bin <= t goes left)
-    - split_gain:    (T, I) float32
-    - internal_value:(T, I) float32 (-G/(H+l2) at the node; Saabas contribs)
-    - internal_count:(T, I) float32 row counts
+
+def children_depth_bound(left_child: np.ndarray, right_child: np.ndarray) -> int:
+    """Longest internal-node chain over (T, M) child arrays — the static
+    iteration count prediction walks need.  Child internal indices always
+    exceed the parent's (creation order), so one forward pass suffices."""
+    lc = np.asarray(left_child)
+    rc = np.asarray(right_child)
+    if lc.ndim == 1:
+        lc, rc = lc[None], rc[None]
+    T, M = lc.shape
+    d = np.ones((T, M), np.int32)
+    for i in range(M):
+        for child in (lc[:, i], rc[:, i]):
+            internal = child >= 0
+            rows = np.nonzero(internal)[0]
+            d[rows, child[rows]] = np.maximum(d[rows, child[rows]],
+                                              d[rows, i] + 1)
+    return int(d.max()) if M else 1
+
+
+class GBDTBooster(Saveable):
+    """Immutable fitted booster.  T trees, M = num_leaves - 1 internal node
+    slots, L = num_leaves leaf slots.  Arrays:
+
+    - left_child:    (T, M) int32 child pointer (>=0 internal, <0 = ~leaf_id)
+    - right_child:   (T, M) int32
+    - split_feature: (T, M) int32, -1 where the node doesn't split
+    - threshold:     (T, M) float32 raw-value threshold (x <= thr goes left)
+    - threshold_bin: (T, M) int32 binned threshold (bin <= t goes left)
+    - split_gain:    (T, M) float32
+    - internal_value:(T, M) float32 (-G/(H+l2) at the node; Saabas contribs)
+    - internal_count:(T, M) float32 row counts
     - leaf_value:    (T, L) float32
     - leaf_count:    (T, L) float32
     - tree_weight:   (T,)   float32 (DART/RF weights; 1.0 for gbdt/goss)
@@ -55,8 +97,16 @@ class GBDTBooster(Saveable):
                  init_score: float = 0.0, average_output: bool = False,
                  feature_names: Optional[List[str]] = None,
                  best_iteration: int = -1, sigmoid: float = 1.0,
-                 categorical_features: Optional[List[int]] = None):
+                 categorical_features: Optional[List[int]] = None,
+                 left_child=None, right_child=None):
         self.split_feature = np.asarray(split_feature, np.int32)
+        if left_child is None:  # pre-round-3 artifact: perfect depth-D tree
+            lc1, rc1 = perfect_tree_children(int(max_depth))
+            T = self.split_feature.shape[0]
+            left_child = np.tile(lc1, (T, 1))
+            right_child = np.tile(rc1, (T, 1))
+        self.left_child = np.asarray(left_child, np.int32)
+        self.right_child = np.asarray(right_child, np.int32)
         self.threshold = np.asarray(threshold, np.float32)
         self.threshold_bin = np.asarray(threshold_bin, np.int32)
         self.split_gain = np.asarray(split_gain, np.float32)
@@ -102,14 +152,21 @@ class GBDTBooster(Saveable):
         """(n, T') leaf index per tree.  Device gather-walk for batch scoring;
         pure-numpy walk for small batches (the serving regime: avoids the
         per-call device transfer + dispatch, keeping request latency in the
-        low milliseconds as the reference's continuous serving does)."""
+        low milliseconds as the reference's continuous serving does).
+
+        Node ids start at 0 (the root) and chase ``left/right_child``
+        pointers; negative ids are leaves (``~leaf_id``) and self-loop, so a
+        fixed ``max_depth``-iteration walk resolves every (possibly
+        non-perfect, leaf-wise) tree."""
         import jax
         import jax.numpy as jnp
         sf = self.split_feature
         th = self.threshold
+        lca, rca = self.left_child, self.right_child
         if use_trees is not None:
             sf, th = sf[use_trees], th[use_trees]
-        D = self.max_depth
+            lca, rca = lca[use_trees], rca[use_trees]
+        D = max(1, self.max_depth)
         n_rows = X.shape[0]
         T = sf.shape[0]
         if n_rows * T <= 1 << 17:  # small: numpy vectorized walk
@@ -119,40 +176,46 @@ class GBDTBooster(Saveable):
             r_idx = np.arange(n_rows)[:, None]
             isc_all = self._is_cat
             for _ in range(D):
-                f = sf[t_idx, node]
-                thr = th[t_idx, node]
+                j = np.maximum(node, 0)
+                f = sf[t_idx, j]
+                thr = th[t_idx, j]
                 xv = Xn[r_idx, np.maximum(f, 0)]
                 isc = isc_all[np.maximum(f, 0)]
                 # categorical codes compare after rounding, matching the
                 # round() used at binning time (2.9999 trains as code 3)
-                go_right = np.where(isc, np.round(xv) != thr, xv > thr)
-                node = 2 * node + 1 + ((f >= 0) & go_right)
-            return (node - (2 ** D - 1)).astype(np.int64)
+                go_right = (f >= 0) & np.where(isc, np.round(xv) != thr,
+                                               xv > thr)
+                child = np.where(go_right, rca[t_idx, j], lca[t_idx, j])
+                node = np.where(node >= 0, child, node)
+            return (~node).astype(np.int64)
 
         @partial(jax.jit, static_argnames=())
-        def walk(X, sf, th, cat):
+        def walk(X, sf, th, lca, rca, cat):
             n = X.shape[0]
             Xn = jnp.nan_to_num(X, nan=-jnp.inf)  # missing routes left
 
-            def one_tree(sf_t, th_t):
+            def one_tree(sf_t, th_t, lc_t, rc_t):
                 node = jnp.zeros((n,), jnp.int32)
 
                 def body(d, node):
-                    f = sf_t[node]
-                    thr = th_t[node]
+                    j = jnp.maximum(node, 0)
+                    f = sf_t[j]
+                    thr = th_t[j]
                     x = Xn[jnp.arange(n), jnp.maximum(f, 0)]
                     go_right = (f >= 0) & jnp.where(cat[jnp.maximum(f, 0)],
                                                     jnp.round(x) != thr,
                                                     x > thr)
-                    return 2 * node + 1 + go_right.astype(jnp.int32)
+                    child = jnp.where(go_right, rc_t[j], lc_t[j])
+                    return jnp.where(node >= 0, child, node)
 
                 node = jax.lax.fori_loop(0, D, body, node)
-                return node - (2 ** D - 1)
+                return ~node
 
-            return jax.vmap(one_tree)(sf, th).T  # (n, T)
+            return jax.vmap(one_tree)(sf, th, lca, rca).T  # (n, T)
 
         return np.asarray(walk(jnp.asarray(X, jnp.float32), jnp.asarray(sf),
-                               jnp.asarray(th), jnp.asarray(self._is_cat)))
+                               jnp.asarray(th), jnp.asarray(lca),
+                               jnp.asarray(rca), jnp.asarray(self._is_cat)))
 
     def predict_leaf(self, X: np.ndarray) -> np.ndarray:
         """Reference ``predictLeaf`` (LightGBMBooster.scala:403)."""
@@ -205,7 +268,8 @@ class GBDTBooster(Saveable):
             return tree_shap(self, X)
         X = np.asarray(X, np.float32)
         n, F = X.shape
-        D, I = self.max_depth, self.split_feature.shape[1]
+        D = max(1, self.max_depth)
+        M = self.split_feature.shape[1]
         out = np.zeros((n, F + 1), np.float64)
         Xn = np.nan_to_num(X, nan=-np.inf)
         k = self.num_class if self.objective == "multiclass" else 1
@@ -213,28 +277,32 @@ class GBDTBooster(Saveable):
             raise ValueError("predict_contrib supports single-score models; "
                              "slice trees per class for multiclass")
         out[:, F] = self.init_score
+        rows = np.arange(n)
         for t in range(self.num_trees):
             w = self.tree_weight[t]
+            lca, rca = self.left_child[t], self.right_child[t]
             node = np.zeros(n, np.int64)
             cur_val = np.full(n, self.internal_value[t, 0], np.float64)
             out[:, F] += w * self.internal_value[t, 0]
-            for d in range(D):
-                f = self.split_feature[t, node]
-                thr = self.threshold[t, node]
-                xv = Xn[np.arange(n), np.maximum(f, 0)]
+            for _ in range(D):
+                active = node >= 0
+                j = np.maximum(node, 0)
+                f = self.split_feature[t, j]
+                thr = self.threshold[t, j]
+                xv = Xn[rows, np.maximum(f, 0)]
                 isc = self._is_cat[np.maximum(f, 0)]
                 go_right = (f >= 0) & np.where(isc, np.round(xv) != thr,
                                                xv > thr)
-                nxt = 2 * node + 1 + go_right
-                is_leaf_level = d == D - 1
-                if is_leaf_level:
-                    nxt_val = self.leaf_value[t, nxt - (2 ** D - 1)]
-                else:
-                    nxt_val = self.internal_value[t, nxt]
-                delta = w * (nxt_val - cur_val)
-                np.add.at(out, (np.arange(n), np.where(f >= 0, f, F)), np.where(f >= 0, delta, 0.0))
-                cur_val = np.where(f >= 0, nxt_val, cur_val)
-                node = nxt
+                nxt = np.where(go_right, rca[j], lca[j])
+                nxt_val = np.where(
+                    nxt >= 0,
+                    self.internal_value[t, np.clip(nxt, 0, M - 1)],
+                    self.leaf_value[t, np.clip(~nxt, 0, self.num_leaves - 1)])
+                attributed = active & (f >= 0)
+                delta = np.where(attributed, w * (nxt_val - cur_val), 0.0)
+                np.add.at(out, (rows, np.where(attributed, f, F)), delta)
+                cur_val = np.where(attributed, nxt_val, cur_val)
+                node = np.where(active, nxt, node)
         return out
 
     # ------------------------------------------------------------------ utils
@@ -254,7 +322,7 @@ class GBDTBooster(Saveable):
 
     def merge(self, other: "GBDTBooster") -> "GBDTBooster":
         """Concatenate trees (reference ``mergeBooster:252`` batch training)."""
-        assert self.max_depth == other.max_depth and self.num_class == other.num_class
+        assert self.num_leaves == other.num_leaves and self.num_class == other.num_class
         assert self.categorical_features == other.categorical_features
         cat = lambda a, b: np.concatenate([a, b], axis=0)
         return GBDTBooster(
@@ -267,7 +335,10 @@ class GBDTBooster(Saveable):
             cat(self.leaf_value, other.leaf_value),
             cat(self.leaf_count, other.leaf_count),
             cat(self.tree_weight, other.tree_weight),
-            max_depth=self.max_depth, num_features=self.num_features,
+            left_child=cat(self.left_child, other.left_child),
+            right_child=cat(self.right_child, other.right_child),
+            max_depth=max(self.max_depth, other.max_depth),
+            num_features=self.num_features,
             objective=self.objective, num_class=self.num_class,
             init_score=self.init_score, average_output=self.average_output,
             feature_names=self.feature_names, sigmoid=self.sigmoid,
@@ -279,7 +350,7 @@ class GBDTBooster(Saveable):
              "categorical_features")
     _ARRAYS = ("split_feature", "threshold", "threshold_bin", "split_gain",
                "internal_value", "internal_count", "leaf_value", "leaf_count",
-               "tree_weight")
+               "tree_weight", "left_child", "right_child")
 
     def to_string(self) -> str:
         """Model as a JSON string (reference native model string serde,
@@ -306,12 +377,13 @@ class GBDTBooster(Saveable):
         with open(os.path.join(path, "meta.json")) as f:
             meta = json.load(f)
         with np.load(os.path.join(path, "trees.npz")) as z:
-            arrays = {k: z[k] for k in cls._ARRAYS}
+            # pre-round-3 artifacts lack child arrays (perfect trees only)
+            arrays = {k: z[k] for k in cls._ARRAYS if k in z.files}
         return cls(**arrays, **meta)
 
 
 # ---------------------------------------------------------------------------
-# Path-dependent TreeSHAP (Lundberg Algorithm 2) over perfect-depth trees
+# Path-dependent TreeSHAP (Lundberg Algorithm 2) over array-of-nodes trees
 # ---------------------------------------------------------------------------
 
 class _ShapPath:
@@ -367,35 +439,35 @@ def _unwound_sum(path, i):
 
 
 def _tree_shap_one(x, phi, t, booster: "GBDTBooster"):
-    """Accumulate SHAP values of tree t for instance x into phi (F+1,)."""
-    D = booster.max_depth
-    I = 2 ** D - 1
+    """Accumulate SHAP values of tree t for instance x into phi (F+1,).
+    Nodes: j >= 0 internal (children via left/right_child), j < 0 leaf ~j."""
     sf = booster.split_feature[t]
     th = booster.threshold[t]
-    iv = booster.internal_value[t]
+    lca = booster.left_child[t]
+    rca = booster.right_child[t]
     ic = booster.internal_count[t]
     lv = booster.leaf_value[t]
     lc = booster.leaf_count[t]
     w = float(booster.tree_weight[t])
 
     def cover(j):
-        return float(ic[j]) if j < I else float(lc[j - I])
+        return float(ic[j]) if j >= 0 else float(lc[~j])
 
     def value(j):
-        return float(lv[j - I])  # only leaves are valued in the recursion
+        return float(lv[~j])  # only leaves are valued in the recursion
 
     total_cover = max(float(lc.sum()), 1e-12)
     phi[-1] += w * float((lv * lc).sum()) / total_cover  # E[f] under covers
 
     def recurse(j, path, pz, po, pi):
         path = _extend(path, pz, po, pi)
-        if j >= I:  # leaf
+        if j < 0:  # leaf
             for i in range(1, len(path)):
                 phi[path[i].d] += w * _unwound_sum(path, i) * \
                     (path[i].o - path[i].z) * value(j)
             return
         f = int(sf[j])
-        left, right = 2 * j + 1, 2 * j + 2
+        left, right = int(lca[j]), int(rca[j])
         if f < 0:
             # pass-through node: everything goes left
             recurse(left, path, 1.0, 1.0, -2)

@@ -248,7 +248,9 @@ def build(binned, grad, hess, node_ids, num_nodes, num_bins,
     VMEM kernel (``pallas_histogram.py``; interpret-mode on CPU); override
     via MMLSPARK_TPU_HIST_BACKEND."""
     import os
-    backend = os.environ.get("MMLSPARK_TPU_HIST_BACKEND", backend)
+    if backend == "auto":  # env override only applies when the caller did
+        backend = os.environ.get("MMLSPARK_TPU_HIST_BACKEND", backend)
+        # not request a specific backend (ADVICE r2)
     if backend == "auto":
         backend = "scatter" if jax.default_backend() == "cpu" else "matmul"
     if backend == "pallas":

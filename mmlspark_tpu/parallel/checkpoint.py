@@ -40,6 +40,7 @@ def save_train_state(state: TrainState, path: str,
         target = os.path.join(os.path.abspath(path), "orbax")
         with ocp.StandardCheckpointer() as ckptr:
             ckptr.save(target, _state_tree(state), force=True)
+        _write_marker(path, "orbax")
         return
     # NPZ arrays + pickled optimizer state: exact pytree fidelity
     from flax import traverse_util
@@ -53,20 +54,41 @@ def save_train_state(state: TrainState, path: str,
     from ..utils import pickling
     with open(os.path.join(path, "opt_state.pkl"), "wb") as f:
         pickling.dump(jax.device_get(state.opt_state), f)
+    _write_marker(path, "npz")
+
+
+def _write_marker(path: str, backend: str) -> None:
+    """Record which backend wrote last: mtimes survive neither cp nor rsync
+    reliably, so backend selection on load must not depend on them."""
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "LATEST_BACKEND"), "w") as f:
+        f.write(backend)
 
 
 def load_train_state(path: str, trainer=None,
-                     template: Optional[TrainState] = None) -> TrainState:
+                     template: Optional[TrainState] = None,
+                     backend: Optional[str] = None) -> TrainState:
     """Load a checkpoint; with ``trainer`` given, re-shard onto its mesh.
     Orbax checkpoints additionally need ``template`` (structure + shardings
-    to restore into)."""
+    to restore into).  ``backend`` forces a backend; otherwise the
+    LATEST_BACKEND marker decides, with mtime comparison as a last resort
+    for pre-marker checkpoints."""
     import jax
     orbax_dir = os.path.join(os.path.abspath(path), "orbax")
     npz_path = os.path.join(path, "state.npz")
-    if os.path.exists(orbax_dir) and os.path.exists(npz_path):
-        # both backends wrote here: take the newer artifact, never silently
-        # shadow a fresher save with a stale one
-        use_orbax = os.path.getmtime(orbax_dir) >= os.path.getmtime(npz_path)
+    marker = os.path.join(path, "LATEST_BACKEND")
+    if backend is not None:
+        if backend not in ("npz", "orbax"):
+            raise ValueError(f"backend must be 'npz' or 'orbax', got {backend!r}")
+        use_orbax = backend == "orbax"
+    elif os.path.exists(orbax_dir) and os.path.exists(npz_path):
+        if os.path.exists(marker):
+            with open(marker) as f:
+                use_orbax = f.read().strip() == "orbax"
+        else:
+            # both backends wrote here pre-marker: take the newer artifact,
+            # never silently shadow a fresher save with a stale one
+            use_orbax = os.path.getmtime(orbax_dir) >= os.path.getmtime(npz_path)
     else:
         use_orbax = os.path.exists(orbax_dir)
     if use_orbax:

@@ -91,37 +91,46 @@ class PipelineServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
+        # continuous-mode fast path: an idle handler thread scores its own
+        # request inline instead of paying two thread hand-offs through the
+        # queue (reference continuous mode reaches ~1 ms,
+        # docs/mmlspark-serving.md:10-11; the hand-off alone costs ~0.5 ms)
+        self._inline_lock = threading.Lock()
 
     # ------------------------------------------------------------------ http
     def _make_handler(self):
         server = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1: persistent connections.  Every reply carries an
+            # explicit Content-Length, so keep-alive is safe and a client
+            # scoring a stream of rows pays TCP/handshake setup once, not
+            # per request (the reference's continuous-mode latency claim
+            # assumes exactly this client pattern).
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, *a):  # quiet
                 pass
 
             def do_GET(self):
                 if self.path == "/health":
-                    body = b"ok"
+                    self._write_raw(200, b"ok", b"text/plain")
                 elif self.path == "/stats":
-                    body = json.dumps(server.stats.as_dict()).encode()
+                    self._write_raw(200,
+                                    json.dumps(server.stats.as_dict()).encode())
                 else:
-                    self.send_response(404)
-                    self.end_headers()
-                    return
-                self.send_response(200)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                    self._respond(404, {"error": "not found"})
 
             def do_POST(self):
-                if self.path != server.api_path:
-                    self.send_response(404)
-                    self.end_headers()
-                    return
+                # ALWAYS drain the body first: on keep-alive connections an
+                # unread body would be parsed as the next request line,
+                # desynchronizing the stream after any error reply
                 t0 = time.perf_counter()
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
+                if self.path != server.api_path:
+                    self._respond(404, {"error": "not found"})
+                    return
                 try:
                     payload = server.input_parser(body)
                 except Exception as e:  # noqa: BLE001
@@ -131,7 +140,14 @@ class PipelineServer:
                                headers=dict(self.headers))
                 with server.stats.lock:
                     server.stats.received += 1
-                server._q.put(entry)
+                if server.mode == "continuous" and \
+                        server._inline_lock.acquire(blocking=False):
+                    try:  # idle scorer: skip the queue hand-off entirely
+                        server._score_batch([entry])
+                    finally:
+                        server._inline_lock.release()
+                else:
+                    server._q.put(entry)
                 if not entry.done.wait(server.request_timeout_s):
                     self._respond(504, {"error": "timeout"})
                     with server.stats.lock:
@@ -142,13 +158,23 @@ class PipelineServer:
                     server.stats.replied += 1
                     server.stats.latency_sum += time.perf_counter() - t0
 
+            _STATUS = {200: b"200 OK", 400: b"400 Bad Request",
+                       404: b"404 Not Found", 500: b"500 Internal Server Error",
+                       504: b"504 Gateway Timeout"}
+
+            def _write_raw(self, status, body, ctype=b"application/json"):
+                # one buffered write per reply: status line + headers + body
+                # in a single syscall/TCP segment (the default handler path
+                # issues one write per header, which interacts badly with
+                # delayed ACKs on loopback)
+                self.wfile.write(
+                    b"HTTP/1.1 " + self._STATUS.get(status, b"500 ISE")
+                    + b"\r\nContent-Type: " + ctype
+                    + b"\r\nContent-Length: " + str(len(body)).encode()
+                    + b"\r\n\r\n" + body)
+
             def _respond(self, status, obj):
-                body = json.dumps(obj, default=str).encode()
-                self.send_response(status)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self._write_raw(status, json.dumps(obj, default=str).encode())
 
         return Handler
 
@@ -174,28 +200,37 @@ class PipelineServer:
                     break
         return batch
 
+    def _score_batch(self, batch: List[_Entry]) -> None:
+        """Run the pipeline over a batch of entries and resolve each one.
+        Called from the worker thread and, in continuous mode, inline from
+        an idle handler thread (guarded by ``_inline_lock``)."""
+        col = np.empty(len(batch), dtype=object)
+        for i, e in enumerate(batch):
+            col[i] = e.payload
+        ids = np.asarray([e.uid for e in batch], dtype=object)
+        df = DataFrame([{self.input_col: col, "id": ids}])
+        try:
+            out = self.model.transform(df).collect()
+            replies = out[self.reply_col]
+            for e, r in zip(batch, replies):
+                e.reply = self.reply_encoder(r)
+                e.done.set()
+        except Exception as ex:  # noqa: BLE001 — reply errors per-request
+            for e in batch:
+                e.status, e.reply = 500, {"error": str(ex)}
+                e.done.set()
+            with self.stats.lock:
+                self.stats.errors += len(batch)
+
     def _worker(self):
         while not self._stop.is_set():
             batch = self._drain()
             if not batch:
                 continue
-            col = np.empty(len(batch), dtype=object)
-            for i, e in enumerate(batch):
-                col[i] = e.payload
-            ids = np.asarray([e.uid for e in batch], dtype=object)
-            df = DataFrame([{self.input_col: col, "id": ids}])
-            try:
-                out = self.model.transform(df).collect()
-                replies = out[self.reply_col]
-                for e, r in zip(batch, replies):
-                    e.reply = self.reply_encoder(r)
-                    e.done.set()
-            except Exception as ex:  # noqa: BLE001 — reply errors per-request
-                for e in batch:
-                    e.status, e.reply = 500, {"error": str(ex)}
-                    e.done.set()
-                with self.stats.lock:
-                    self.stats.errors += len(batch)
+            # same lock as the inline fast path: scoring stays serialized
+            # end-to-end, so pipeline stages may keep per-call scratch state
+            with self._inline_lock:
+                self._score_batch(batch)
 
     # ------------------------------------------------------------------ api
     def start(self) -> "PipelineServer":

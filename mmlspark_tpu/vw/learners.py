@@ -305,10 +305,10 @@ class _VWBase(Estimator, HasFeaturesCol, HasLabelCol, HasWeightCol):
         wc = self.get("weight_col")
         specs = self.get("interactions") or []
         sw = StopWatch()
-        parts_idx, ys, ws = [], [], []
+        parts_idx, part_ids, ys, ws = [], [], [], []
         max_nnz = 1
         with sw.measure("ingest"):
-            for part in df.partitions:
+            for pid, part in enumerate(df.partitions):
                 if fc not in part or len(part[fc]) == 0:
                     continue
                 feats = part[fc]
@@ -317,6 +317,7 @@ class _VWBase(Estimator, HasFeaturesCol, HasLabelCol, HasWeightCol):
                 max_nnz = max(max_nnz, max((len(v["indices"]) for v in feats),
                                            default=1))
                 parts_idx.append(feats)
+                part_ids.append(pid)
                 ys.append(y_transform(np.asarray(part[lc], np.float64)))
                 ws.append(np.asarray(part[wc], np.float32) if wc
                           else np.ones(len(feats), np.float32))
@@ -361,11 +362,25 @@ class _VWBase(Estimator, HasFeaturesCol, HasLabelCol, HasWeightCol):
             for _ in range(iters):
                 weights, opt_state = lbfgs_step(weights, opt_state)
         state = _allreduce_pass_end((weights, jnp.zeros(D), jnp.zeros(D)))
-        stats = [TrainingStats(partition_id=0, rows=n,
-                               features_per_example=float((val != 0).sum() / max(n, 1)),
-                               passes=iters, total_time_s=sw.total_elapsed(),
-                               ingest_time_s=sw.elapsed("ingest"),
-                               learn_time_s=sw.elapsed("learn"))]
+        # features/example from pre-padding index lengths: explicit zero
+        # values count, all-padding rows don't (ADVICE r2); one stats row
+        # per source partition with its true id, mirroring the online path
+        stats = []
+        for pid, feats in zip(part_ids, parts_idx):
+            rows_p = len(feats)
+            nnz_p = sum(len(v["indices"]) for v in feats)
+            stats.append(TrainingStats(
+                partition_id=pid, rows=rows_p,
+                features_per_example=float(nnz_p / max(rows_p, 1)),
+                passes=iters, total_time_s=sw.total_elapsed(),
+                ingest_time_s=sw.elapsed("ingest"),
+                learn_time_s=sw.elapsed("learn")))
+        if not stats:
+            stats = [TrainingStats(partition_id=0, rows=0,
+                                   features_per_example=0.0, passes=iters,
+                                   total_time_s=sw.total_elapsed(),
+                                   ingest_time_s=sw.elapsed("ingest"),
+                                   learn_time_s=sw.elapsed("learn"))]
         return np.asarray(state[0]), stats
 
     def _fit_weights(self, df: DataFrame, loss_name: str, y_transform):
@@ -511,7 +526,9 @@ class VowpalWabbitClassificationModel(VowpalWabbitModelBase, HasProbabilityCol,
 
         def per_part(p):
             raw = self._raw_scores(self._effective_features(p))
-            prob = 1.0 / (1.0 + np.exp(-raw))
+            # clipped sigmoid: extreme margins would overflow np.exp (the
+            # probability saturates at float precision well before |30|)
+            prob = 1.0 / (1.0 + np.exp(-np.clip(raw, -30.0, 30.0)))
             prob_col = np.empty(len(raw), dtype=object)
             raw_col = np.empty(len(raw), dtype=object)
             for i in range(len(raw)):

@@ -128,3 +128,137 @@ def test_safe_load_refuses_pickle_and_foreign_classes(tmp_path):
             serialize.load(p2, safe=True)
     finally:
         serialize._TRUSTED_PREFIXES.discard("tests.")
+
+
+# ---------------------------------------------------------------------------
+# round-2 advisor findings
+# ---------------------------------------------------------------------------
+
+def test_load_dataframe_honours_safe_load_env(tmp_path, monkeypatch):
+    """ADVICE r2 (medium): direct load_dataframe() must resolve
+    MMLSPARK_TPU_SAFE_LOAD like load_stage/load do."""
+    from mmlspark_tpu.core.serialize import load_dataframe, save_dataframe
+
+    df = DataFrame.from_dict({"x": np.arange(4, dtype=np.float64)})
+    obj_col = np.empty(4, dtype=object)
+    for i in range(4):
+        obj_col[i] = {"i": i}
+    df = df.with_column("obj", obj_col)
+    path = str(tmp_path / "frame")
+    save_dataframe(df, path)
+    monkeypatch.setenv("MMLSPARK_TPU_SAFE_LOAD", "1")
+    with pytest.raises(ValueError):
+        load_dataframe(path)                     # env opt-in now applies
+    monkeypatch.delenv("MMLSPARK_TPU_SAFE_LOAD")
+    out = load_dataframe(path)                   # default stays permissive
+    assert out.collect()["obj"][2]["i"] == 2
+
+
+def test_onnx_lstm_peephole_raises():
+    """ADVICE r2: LSTM peephole weights must raise, not silently drop."""
+    from mmlspark_tpu.dl.onnx_import import onnx_to_jax
+    from mmlspark_tpu.dl.onnx_wire import build_model, encode_node
+
+    seq, batch, inp, H = 3, 2, 4, 5
+    rng = np.random.default_rng(0)
+    nodes = [encode_node("LSTM", ["x", "W", "R", "B", "", "", "", "P"],
+                         ["Y"], hidden_size=H)]
+    init = {"W": rng.normal(size=(1, 4 * H, inp)).astype(np.float32),
+            "R": rng.normal(size=(1, 4 * H, H)).astype(np.float32),
+            "B": np.zeros((1, 8 * H), np.float32),
+            "P": np.zeros((1, 3 * H), np.float32)}
+    data = build_model(nodes, init, [("x", [seq, batch, inp])],
+                       [("Y", [seq, 1, batch, H])])
+    with pytest.raises(NotImplementedError, match="peephole"):
+        apply_fn, variables = onnx_to_jax(data)
+        apply_fn(variables, np.zeros((seq, batch, inp), np.float32))
+
+
+def test_checkpoint_backend_marker_beats_mtime(tmp_path):
+    """ADVICE r2: when both backends wrote, the marker (not cp/rsync-fragile
+    mtimes) decides; explicit backend= wins over everything."""
+    import jax.numpy as jnp
+    import optax
+    from mmlspark_tpu.parallel.checkpoint import (load_train_state,
+                                                  save_train_state)
+    from mmlspark_tpu.parallel.trainer import TrainState
+
+    params = {"w": jnp.arange(4, dtype=jnp.float32)}
+    opt = optax.sgd(0.1)
+    state_a = TrainState(params=params, opt_state=opt.init(params), step=1)
+    state_b = TrainState(params={"w": jnp.arange(4, dtype=jnp.float32) + 10},
+                         opt_state=opt.init(params), step=2)
+    path = str(tmp_path / "ckpt")
+    save_train_state(state_a, path, backend="orbax")
+    save_train_state(state_b, path, backend="npz")   # npz wrote LAST
+    # adversarial mtime: touch the orbax dir newer than the npz
+    import os, time
+    os.utime(os.path.join(path, "orbax"))
+    restored = load_train_state(path)
+    assert int(restored.step) == 2                   # marker wins
+    template = TrainState(params=params, opt_state=opt.init(params), step=0)
+    forced = load_train_state(path, template=template, backend="orbax")
+    assert int(forced.step) == 1                     # explicit wins
+
+
+def test_histogram_explicit_backend_not_overridden(monkeypatch):
+    """ADVICE r2: MMLSPARK_TPU_HIST_BACKEND only applies to backend='auto'."""
+    import jax.numpy as jnp
+    from mmlspark_tpu.ops import histogram as hist_ops
+
+    rng = np.random.default_rng(0)
+    binned = jnp.asarray(rng.integers(0, 8, size=(64, 3)).astype(np.int32))
+    g = jnp.asarray(rng.normal(size=64).astype(np.float32))
+    h = jnp.ones(64, jnp.float32)
+    node = jnp.zeros(64, jnp.int32)
+    monkeypatch.setenv("MMLSPARK_TPU_HIST_BACKEND", "bogus_backend")
+    # explicit backend: env must NOT redirect (bogus would crash)
+    out = hist_ops.build(binned, g, h, node, 1, 8, backend="scatter")
+    assert out.shape == (1, 3, 8, 3)
+    # auto: env applies (bogus falls through to the scatter default — assert
+    # it selects *something* rather than crashing on the explicit path)
+    monkeypatch.setenv("MMLSPARK_TPU_HIST_BACKEND", "matmul")
+    out2 = hist_ops.build(binned, g, h, node, 1, 8, backend="auto")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2), atol=1e-4)
+
+
+def test_vw_bfgs_stats_count_packed_nnz_per_partition():
+    """ADVICE r2: features_per_example counts pre-padding nnz (explicit
+    zeros included), with true partition ids."""
+    rng = np.random.default_rng(1)
+    parts = []
+    for pid in range(2):
+        n = 50
+        feats = np.empty(n, dtype=object)
+        for i in range(n):
+            feats[i] = {"indices": np.asarray([0, 5, 9]),
+                        "values": np.asarray([1.0, 0.0, 2.0])}  # explicit 0
+        y = rng.integers(0, 2, n).astype(np.float64)
+        parts.append({"features": feats, "label": y})
+    from mmlspark_tpu.core.schema import ColumnType, Schema
+    df = DataFrame(parts, schema=Schema({"features": ColumnType.STRUCT,
+                                         "label": ColumnType.DOUBLE}))
+    reg = VowpalWabbitRegressor().set_params(args="--bfgs", num_passes=3)
+    model = reg.fit(df)
+    stats = model.get_performance_statistics().collect()
+    assert sorted(stats["partition_id"].tolist()) == [0, 1]
+    for fpe in stats["features_per_example"]:
+        assert fpe == pytest.approx(3.0)  # not 2.0 (explicit zero counts)
+
+
+def test_vw_classifier_extreme_margin_no_overflow():
+    """ADVICE r2 / VERDICT weak #8: the predict sigmoid must not overflow on
+    extreme raw margins."""
+    import warnings
+    df = _sparse_frame(300, seed=7)
+    scaled = df.map_partitions(
+        lambda p: {**p, "features": np.asarray(
+            [{"indices": v["indices"], "values": v["values"] * 1e4}
+             for v in p["features"]], dtype=object)})
+    model = VowpalWabbitClassifier().set_params(num_passes=3,
+                                                learning_rate=5.0).fit(scaled)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        out = model.transform(scaled).collect()
+    probs = np.stack(list(out["probability"]))
+    assert np.isfinite(probs).all()

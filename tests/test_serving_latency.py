@@ -1,0 +1,152 @@
+"""Serving latency regression gate (VERDICT r2 weak #2).
+
+Reference claim: continuous mode reaches ~1 ms
+(``docs/mmlspark-serving.md:10-11``).  BENCH_r02 measured p50 2.09 ms with no
+gate to catch the drift; this test pins the continuous-mode host path under a
+generous CI bound over a persistent HTTP/1.1 connection (the client pattern
+the reference's claim assumes).
+"""
+import http.client
+import json
+import time
+
+import numpy as np
+
+from mmlspark_tpu.core import DataFrame, Transformer
+from mmlspark_tpu.serving import PipelineServer
+
+
+class _Echo(Transformer):
+    """Minimal numeric transform: isolates server overhead from model cost."""
+
+    def _transform(self, frame):
+        def per_part(p):
+            return {**p, "reply": np.asarray(
+                [float(np.sum(v)) for v in p["request"]])}
+        return frame.map_partitions(per_part)
+
+    def transform_schema(self, schema):
+        return schema
+
+
+def test_continuous_p50_under_ci_bound():
+    srv = PipelineServer(_Echo(), port=0, mode="continuous").start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+        body = json.dumps([1.0, 2.0, 3.0])
+        hdrs = {"Content-Type": "application/json"}
+        for _ in range(50):  # warm (thread starts, first-touch allocs)
+            conn.request("POST", srv.api_path, body, hdrs)
+            conn.getresponse().read()
+        lats = []
+        n = 2000
+        for _ in range(n):
+            t0 = time.perf_counter()
+            conn.request("POST", srv.api_path, body, hdrs)
+            resp = conn.getresponse()
+            data = resp.read()
+            lats.append(time.perf_counter() - t0)
+        assert json.loads(data) == 6.0
+        lats.sort()
+        p50 = 1000 * lats[n // 2]
+        p95 = 1000 * lats[int(n * 0.95)]
+        # generous CI bound: the shared CPU container is noisy; the real
+        # regression signal is p50 drifting past the reference's ~1 ms claim
+        # plus headroom.  Locally this path measures well under 1 ms.
+        assert p50 < 3.0, f"continuous p50 {p50:.2f} ms regressed"
+        assert p95 < 25.0, f"continuous p95 {p95:.2f} ms regressed"
+    finally:
+        srv.stop()
+
+
+def test_keepalive_connection_reused():
+    """The HTTP/1.1 upgrade must actually keep the socket open — a silent
+    downgrade to close-per-request reintroduces connection setup costs."""
+    srv = PipelineServer(_Echo(), port=0, mode="continuous").start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+        conn.request("POST", srv.api_path, json.dumps([1.0]),
+                     {"Content-Type": "application/json"})
+        r1 = conn.getresponse()
+        r1.read()
+        assert r1.version == 11
+        assert r1.getheader("Connection", "keep-alive").lower() != "close"
+        sock_before = conn.sock
+        conn.request("POST", srv.api_path, json.dumps([2.0]),
+                     {"Content-Type": "application/json"})
+        r2 = conn.getresponse()
+        assert json.loads(r2.read()) == 2.0
+        assert conn.sock is sock_before  # same socket: reuse happened
+    finally:
+        srv.stop()
+
+
+def test_inline_fast_path_never_runs_concurrently_with_worker():
+    """Code-review r3: the inline fast path shares one lock with the worker
+    so pipeline scoring stays serialized (stages may keep per-call scratch
+    state)."""
+    import threading
+
+    class Reentrancy(Transformer):
+        def __init__(self):
+            super().__init__()
+            self.active = 0
+            self.max_active = 0
+            self.guard = threading.Lock()
+
+        def _transform(self, frame):
+            with self.guard:
+                self.active += 1
+                self.max_active = max(self.max_active, self.active)
+            time.sleep(0.002)  # widen the race window
+
+            def per_part(p):
+                return {**p, "reply": np.asarray(
+                    [float(np.sum(v)) for v in p["request"]])}
+            out = frame.map_partitions(per_part)
+            with self.guard:
+                self.active -= 1
+            return out
+
+        def transform_schema(self, schema):
+            return schema
+
+    model = Reentrancy()
+    srv = PipelineServer(model, port=0, mode="continuous").start()
+    try:
+        def fire():
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                              timeout=10)
+            for _ in range(10):
+                conn.request("POST", srv.api_path, json.dumps([1.0]),
+                             {"Content-Type": "application/json"})
+                assert conn.getresponse().read() == b"1.0"
+
+        threads = [threading.Thread(target=fire) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert model.max_active == 1, \
+            f"scoring ran {model.max_active}-way concurrent"
+    finally:
+        srv.stop()
+
+
+def test_keepalive_survives_404_with_body():
+    """Code-review r3: a POST to a wrong path must drain its body, or the
+    next request on the same keep-alive connection desynchronizes."""
+    srv = PipelineServer(_Echo(), port=0, mode="continuous").start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+        conn.request("POST", "/wrong", json.dumps([1, 2, 3]),
+                     {"Content-Type": "application/json"})
+        r1 = conn.getresponse()
+        r1.read()
+        assert r1.status == 404
+        conn.request("POST", srv.api_path, json.dumps([4.0, 5.0]),
+                     {"Content-Type": "application/json"})
+        r2 = conn.getresponse()
+        assert r2.status == 200 and json.loads(r2.read()) == 9.0
+    finally:
+        srv.stop()
