@@ -86,10 +86,20 @@ class GBDTParams:
     metric: str = ""
     seed: int = 0
     verbosity: int = -1
-    # one-vs-rest categorical splits (reference getCategoricalIndexes,
-    # LightGBMBase.scala:168): these feature indices bin by CATEGORY CODE
-    # and split as code == c vs rest (LightGBM's max_cat_to_onehot mode)
+    # categorical splits (reference getCategoricalIndexes,
+    # LightGBMBase.scala:168): these feature indices bin by CATEGORY CODE.
+    # Low-cardinality features (<= max_cat_to_onehot observed codes) split
+    # one-vs-rest (code == c); higher-cardinality features use LightGBM's
+    # sorted-subset (many-vs-many) search: codes sorted by grad/hess ratio,
+    # prefix subsets scanned from the same histogram tensor
     categorical_features: Optional[Tuple[int, ...]] = None
+    max_cat_to_onehot: int = 4
+    cat_smooth: float = 10.0         # ratio denominator smoothing
+    cat_l2: float = 10.0             # extra L2 when scoring subset splits
+    max_cat_threshold: int = 32      # cap on the smaller side's category count
+    # resolved in train() from observed cardinalities (data-dependent, part
+    # of the jit cache key); settable explicitly for tests
+    cat_subset: Optional[Tuple[int, ...]] = None
     # voting-parallel (reference parallelism=voting_parallel + topK,
     # TrainParams.scala:11-12): each shard votes its local top-k features
     # per node; only the global top-2k features' histograms are allreduced,
@@ -298,7 +308,9 @@ def _params_sig(p: "GBDTParams") -> tuple:
             p.sigmoid, p.alpha, p.tweedie_variance_power,
             p.top_rate, p.other_rate, p.feature_fraction,
             p.bagging_fraction, p.bagging_freq,
-            tuple(p.categorical_features or ()), p.voting_k)
+            tuple(p.categorical_features or ()), tuple(p.cat_subset or ()),
+            p.max_cat_to_onehot, p.cat_smooth, p.cat_l2, p.max_cat_threshold,
+            p.voting_k)
 
 
 def _cached(key, builder):
@@ -312,6 +324,81 @@ def _cached(key, builder):
 # ---------------------------------------------------------------------------
 # tree grower
 # ---------------------------------------------------------------------------
+
+class _CatTools:
+    """Categorical split machinery shared by both growers: static masks, the
+    cat_l2-regularised score, ratio-sorted prefix stats (the many-vs-many
+    candidate scan) and winner membership reconstruction.
+
+    Reference: LightGBM's native sorted-subset categorical search, wired
+    from ``LightGBMBase.scala:163-200`` (categoricalSlotIndexes ->
+    ``categorical_feature`` engine param)."""
+
+    def __init__(self, params: "GBDTParams", F: int, B: int):
+        import jax.numpy as jnp
+        self.jnp = jnp
+        self.B = B
+        self.cat_np = np.zeros((F,), bool)
+        if params.categorical_features:
+            self.cat_np[list(params.categorical_features)] = True
+        self.sub_np = np.zeros((F,), bool)
+        if params.cat_subset:
+            self.sub_np[list(params.cat_subset)] = True
+        self.has_cat = bool(self.cat_np.any())
+        self.has_subset = bool(self.sub_np.any())
+        self.cat_smooth = params.cat_smooth
+        self.cat_l2 = params.cat_l2
+        self.maxcat = float(params.max_cat_threshold)
+        self.l1, self.l2 = params.lambda_l1, params.lambda_l2
+        self.seenable_np = np.arange(B) != B - 1  # B-1 = NaN/overflow bin
+
+    def leaf_score_cat(self, G, H):
+        # subset splits score under extra regularisation (LightGBM cat_l2):
+        # high-cardinality categoricals overfit the gain otherwise
+        jnp = self.jnp
+        t = jnp.sign(G) * jnp.maximum(jnp.abs(G) - self.l1, 0.0)
+        return t ** 2 / (H + self.l2 + self.cat_l2)
+
+    def sorted_prefix(self, hist_d):
+        """Sorted-subset candidate stats for (..., B, 3) histograms: sort
+        bins ascending by grad/hess ratio (cat_smooth in the denominator,
+        LightGBM's categorical ordering); unseen bins and the NaN catch-all
+        sort last (+inf), so the cumsum at position k is the stats of the
+        BEST k+1 seen categories — the many-vs-many candidate set.  Returns
+        (prefix_cumsum, sort_order, valid_prefix_mask)."""
+        jnp, B = self.jnp, self.B
+        seen = (hist_d[..., 2] > 0) & jnp.asarray(self.seenable_np)
+        ratio = jnp.where(seen,
+                          hist_d[..., 0] / (hist_d[..., 1] + self.cat_smooth),
+                          jnp.inf)
+        order = jnp.argsort(ratio, axis=-1)
+        subcum = jnp.cumsum(
+            jnp.take_along_axis(hist_d, order[..., None], axis=-2), axis=-2)
+        nseen = seen.sum(axis=-1, keepdims=True).astype(jnp.float32)
+        k1 = (jnp.arange(B) + 1).astype(jnp.float32)
+        # a prefix must leave >=1 seen category right, and the smaller side
+        # stays under max_cat_threshold (LightGBM's subset-size cap)
+        sub_ok = (k1 < nseen) & ((k1 <= self.maxcat)
+                                 | (nseen - k1 <= self.maxcat))
+        return subcum, order, sub_ok
+
+    def winner_member(self, win_hist, bf, bb):
+        """(nodes, B) category membership of each node's winning split:
+        subset winners take the first bb+1 bins of the ratio sort; one-vs-rest
+        winners take the single code bb.  Only read where the winning feature
+        is categorical."""
+        jnp, B = self.jnp, self.B
+        onehot_m = jnp.arange(B)[None, :] == bb[:, None]
+        if not self.has_subset:
+            return onehot_m
+        _, ordw, _ = self.sorted_prefix(win_hist)
+        msorted = jnp.arange(B)[None, :] <= bb[:, None]
+        nodes = win_hist.shape[0]
+        member_sub = jnp.zeros((nodes, B), bool).at[
+            jnp.arange(nodes)[:, None], ordw].set(msorted)
+        return jnp.where(jnp.asarray(self.sub_np)[bf][:, None], member_sub,
+                         onehot_m)
+
 
 def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
                      params: GBDTParams, axis_name: str = None,
@@ -338,10 +425,11 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
     D, F, B = max_depth, num_features, num_bins
     I = 2 ** D - 1     # internal nodes
     L = 2 ** D         # leaves
-    cat_np = np.zeros((F,), bool)
-    if params.categorical_features:
-        cat_np[list(params.categorical_features)] = True
-    has_cat = bool(cat_np.any())
+    ct = _CatTools(params, F, B)
+    cat_np, sub_np = ct.cat_np, ct.sub_np
+    has_cat, has_subset = ct.has_cat, ct.has_subset
+    sorted_prefix, winner_member = ct.sorted_prefix, ct.winner_member
+    leaf_score_cat = ct.leaf_score_cat
     l1, l2 = params.lambda_l1, params.lambda_l2
     min_data = float(params.min_data_in_leaf)
     min_hess = params.min_sum_hessian_in_leaf
@@ -369,8 +457,12 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
         split_gain = jnp.zeros((I,), jnp.float32)
         internal_value = jnp.zeros((I,), jnp.float32)
         internal_count = jnp.zeros((I,), jnp.float32)
+        # per-internal-node category membership of the LEFT set (read only
+        # where the split feature is categorical); 1-wide dummy otherwise
+        cat_member = jnp.zeros((I, B if has_cat else 1), bool)
 
         cat_b = jnp.asarray(cat_np)
+        sub_b = jnp.asarray(sub_np)
         edge_finite = jnp.concatenate(
             [jnp.isfinite(edges), jnp.zeros((F, 1), bool)], axis=1)[None, :, :]
         if has_cat:
@@ -381,22 +473,32 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
             cat_cand = cat_b[None, :, None] & \
                 (jnp.arange(B) != B - 1)[None, None, :]
             edge_finite = edge_finite | cat_cand
-        def split_gains(hist_d, fmask2, edge3, catm2):
+        def split_gains(hist_d, fmask2, edge3, catm2, subm2):
             """(nodes, Fs, B, 3) histograms -> (gain, left-stat pick, node
             totals).  LEFT-child stats: numerical split at t takes bins <= t
             (the cumsum); categorical one-vs-rest at code c takes bin c alone
-            (the histogram itself).  ``fmask2``/``catm2`` broadcast over
-            (nodes, Fs); ``edge3`` over (nodes, Fs, B)."""
+            (the histogram itself); sorted-subset candidate k takes the best
+            k+1 ratio-sorted categories (the prefix cumsum).  ``fmask2`` /
+            ``catm2`` / ``subm2`` broadcast over (nodes, Fs); ``edge3`` over
+            (nodes, Fs, B)."""
             cum = jnp.cumsum(hist_d, axis=2)
             tot = cum[:, :1, -1, :]                 # (nodes,1,3) totals
             left3 = jnp.where(catm2[:, :, None, None], hist_d, cum) \
                 if has_cat else cum
+            if has_subset:
+                subcum, _, sub_ok = sorted_prefix(hist_d)
+                left3 = jnp.where(subm2[:, :, None, None], subcum, left3)
+                edge3 = jnp.where(subm2[:, :, None], sub_ok, edge3)
             GL, HL, CL = left3[..., 0], left3[..., 1], left3[..., 2]
             Gp, Hp, Cp = tot[..., 0], tot[..., 1], tot[..., 2]
             GR, HR, CR = (Gp[:, :, None] - GL, Hp[:, :, None] - HL,
                           Cp[:, :, None] - CL)
             gain = (leaf_score(GL, HL) + leaf_score(GR, HR)
                     - leaf_score(Gp, Hp)[:, :, None])
+            if has_subset:
+                gain_cat = (leaf_score_cat(GL, HL) + leaf_score_cat(GR, HR)
+                            - leaf_score_cat(Gp, Hp)[:, :, None])
+                gain = jnp.where(subm2[:, :, None], gain_cat, gain)
             # split at bin t => left: bins<=t, right: bins>t; needs a finite
             # edge (last bin and inf-padded pseudo-bins can't split)
             valid = ((CL >= min_data) & (CR >= min_data)
@@ -437,7 +539,8 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
                                       axis=1).reshape(nodes_d, F, B, 3)
                 prev_hist = local
                 gain_l, _, _ = split_gains(local, feat_mask[None, :],
-                                           edge_finite, cat_b[None, :])
+                                           edge_finite, cat_b[None, :],
+                                           sub_b[None, :])
                 per_feat = gain_l.max(axis=2)        # (nodes, F) local best
                 top_gain, top_local = jax.lax.top_k(per_feat, voting_k)
                 # a shard with fewer than k locally-valid candidates must not
@@ -455,7 +558,8 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
                     jnp.broadcast_to(edge_finite, (nodes_d, F, B)),
                     sel[:, :, None], axis=1)
                 gain, pick, (Gp0, Hp0, Cp0) = split_gains(
-                    sel_hist, feat_mask[sel], edge3, cat_b[sel])
+                    sel_hist, feat_mask[sel], edge3, cat_b[sel], sub_b[sel])
+                hist_for_win = sel_hist
                 Fs = k2
             else:
                 if d == 0:
@@ -472,7 +576,9 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
                         .reshape(nodes_d, F, B, 3)
                 prev_hist = hist_d
                 gain, pick, (Gp0, Hp0, Cp0) = split_gains(
-                    hist_d, feat_mask[None, :], edge_finite, cat_b[None, :])
+                    hist_d, feat_mask[None, :], edge_finite, cat_b[None, :],
+                    sub_b[None, :])
+                hist_for_win = hist_d
                 sel = None
                 Fs = F
 
@@ -485,6 +591,11 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
             do_split = best_gain > min_gain
 
             idx = off + jnp.arange(nodes_d)
+            if has_cat:
+                member = winner_member(
+                    hist_for_win[jnp.arange(nodes_d), bf_local], bf, bb)
+                cat_member = cat_member.at[idx].set(
+                    member & do_split[:, None] & cat_b[bf][:, None])
             split_feature = split_feature.at[idx].set(jnp.where(do_split, bf, -1))
             threshold_bin = threshold_bin.at[idx].set(bb)
             thr_raw = edges[bf, jnp.clip(bb, 0, B - 2)]
@@ -509,8 +620,9 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
             s_of_row = do_split[node]
             row_bin = binned[jnp.arange(n), jnp.maximum(f_of_row, 0)].astype(jnp.int32)
             if has_cat:
+                memb_row = member[node, row_bin]
                 right_dec = jnp.where(cat_b[jnp.maximum(f_of_row, 0)],
-                                      row_bin != t_of_row, row_bin > t_of_row)
+                                      ~memb_row, row_bin > t_of_row)
             else:
                 right_dec = row_bin > t_of_row
             go_right = s_of_row & right_dec
@@ -525,7 +637,7 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
         leaf_value = jnp.where(lc > 0, lv, 0.0)
         return (lc_const, rc_const, split_feature, threshold, threshold_bin,
                 split_gain, internal_value, internal_count, leaf_value, lc,
-                node)
+                cat_member, node)
 
     lc_np, rc_np = perfect_tree_children(D)
     lc_const = jnp.asarray(lc_np)
@@ -561,10 +673,9 @@ def make_leafwise_grower(num_leaves: int, depth_cap: int, num_features: int,
     from ..ops import histogram as hist_ops
 
     L, M, F, B = num_leaves, num_leaves - 1, num_features, num_bins
-    cat_np = np.zeros((F,), bool)
-    if params.categorical_features:
-        cat_np[list(params.categorical_features)] = True
-    has_cat = bool(cat_np.any())
+    ct = _CatTools(params, F, B)
+    cat_np, sub_np = ct.cat_np, ct.sub_np
+    has_cat, has_subset = ct.has_cat, ct.has_subset
     l1, l2 = params.lambda_l1, params.lambda_l2
     min_data = float(params.min_data_in_leaf)
     min_hess = params.min_sum_hessian_in_leaf
@@ -588,6 +699,7 @@ def make_leafwise_grower(num_leaves: int, depth_cap: int, num_features: int,
     def grow(binned, grad, hess, hist_mask, feat_mask, edges):
         n = binned.shape[0]
         cat_b = jnp.asarray(cat_np)
+        sub_b = jnp.asarray(sub_np)
         edge_ok = jnp.concatenate(
             [jnp.isfinite(edges), jnp.zeros((F, 1), bool)], axis=1)
         if has_cat:
@@ -605,30 +717,52 @@ def make_leafwise_grower(num_leaves: int, depth_cap: int, num_features: int,
             """(F, B) gains + left-child pick stats from one leaf's (psum'd)
             histogram.  Same split semantics as the level-wise grower:
             numerical split at bin t takes bins <= t left (the cumsum);
-            categorical one-vs-rest at code c takes bin c alone."""
+            categorical one-vs-rest at code c takes bin c alone;
+            sorted-subset candidate k takes the best k+1 ratio-sorted
+            categories (the prefix cumsum)."""
             cum = jnp.cumsum(hist_f3, axis=1)
             tot = cum[0, -1, :]                               # (3,)
             left3 = jnp.where(cat_b[:, None, None], hist_f3, cum) \
                 if has_cat else cum
+            sub_edge = None
+            if has_subset:
+                subcum, _, sub_ok = ct.sorted_prefix(hist_f3)
+                left3 = jnp.where(sub_b[:, None, None], subcum, left3)
+                sub_edge = sub_ok
             GL, HL, CL = left3[..., 0], left3[..., 1], left3[..., 2]
             GR, HR, CR = tot[0] - GL, tot[1] - HL, tot[2] - CL
             gain = (leaf_score(GL, HL) + leaf_score(GR, HR)
                     - leaf_score(tot[0], tot[1]))
+            if has_subset:
+                gain_cat = (ct.leaf_score_cat(GL, HL)
+                            + ct.leaf_score_cat(GR, HR)
+                            - ct.leaf_score_cat(tot[0], tot[1]))
+                gain = jnp.where(sub_b[:, None], gain_cat, gain)
             valid = ((CL >= min_data) & (CR >= min_data)
                      & (HL >= min_hess) & (HR >= min_hess)
                      & fmask[:, None] & depth_ok)
+            if has_subset:  # subset prefixes have their own validity mask
+                valid = valid & jnp.where(sub_b[:, None], sub_edge, True)
             return jnp.where(valid, gain, -jnp.inf), left3, tot
+
+        def leaf_member(win_hist_b3, bf, bb):
+            """(B,) membership of one leaf's winning categorical split."""
+            return ct.winner_member(win_hist_b3[None], bf[None],
+                                    bb[None])[0]
 
         def leaf_best(hist_f3, fmask, depth_ok):
             """Best candidate split of one leaf: (gain, feat, bin,
-            left-child (G,H,C))."""
+            left-child (G,H,C), totals, member bitset)."""
             gain, left3, tot = candidate_tables(hist_f3, fmask, depth_ok)
+            # edge_ok is sound for subset features too: their position-(B-1)
+            # candidate (a prefix of all bins) is invalid regardless
             gain = jnp.where(edge_ok, gain, -jnp.inf)
             flat = gain.reshape(-1)
             best = jnp.argmax(flat)
             bf = (best // B).astype(jnp.int32)
             bb = (best % B).astype(jnp.int32)
-            return flat[best], bf, bb, left3[bf, bb], tot
+            member = leaf_member(hist_f3[bf], bf, bb) if has_cat else None
+            return flat[best], bf, bb, left3[bf, bb], tot, member
 
         def leaf_best_voting(hist_local_f3, fmask, depth_ok):
             """Voting-parallel per-leaf split finding: rank features by
@@ -649,19 +783,34 @@ def make_leafwise_grower(num_leaves: int, depth_cap: int, num_features: int,
                 jnp.cumsum(hist_local_f3[:1], axis=1)[0, -1, :], axis_name)
             left3 = jnp.where(cat_b[sel][:, None, None], sel_hist, cum) \
                 if has_cat else cum
+            sub_edge = True
+            if has_subset:
+                subcum, _, sub_ok = ct.sorted_prefix(sel_hist)
+                left3 = jnp.where(sub_b[sel][:, None, None], subcum, left3)
+                sub_edge = jnp.where(sub_b[sel][:, None], sub_ok, True)
             GL, HL, CL = left3[..., 0], left3[..., 1], left3[..., 2]
             GR, HR, CR = tot[0] - GL, tot[1] - HL, tot[2] - CL
             gain = (leaf_score(GL, HL) + leaf_score(GR, HR)
                     - leaf_score(tot[0], tot[1]))
+            if has_subset:
+                gain_cat = (ct.leaf_score_cat(GL, HL)
+                            + ct.leaf_score_cat(GR, HR)
+                            - ct.leaf_score_cat(tot[0], tot[1]))
+                gain = jnp.where(sub_b[sel][:, None], gain_cat, gain)
             valid = ((CL >= min_data) & (CR >= min_data)
                      & (HL >= min_hess) & (HR >= min_hess)
-                     & fmask[sel][:, None] & depth_ok & edge_ok[sel])
+                     & fmask[sel][:, None] & depth_ok & edge_ok[sel]
+                     & sub_edge)
             gain = jnp.where(valid, gain, -jnp.inf)
             flat = gain.reshape(-1)
             best = jnp.argmax(flat)
             bf = sel[(best // B)].astype(jnp.int32)
             bb = (best % B).astype(jnp.int32)
-            return flat[best], bf, bb, left3[best // B, bb], tot
+            # membership from the winner's GLOBAL (psum'd) histogram slice:
+            # every shard reconstructs the identical bitset
+            member = leaf_member(sel_hist[best // B], bf, bb) \
+                if has_cat else None
+            return flat[best], bf, bb, left3[best // B, bb], tot, member
 
         best_of = leaf_best_voting if use_voting else leaf_best
 
@@ -681,7 +830,7 @@ def make_leafwise_grower(num_leaves: int, depth_cap: int, num_features: int,
         # ---- root
         leaf_of_row = jnp.zeros((n,), jnp.int32)
         h_root = psum_maybe(local_hist(hist_mask))
-        g0, f0, b0, lp0, tot0 = best_of(h_root, feat_mask, depth_ok_of(0))
+        g0, f0, b0, lp0, tot0, m0 = best_of(h_root, feat_mask, depth_ok_of(0))
 
         carry0 = dict(
             leaf_of_row=leaf_of_row,
@@ -701,6 +850,11 @@ def make_leafwise_grower(num_leaves: int, depth_cap: int, num_features: int,
             leaf_tot=jnp.zeros((L, 3)).at[0].set(tot0),
             leaf_depth=jnp.zeros((L,), jnp.int32),
             created=jnp.zeros((L,), bool).at[0].set(True),
+            # per-internal-node LEFT category set + each live leaf's best
+            # candidate's membership (1-wide dummies without categoricals)
+            cbs=jnp.zeros((M, B if has_cat else 1), bool),
+            best_member=(jnp.zeros((L, B), bool).at[0].set(m0) if has_cat
+                         else jnp.zeros((L, 1), bool)),
         )
 
         def step(c, s):
@@ -721,6 +875,9 @@ def make_leafwise_grower(num_leaves: int, depth_cap: int, num_features: int,
                 thr_raw = jnp.where(cat_b[f], b.astype(jnp.float32), thr_raw)
 
             c = dict(c)
+            if has_cat:
+                member_j = c["best_member"][j]               # (B,)
+                c["cbs"] = set_if(c["cbs"], s, member_j & cat_b[f], do, M)
             c["sf"] = set_if(c["sf"], s, f, do, M)
             c["tb"] = set_if(c["tb"], s, b, do, M)
             c["th"] = set_if(c["th"], s, thr_raw, do, M)
@@ -749,7 +906,7 @@ def make_leafwise_grower(num_leaves: int, depth_cap: int, num_features: int,
             row_bin = binned[jnp.arange(n), jnp.maximum(f, 0)].astype(jnp.int32)
             if has_cat:
                 right_dec = jnp.where(cat_b[jnp.maximum(f, 0)],
-                                      row_bin != b, row_bin > b)
+                                      ~member_j[row_bin], row_bin > b)
             else:
                 right_dec = row_bin > b
             c["leaf_of_row"] = jnp.where(do & in_j & right_dec, new_leaf,
@@ -772,8 +929,12 @@ def make_leafwise_grower(num_leaves: int, depth_cap: int, num_features: int,
             c["hists"] = set_if(c["hists"], new_leaf, hr, do, L)
 
             dok = depth_ok_of(d_new)
-            gl, fl, bl, lpl, _ = best_of(hl, feat_mask, dok)
-            gr, fr, br, lpr, _ = best_of(hr, feat_mask, dok)
+            gl, fl, bl, lpl, _, ml = best_of(hl, feat_mask, dok)
+            gr, fr, br, lpr, _, mr = best_of(hr, feat_mask, dok)
+            if has_cat:
+                c["best_member"] = set_if(c["best_member"], j, ml, do, L)
+                c["best_member"] = set_if(c["best_member"], new_leaf, mr,
+                                          do, L)
             c["best_gain"] = set_if(c["best_gain"], j, gl, do, L)
             c["best_gain"] = set_if(c["best_gain"], new_leaf, gr, do, L)
             c["best_feat"] = set_if(c["best_feat"], j, fl, do, L)
@@ -793,7 +954,8 @@ def make_leafwise_grower(num_leaves: int, depth_cap: int, num_features: int,
                                            c["leaf_tot"][:, 1]), 0.0)
         leaf_count = jnp.where(c["created"], c["leaf_tot"][:, 2], 0.0)
         return (c["lc_arr"], c["rc_arr"], c["sf"], c["th"], c["tb"], c["sg"],
-                c["iv"], c["ic"], leaf_value, leaf_count, c["leaf_of_row"])
+                c["iv"], c["ic"], leaf_value, leaf_count, c["cbs"],
+                c["leaf_of_row"])
 
     return grow
 
@@ -806,14 +968,17 @@ def make_binned_walker(depth_bound: int,
                        categorical_features: Optional[Tuple[int, ...]] = None):
     """Binned-space pointer-chase over array-of-nodes trees (leaf slots
     encoded ``~leaf_id``; leaves self-loop so a static ``depth_bound``
-    iteration count resolves every tree shape)."""
+    iteration count resolves every tree shape).  ``bitset`` (M, B) carries
+    sorted-subset categorical membership (bin in set -> left); without it,
+    categorical nodes fall back to one-vs-rest code equality."""
     import jax
     import jax.numpy as jnp
     D = max(1, depth_bound)
     cats = frozenset(categorical_features or ())
 
     @jax.jit
-    def walk(binned, split_feature, threshold_bin, left_child, right_child):
+    def walk(binned, split_feature, threshold_bin, left_child, right_child,
+             bitset=None):
         n = binned.shape[0]
         node = jnp.zeros((n,), jnp.int32)
         F = binned.shape[1]
@@ -824,7 +989,9 @@ def make_binned_walker(depth_bound: int,
             t = threshold_bin[j]
             row_bin = binned[jnp.arange(n), jnp.maximum(f, 0)].astype(jnp.int32)
             if cat_b is not None:
-                dec = jnp.where(cat_b[jnp.maximum(f, 0)], row_bin != t,
+                left_dec = bitset[j, row_bin] if bitset is not None \
+                    else row_bin == t
+                dec = jnp.where(cat_b[jnp.maximum(f, 0)], ~left_dec,
                                 row_bin > t)
             else:
                 dec = row_bin > t
@@ -1018,6 +1185,18 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
     edges = jnp.asarray(mapper.edges)
     B = mapper.num_bins
 
+    if p.categorical_features and p.cat_subset is None:
+        # observed-cardinality mode split (LightGBM max_cat_to_onehot):
+        # low-cardinality features stay one-vs-rest; the rest get the
+        # sorted-subset many-vs-many search.  Data-dependent, hence part of
+        # the resolved params (and the jit cache key).
+        sub = []
+        for f_i in p.categorical_features:
+            codes = np.unique(binned_np[:, f_i])
+            if int((codes != B - 1).sum()) > p.max_cat_to_onehot:
+                sub.append(int(f_i))
+        p = dataclasses.replace(p, cat_subset=tuple(sub))
+
     sig = _params_sig(p)
     if shard_rows:
         from jax.sharding import PartitionSpec as P
@@ -1042,7 +1221,7 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
                 grow_raw, mesh=mesh,
                 in_specs=(P(AXIS_DATA), P(AXIS_DATA), P(AXIS_DATA), P(AXIS_DATA),
                           P(), P()),
-                out_specs=(P(),) * 10 + (P(AXIS_DATA),), check_vma=False))
+                out_specs=(P(),) * 11 + (P(AXIS_DATA),), check_vma=False))
         grower = _cached(("sharded_grower", sig, F, id(mesh)), _build_sharded)
     else:
         binned = jnp.asarray(binned_np)
@@ -1072,7 +1251,15 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
     _TREE_KEYS = ("left_child", "right_child", "split_feature", "threshold",
                   "threshold_bin", "split_gain", "internal_value",
                   "internal_count", "leaf_value", "leaf_count")
-    trees: Dict[str, List[np.ndarray]] = {k: [] for k in _TREE_KEYS}
+    has_cat = bool(p.categorical_features)
+    # subset splits need the per-node category bitset persisted; a warm-start
+    # booster that carries bitsets keeps them through continuation too
+    store_bitset = has_cat and (
+        bool(p.cat_subset)
+        or (init_booster is not None
+            and getattr(init_booster, "cat_bitset", None) is not None))
+    tree_keys = _TREE_KEYS + (("cat_bitset",) if store_bitset else ())
+    trees: Dict[str, List[np.ndarray]] = {k: [] for k in tree_keys}
     tree_weights: List[float] = []
     # the replay walker must also resolve warm-start trees, which may be
     # DEEPER than this run's depth bound (e.g. uncapped leaf-wise booster
@@ -1084,14 +1271,21 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
                                                 p.categorical_features))
     if init_booster is not None:
         assert init_booster.num_leaves == L and init_booster.num_features == F
+        # one-vs-rest warm-start trees get onehot bitsets synthesized so the
+        # continued booster's trees are uniform
+        init_cbs = init_booster.resolve_cat_bitset(B) if store_bitset else None
         for t in range(init_booster.num_trees):
-            for k in trees:
+            for k in _TREE_KEYS:
                 trees[k].append(getattr(init_booster, k)[t])
+            if store_bitset:
+                trees["cat_bitset"].append(init_cbs[t])
             tree_weights.append(float(init_booster.tree_weight[t]))
             leaf = walker(binned, jnp.asarray(init_booster.split_feature[t]),
                           jnp.asarray(init_booster.threshold_bin[t]),
                           jnp.asarray(init_booster.left_child[t]),
-                          jnp.asarray(init_booster.right_child[t]))
+                          jnp.asarray(init_booster.right_child[t]),
+                          bitset=(jnp.asarray(init_cbs[t])
+                                  if store_bitset else None))
             contrib = jnp.asarray(init_booster.leaf_value[t])[leaf] * init_booster.tree_weight[t]
             scores = scores.at[:, t % K].add(contrib)
         # shift base score to the incoming booster's BEFORE reassigning, so
@@ -1146,11 +1340,11 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
             g, h = g * wamp[:, None], h * wamp[:, None]
         tree_out = []
         for c in range(K):
-            lch, rch, sf, th, tb, sg, iv, ic, lv, lc, leaf = grow_fn(
+            lch, rch, sf, th, tb, sg, iv, ic, lv, lc, cbs, leaf = grow_fn(
                 binned_d, g[:, c], h[:, c], hist_mask, feat_mask_d, edges_d)
             lv_s = lv * shrink_const
             scores = scores.at[:, c].add(lv_s[leaf] * new_w)
-            tree_out.append((lch, rch, sf, th, tb, sg, iv, ic, lv_s, lc))
+            tree_out.append((lch, rch, sf, th, tb, sg, iv, ic, lv_s, lc, cbs))
         return scores, tree_out
 
     _iter_jit = {} if shard_rows else {
@@ -1207,7 +1401,8 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
                 g, h = g * wamp[:, None], h * wamp[:, None]
             outs = []
             for c in range(K):
-                lch, rch, sf, th, tb, sg, iv, ic, lv, lc, leaf = grow_fn(
+                # chunked path excludes categoricals, so the bitset is a dummy
+                lch, rch, sf, th, tb, sg, iv, ic, lv, lc, _cbs, leaf = grow_fn(
                     binned, g[:, c], h[:, c], hist_mask, feat_mask, edges)
                 lv_s = lv * shrink_const
                 scores_c = scores_c.at[:, c].add(lv_s[leaf])
@@ -1325,7 +1520,9 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
             for t in dropped:
                 leaf = walker(binned, trees["split_feature"][t],
                               trees["threshold_bin"][t],
-                              trees["left_child"][t], trees["right_child"][t])
+                              trees["left_child"][t], trees["right_child"][t],
+                              bitset=(trees["cat_bitset"][t]
+                                      if store_bitset else None))
                 drop_delta = drop_delta.at[:, t % K].add(
                     trees["leaf_value"][t][leaf] * tree_weights[t])
             g_pre, h_pre = jit_objective(scores - drop_delta, y_dev, w_dev)
@@ -1351,21 +1548,26 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
             shrink = 1.0 if p.boosting_type == "rf" else p.learning_rate
             tree_out = []
             for c in range(K):
-                (lch, rch, sf, th, tb, sg, iv, ic, lv, lc, leaf_of_row) = grower(
+                (lch, rch, sf, th, tb, sg, iv, ic, lv, lc, cbs,
+                 leaf_of_row) = grower(
                     binned, g_eff[:, c], h_eff[:, c], base_mask, feat_mask, edges)
                 lv_s = lv * shrink
                 scores = scores.at[:, c].add(lv_s[leaf_of_row] * new_w)
-                tree_out.append((lch, rch, sf, th, tb, sg, iv, ic, lv_s, lc))
+                tree_out.append((lch, rch, sf, th, tb, sg, iv, ic, lv_s, lc,
+                                 cbs))
 
-        for c, (lch, rch, sf, th, tb, sg, iv, ic, lv_s, lc) in enumerate(tree_out):
+        for c, (lch, rch, sf, th, tb, sg, iv, ic, lv_s, lc, cbs) \
+                in enumerate(tree_out):
             # keep tree arrays on device: every host fetch is a relay
             # round-trip; one device_get happens after the loop
-            for k_name, v in zip(_TREE_KEYS,
-                                 (lch, rch, sf, th, tb, sg, iv, ic, lv_s, lc)):
+            vals = (lch, rch, sf, th, tb, sg, iv, ic, lv_s, lc) \
+                + ((cbs,) if store_bitset else ())
+            for k_name, v in zip(tree_keys, vals):
                 trees[k_name].append(v)
             tree_weights.append(new_w)
             if has_valid:
-                leaf_v = walker(binned_v, sf, tb, lch, rch)
+                leaf_v = walker(binned_v, sf, tb, lch, rch,
+                                bitset=cbs if store_bitset else None)
                 scores_v = scores_v.at[:, c].add(lv_s[leaf_v] * new_w)
 
         # ---- dart renormalize dropped trees
@@ -1373,16 +1575,18 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
             factor = len(dropped) / (1.0 + len(dropped))
             for t in dropped:
                 # subtract the shrunken part from train/valid scores
+                bs_t = trees["cat_bitset"][t] if store_bitset else None
                 leaf = walker(binned, trees["split_feature"][t],
                               trees["threshold_bin"][t],
-                              trees["left_child"][t], trees["right_child"][t])
+                              trees["left_child"][t], trees["right_child"][t],
+                              bitset=bs_t)
                 delta = trees["leaf_value"][t][leaf] * tree_weights[t] * (factor - 1.0)
                 scores = scores.at[:, t % K].add(delta)
                 if has_valid:
                     leaf_v = walker(binned_v, trees["split_feature"][t],
                                     trees["threshold_bin"][t],
                                     trees["left_child"][t],
-                                    trees["right_child"][t])
+                                    trees["right_child"][t], bitset=bs_t)
                     delta_v = trees["leaf_value"][t][leaf_v] * tree_weights[t] * (factor - 1.0)
                     scores_v = scores_v.at[:, t % K].add(delta_v)
                 tree_weights[t] *= factor
@@ -1417,6 +1621,10 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
         # level-wise continuation must keep a bound that resolves the
         # warm-start trees, which may be deeper than this run's depth
         D = max(D, init_booster.max_depth)
+    cat_bitset = None
+    if store_bitset:
+        cat_bitset = np.stack([np.asarray(a, bool)
+                               for a in trees_np["cat_bitset"]])
     booster = GBDTBooster(
         np.stack(trees_np["split_feature"]), np.stack(trees_np["threshold"]),
         np.stack(trees_np["threshold_bin"]), np.stack(trees_np["split_gain"]),
@@ -1427,5 +1635,6 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
         max_depth=D, num_features=F, objective=p.objective, num_class=K,
         init_score=init_score, average_output=(p.boosting_type == "rf"),
         feature_names=feature_names, best_iteration=best_iter, sigmoid=p.sigmoid,
-        categorical_features=list(p.categorical_features or []))
+        categorical_features=list(p.categorical_features or []),
+        cat_bitset=cat_bitset)
     return TrainResult(booster=booster, evals=evals, bin_mapper=mapper)

@@ -68,8 +68,17 @@ def _shared_params(cls):
                   "(reference TrainParams topK)", "int", 20),
         ("shard_rows", "shard rows over the active device mesh", "bool", False),
         ("categorical_features", "feature indices treated as categorical "
-         "(one-vs-rest code==c splits; reference getCategoricalIndexes, "
+         "(one-vs-rest below max_cat_to_onehot cardinality, sorted-subset "
+         "many-vs-many above; reference getCategoricalIndexes, "
          "LightGBMBase.scala:168)", "list", None),
+        ("max_cat_to_onehot", "cardinality threshold below which categorical "
+         "features split one-vs-rest instead of sorted-subset", "int", 4),
+        ("cat_smooth", "grad/hess ratio smoothing when ordering categories "
+         "for subset splits", "double", 10.0),
+        ("cat_l2", "extra L2 regularization applied when scoring "
+         "sorted-subset categorical splits", "double", 10.0),
+        ("max_cat_threshold", "max categories on the smaller side of a "
+         "sorted-subset split", "int", 32),
     ]
     for name, doc, dtype, default in specs:
         setattr(cls, name, Param(name, doc, dtype, default))
@@ -118,6 +127,9 @@ class _LightGBMBase(Estimator, HasFeaturesCol, HasLabelCol, HasWeightCol):
             metric=self.get("metric"), seed=self.get("seed"),
             categorical_features=tuple(self.get("categorical_features") or ())
             or None,
+            max_cat_to_onehot=self.get("max_cat_to_onehot"),
+            cat_smooth=self.get("cat_smooth"), cat_l2=self.get("cat_l2"),
+            max_cat_threshold=self.get("max_cat_threshold"),
             voting_k=self.get("top_k")
             if self.get("parallelism") == "voting_parallel" else 0)
         return p

@@ -98,7 +98,7 @@ class GBDTBooster(Saveable):
                  feature_names: Optional[List[str]] = None,
                  best_iteration: int = -1, sigmoid: float = 1.0,
                  categorical_features: Optional[List[int]] = None,
-                 left_child=None, right_child=None):
+                 left_child=None, right_child=None, cat_bitset=None):
         self.split_feature = np.asarray(split_feature, np.int32)
         if left_child is None:  # pre-round-3 artifact: perfect depth-D tree
             lc1, rc1 = perfect_tree_children(int(max_depth))
@@ -124,15 +124,20 @@ class GBDTBooster(Saveable):
         self.feature_names = feature_names or [f"f{i}" for i in range(num_features)]
         self.best_iteration = int(best_iteration)
         self.sigmoid = float(sigmoid)
-        # one-vs-rest categorical splits: for these features, threshold holds
-        # the CATEGORY CODE and the decision is x == code -> left (reference
-        # categorical support, LightGBMBase.getCategoricalIndexes:168; NaN
-        # matches no category and routes right)
+        # categorical splits (reference categorical support,
+        # LightGBMBase.getCategoricalIndexes:168; NaN matches no category and
+        # routes right).  Without ``cat_bitset``: one-vs-rest — threshold
+        # holds the CATEGORY CODE and x == code -> left.  With it:
+        # ``cat_bitset[t, m]`` is the (B,) LEFT category set of node m
+        # (sorted-subset many-vs-many splits; onehot nodes carry their
+        # single-bit set), and code-in-set -> left.
         self.categorical_features = sorted(int(i) for i in
                                            (categorical_features or []))
         self._is_cat = np.zeros(self.num_features, bool)
         if self.categorical_features:
             self._is_cat[self.categorical_features] = True
+        self.cat_bitset = None if cat_bitset is None \
+            else np.asarray(cat_bitset, bool)
 
     # ------------------------------------------------------------------ shape
     @property
@@ -146,6 +151,23 @@ class GBDTBooster(Saveable):
     @property
     def num_leaves(self) -> int:
         return self.leaf_value.shape[1]
+
+    def resolve_cat_bitset(self, B: int) -> np.ndarray:
+        """(T, M, B) LEFT category sets, width-normalized to B bins; for
+        one-vs-rest boosters the stored codes become one-bit sets (the two
+        decision rules are equivalent, so this is lossless)."""
+        T, M = self.split_feature.shape
+        out = np.zeros((T, M, B), bool)
+        if self.cat_bitset is not None:
+            W = min(B, self.cat_bitset.shape[-1])
+            out[:, :, :W] = self.cat_bitset[:, :, :W]
+            return out
+        is_cat_node = (self.split_feature >= 0) & \
+            self._is_cat[np.maximum(self.split_feature, 0)]
+        codes = np.clip(self.threshold_bin, 0, B - 1)
+        t_i, m_i = np.nonzero(is_cat_node)
+        out[t_i, m_i, codes[t_i, m_i]] = True
+        return out
 
     # ------------------------------------------------------------------ predict
     def _walk_leaves(self, X: np.ndarray, use_trees: Optional[slice] = None) -> np.ndarray:
@@ -163,9 +185,11 @@ class GBDTBooster(Saveable):
         sf = self.split_feature
         th = self.threshold
         lca, rca = self.left_child, self.right_child
+        cbs = self.cat_bitset
         if use_trees is not None:
             sf, th = sf[use_trees], th[use_trees]
             lca, rca = lca[use_trees], rca[use_trees]
+            cbs = cbs[use_trees] if cbs is not None else None
         D = max(1, self.max_depth)
         n_rows = X.shape[0]
         T = sf.shape[0]
@@ -183,18 +207,28 @@ class GBDTBooster(Saveable):
                 isc = isc_all[np.maximum(f, 0)]
                 # categorical codes compare after rounding, matching the
                 # round() used at binning time (2.9999 trains as code 3)
-                go_right = (f >= 0) & np.where(isc, np.round(xv) != thr,
-                                               xv > thr)
+                if cbs is not None:
+                    Bb = cbs.shape[-1]
+                    code = np.where(np.isfinite(xv), np.round(xv), -1.0)
+                    memb = ((code >= 0) & (code < Bb)
+                            & cbs[t_idx, j,
+                                  np.clip(code, 0, Bb - 1).astype(np.int64)])
+                    go_right = (f >= 0) & np.where(isc, ~memb, xv > thr)
+                else:
+                    go_right = (f >= 0) & np.where(isc, np.round(xv) != thr,
+                                                   xv > thr)
                 child = np.where(go_right, rca[t_idx, j], lca[t_idx, j])
                 node = np.where(node >= 0, child, node)
             return (~node).astype(np.int64)
 
+        use_bitset = cbs is not None and bool(self._is_cat.any())
+
         @partial(jax.jit, static_argnames=())
-        def walk(X, sf, th, lca, rca, cat):
+        def walk(X, sf, th, lca, rca, cat, cbs_a):
             n = X.shape[0]
             Xn = jnp.nan_to_num(X, nan=-jnp.inf)  # missing routes left
 
-            def one_tree(sf_t, th_t, lc_t, rc_t):
+            def one_tree(sf_t, th_t, lc_t, rc_t, cbs_t):
                 node = jnp.zeros((n,), jnp.int32)
 
                 def body(d, node):
@@ -202,20 +236,31 @@ class GBDTBooster(Saveable):
                     f = sf_t[j]
                     thr = th_t[j]
                     x = Xn[jnp.arange(n), jnp.maximum(f, 0)]
+                    if use_bitset:
+                        Bb = cbs_t.shape[-1]
+                        code = jnp.where(jnp.isfinite(x), jnp.round(x), -1.0)
+                        memb = ((code >= 0) & (code < Bb)
+                                & cbs_t[j, jnp.clip(code, 0, Bb - 1)
+                                        .astype(jnp.int32)])
+                        cat_right = ~memb
+                    else:
+                        cat_right = jnp.round(x) != thr
                     go_right = (f >= 0) & jnp.where(cat[jnp.maximum(f, 0)],
-                                                    jnp.round(x) != thr,
-                                                    x > thr)
+                                                    cat_right, x > thr)
                     child = jnp.where(go_right, rc_t[j], lc_t[j])
                     return jnp.where(node >= 0, child, node)
 
                 node = jax.lax.fori_loop(0, D, body, node)
                 return ~node
 
-            return jax.vmap(one_tree)(sf, th, lca, rca).T  # (n, T)
+            return jax.vmap(one_tree)(sf, th, lca, rca, cbs_a).T  # (n, T)
 
+        cbs_dev = jnp.asarray(cbs) if use_bitset \
+            else jnp.zeros((T, 1, 1), bool)
         return np.asarray(walk(jnp.asarray(X, jnp.float32), jnp.asarray(sf),
                                jnp.asarray(th), jnp.asarray(lca),
-                               jnp.asarray(rca), jnp.asarray(self._is_cat)))
+                               jnp.asarray(rca), jnp.asarray(self._is_cat),
+                               cbs_dev))
 
     def predict_leaf(self, X: np.ndarray) -> np.ndarray:
         """Reference ``predictLeaf`` (LightGBMBooster.scala:403)."""
@@ -291,8 +336,17 @@ class GBDTBooster(Saveable):
                 thr = self.threshold[t, j]
                 xv = Xn[rows, np.maximum(f, 0)]
                 isc = self._is_cat[np.maximum(f, 0)]
-                go_right = (f >= 0) & np.where(isc, np.round(xv) != thr,
-                                               xv > thr)
+                if self.cat_bitset is not None:
+                    Bb = self.cat_bitset.shape[-1]
+                    code = np.where(np.isfinite(xv), np.round(xv), -1.0)
+                    memb = ((code >= 0) & (code < Bb)
+                            & self.cat_bitset[t, j,
+                                              np.clip(code, 0, Bb - 1)
+                                              .astype(np.int64)])
+                    go_right = (f >= 0) & np.where(isc, ~memb, xv > thr)
+                else:
+                    go_right = (f >= 0) & np.where(isc, np.round(xv) != thr,
+                                                   xv > thr)
                 nxt = np.where(go_right, rca[j], lca[j])
                 nxt_val = np.where(
                     nxt >= 0,
@@ -325,6 +379,12 @@ class GBDTBooster(Saveable):
         assert self.num_leaves == other.num_leaves and self.num_class == other.num_class
         assert self.categorical_features == other.categorical_features
         cat = lambda a, b: np.concatenate([a, b], axis=0)
+        merged_bitset = None
+        if self.cat_bitset is not None or other.cat_bitset is not None:
+            W = max(b.cat_bitset.shape[-1] for b in (self, other)
+                    if b.cat_bitset is not None)
+            merged_bitset = cat(self.resolve_cat_bitset(W),
+                                other.resolve_cat_bitset(W))
         return GBDTBooster(
             cat(self.split_feature, other.split_feature),
             cat(self.threshold, other.threshold),
@@ -342,7 +402,8 @@ class GBDTBooster(Saveable):
             objective=self.objective, num_class=self.num_class,
             init_score=self.init_score, average_output=self.average_output,
             feature_names=self.feature_names, sigmoid=self.sigmoid,
-            categorical_features=self.categorical_features)
+            categorical_features=self.categorical_features,
+            cat_bitset=merged_bitset)
 
     # ------------------------------------------------------------------ serde
     _META = ("max_depth", "num_features", "objective", "num_class", "init_score",
@@ -351,24 +412,42 @@ class GBDTBooster(Saveable):
     _ARRAYS = ("split_feature", "threshold", "threshold_bin", "split_gain",
                "internal_value", "internal_count", "leaf_value", "leaf_count",
                "tree_weight", "left_child", "right_child")
+    # optional arrays: absent on boosters without sorted-subset splits (and
+    # on pre-round-3 artifacts)
+    _OPT_ARRAYS = ("cat_bitset",)
+
+    def _present_arrays(self):
+        return self._ARRAYS + tuple(k for k in self._OPT_ARRAYS
+                                    if getattr(self, k) is not None)
 
     def to_string(self) -> str:
         """Model as a JSON string (reference native model string serde,
         ``saveNativeModel:454`` / ``modelString`` params)."""
         d = {k: getattr(self, k) for k in self._META}
-        d["arrays"] = {k: getattr(self, k).tolist() for k in self._ARRAYS}
+        arrays = {k: getattr(self, k).tolist() for k in self._ARRAYS}
+        if self.cat_bitset is not None:
+            # pack the (T, M, B) membership to uint8 words: 32x smaller JSON
+            packed = np.packbits(self.cat_bitset, axis=-1)
+            arrays["cat_bitset_packed"] = packed.tolist()
+            d["cat_bitset_bins"] = int(self.cat_bitset.shape[-1])
+        d["arrays"] = arrays
         return json.dumps(d)
 
     @staticmethod
     def from_string(s: str) -> "GBDTBooster":
         d = json.loads(s)
         arrays = {k: np.asarray(v) for k, v in d.pop("arrays").items()}
+        packed = arrays.pop("cat_bitset_packed", None)
+        nbits = d.pop("cat_bitset_bins", 0)
+        if packed is not None:
+            arrays["cat_bitset"] = np.unpackbits(
+                packed.astype(np.uint8), axis=-1)[..., :nbits].astype(bool)
         return GBDTBooster(**arrays, **d)
 
     def save(self, path: str) -> None:
         os.makedirs(path, exist_ok=True)
         np.savez(os.path.join(path, "trees.npz"),
-                 **{k: getattr(self, k) for k in self._ARRAYS})
+                 **{k: getattr(self, k) for k in self._present_arrays()})
         with open(os.path.join(path, "meta.json"), "w") as f:
             json.dump({k: getattr(self, k) for k in self._META}, f)
 
@@ -378,7 +457,8 @@ class GBDTBooster(Saveable):
             meta = json.load(f)
         with np.load(os.path.join(path, "trees.npz")) as z:
             # pre-round-3 artifacts lack child arrays (perfect trees only)
-            arrays = {k: z[k] for k in cls._ARRAYS if k in z.files}
+            arrays = {k: z[k]
+                      for k in cls._ARRAYS + cls._OPT_ARRAYS if k in z.files}
         return cls(**arrays, **meta)
 
 
@@ -474,7 +554,15 @@ def _tree_shap_one(x, phi, t, booster: "GBDTBooster"):
             return
         xv = x[f]
         if booster._is_cat[f]:
-            goes_left = round(xv) == th[j] if np.isfinite(xv) else False
+            if not np.isfinite(xv):
+                goes_left = False
+            elif booster.cat_bitset is not None:
+                code = int(round(xv))
+                Bb = booster.cat_bitset.shape[-1]
+                goes_left = bool(0 <= code < Bb
+                                 and booster.cat_bitset[t, j, code])
+            else:
+                goes_left = round(xv) == th[j]
         else:
             goes_left = not (xv > th[j])    # NaN compares False -> left
         hot, cold = (left, right) if goes_left else (right, left)
