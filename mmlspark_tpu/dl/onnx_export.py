@@ -1,0 +1,352 @@
+"""ONNX export — boosters and flax zoo models -> serialized ModelProto bytes.
+
+Reference capability: the reference's interop surface ships models OUT as
+well as in (``saveNativeModel`` for LightGBM, CNTK graph artifacts for the
+DL side; ``LightGBMBooster.scala:454``, ``CNTKModel.scala:34``).  The TPU
+rebuild's interchange format is ONNX: these exporters emit standard ops —
+``ai.onnx.ml`` TreeEnsemble for GBDT boosters, Conv/BatchNormalization/
+Gemm/MaxPool graphs for the flax zoo — through the dependency-free wire
+codec in ``onnx_wire``, so any ONNX runtime (and this repo's own
+``onnx_import``) can read them back.
+
+Round-trip contract (tested): ``onnx_to_jax(export_gbdt(b))(X) ==
+b.raw_scores(X)`` and ``onnx_to_jax(export_resnet(...))(x_nchw) ==
+module.apply(..., x_nhwc)``.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .onnx_wire import build_model, encode_node
+
+ML_DOMAIN = "ai.onnx.ml"
+
+
+# --------------------------------------------------------------------------
+# GBDT booster -> TreeEnsembleRegressor / TreeEnsembleClassifier
+# --------------------------------------------------------------------------
+
+def _emit_tree(booster, t: int, weight_rows: List[Tuple[int, int, int, float]],
+               nodes: Dict[str, list], target_id: int, bitset) -> None:
+    """Flatten tree ``t``'s reachable slots into the ONNX parallel-array
+    node encoding.  Sorted-subset categorical nodes (a SET left-decision,
+    which ai.onnx.ml cannot express directly) expand into a BRANCH_EQ chain
+    — one equality test per member code, any hit -> left."""
+    sf = booster.split_feature[t]
+    th = booster.threshold[t]
+    lc, rc = booster.left_child[t], booster.right_child[t]
+    lv = booster.leaf_value[t]
+    w = float(booster.tree_weight[t])
+    is_cat = booster._is_cat
+
+    def resolve_leaf(j: int) -> int:
+        # pass-through slots chase left pointers until a leaf encoding
+        while j >= 0 and sf[j] < 0:
+            j = int(lc[j])
+        return ~j if j < 0 else ~0
+
+    next_id = [0]
+
+    def add_node(mode: str, feat: int, value: float, track_true: int) -> int:
+        nid = next_id[0]
+        next_id[0] += 1
+        nodes["treeids"].append(t)
+        nodes["nodeids"].append(nid)
+        nodes["featureids"].append(feat)
+        nodes["modes"].append(mode)
+        nodes["values"].append(value)
+        nodes["trueids"].append(0)      # patched by caller
+        nodes["falseids"].append(0)
+        nodes["track_true"].append(track_true)
+        return nid
+
+    def emit(j: int) -> int:
+        """Emit the subtree rooted at slot j (or leaf ~j if j < 0); returns
+        its ONNX node id."""
+        if j < 0 or sf[j] < 0:
+            leaf = ~j if j < 0 else resolve_leaf(j)
+            nid = add_node("LEAF", 0, 0.0, 0)
+            weight_rows.append((t, nid, target_id, float(lv[leaf]) * w))
+            return nid
+        f = int(sf[j])
+        if is_cat[f] and bitset is not None and bitset[t, j].sum() != 1:
+            codes = np.nonzero(bitset[t, j])[0]
+            if len(codes) == 0:  # empty left set: all rows go right
+                return emit(int(rc[j]))
+            chain = [add_node("BRANCH_EQ", f, float(c), 0) for c in codes]
+            left_id = emit(int(lc[j]))
+            right_id = emit(int(rc[j]))
+            for i, nid in enumerate(chain):
+                pos = _pos(nodes, t, nid)
+                nodes["trueids"][pos] = left_id
+                nodes["falseids"][pos] = chain[i + 1] \
+                    if i + 1 < len(chain) else right_id
+            return chain[0]
+        if is_cat[f]:
+            code = float(bitset[t, j].argmax()) if bitset is not None \
+                else float(th[j])
+            nid = add_node("BRANCH_EQ", f, code, 0)  # NaN != code -> right
+        else:
+            # numeric x <= thr -> left; NaN tracks TRUE (missing routes left)
+            nid = add_node("BRANCH_LEQ", f, float(th[j]), 1)
+        left_id = emit(int(lc[j]))
+        right_id = emit(int(rc[j]))
+        pos = _pos(nodes, t, nid)
+        nodes["trueids"][pos] = left_id
+        nodes["falseids"][pos] = right_id
+        return nid
+
+    emit(0)
+
+
+def _pos(nodes: Dict[str, list], t: int, nid: int) -> int:
+    # nodes of tree t are contiguous and nid-ordered within the flat arrays
+    for i in range(len(nodes["nodeids"]) - 1, -1, -1):
+        if nodes["treeids"][i] == t and nodes["nodeids"][i] == nid:
+            return i
+    raise KeyError((t, nid))
+
+
+def export_gbdt(booster, name: str = "gbdt") -> bytes:
+    """GBDT booster -> ONNX TreeEnsemble model bytes.
+
+    Regression/ranking objectives emit ``TreeEnsembleRegressor``; binary and
+    multiclass emit ``TreeEnsembleClassifier`` (scores output, post_transform
+    NONE — the raw margins, so consumers apply their own link exactly as
+    ``raw_scores`` callers do here).  RF averaging folds ``1/T_c`` into the
+    leaf weights.  Input: float tensor (N, num_features)."""
+    K = booster.num_class if booster.objective == "multiclass" else 1
+    T = booster.num_trees
+    classifier = booster.objective in ("binary", "multiclass")
+    nodes: Dict[str, list] = {k: [] for k in
+                              ("treeids", "nodeids", "featureids", "modes",
+                               "values", "trueids", "falseids", "track_true")}
+    weight_rows: List[Tuple[int, int, int, float]] = []
+    for t in range(T):
+        _emit_tree(booster, t, weight_rows, nodes, t % K, booster.cat_bitset)
+    if booster.average_output:
+        wsum = [float(booster.tree_weight[c::K].sum()) or 1.0
+                for c in range(K)]
+        weight_rows = [(t, n, cid, wt / wsum[cid])
+                       for (t, n, cid, wt) in weight_rows]
+    base = [float(booster.init_score)] * K
+
+    prefix = "class" if classifier else "target"
+    attrs: Dict[str, Any] = {
+        "nodes_treeids": nodes["treeids"], "nodes_nodeids": nodes["nodeids"],
+        "nodes_featureids": nodes["featureids"],
+        "nodes_modes": _strings(nodes["modes"]),
+        "nodes_values": [float(v) for v in nodes["values"]],
+        "nodes_truenodeids": nodes["trueids"],
+        "nodes_falsenodeids": nodes["falseids"],
+        "nodes_missing_value_tracks_true": nodes["track_true"],
+        f"{prefix}_treeids": [r[0] for r in weight_rows],
+        f"{prefix}_nodeids": [r[1] for r in weight_rows],
+        f"{prefix}_ids": [r[2] for r in weight_rows],
+        f"{prefix}_weights": [float(r[3]) for r in weight_rows],
+        "base_values": base,
+        "post_transform": "NONE",
+    }
+    if classifier:
+        attrs["classlabels_int64s"] = list(range(max(K, 2)))
+        outputs = [("label", [0]), ("scores", [0, K])]
+        out_names = ["label", "scores"]
+    else:
+        attrs["n_targets"] = K
+        outputs = [("scores", [0, K])]
+        out_names = ["scores"]
+    op = "TreeEnsembleClassifier" if classifier else "TreeEnsembleRegressor"
+    node = encode_node(op, ["input"], out_names, **attrs)
+    # domain field (NodeProto field 7) marks the ai.onnx.ml op
+    from .onnx_wire import _str_field
+    node += _str_field(7, ML_DOMAIN)
+    return build_model([node], {}, [("input", [0, booster.num_features])],
+                       outputs)
+
+
+def _strings(vals: Sequence[str]) -> list:
+    return [v.encode() for v in vals]
+
+
+# --------------------------------------------------------------------------
+# flax Dense stacks (MLP) -> Gemm chains
+# --------------------------------------------------------------------------
+
+_ACTS = {"relu": "Relu", "tanh": "Tanh", "sigmoid": "Sigmoid",
+         "leaky_relu": "LeakyRelu", None: None, "": None}
+
+
+def export_mlp(params: Dict[str, Any], input_dim: int,
+               activation: str = "relu", final_activation: str = "") -> bytes:
+    """flax Dense-stack params -> ONNX Gemm(+activation) chain.
+
+    ``params`` is the ``{'Dense_0': {'kernel', 'bias'}, ...}`` pytree (any
+    key names; layer order = insertion order, matching flax ``nn.compact``
+    tracing).  Kernels stay (in, out) — Gemm with transB=0."""
+    layers = [(k, v) for k, v in params.items()
+              if isinstance(v, dict) and "kernel" in v]
+    if not layers:
+        raise ValueError("no Dense layers found in params")
+    act_op = _ACTS[activation]
+    nodes: List[bytes] = []
+    inits: Dict[str, np.ndarray] = {}
+    cur = "input"
+    for i, (lname, leaf) in enumerate(layers):
+        k = np.asarray(leaf["kernel"], np.float32)
+        inits[f"{lname}.w"] = k
+        ins = [cur, f"{lname}.w"]
+        if "bias" in leaf and leaf["bias"] is not None:
+            inits[f"{lname}.b"] = np.asarray(leaf["bias"], np.float32)
+            ins.append(f"{lname}.b")
+        out = f"{lname}.out"
+        nodes.append(encode_node("Gemm", ins, [out]))
+        cur = out
+        last = i == len(layers) - 1
+        a = _ACTS[final_activation] if last else act_op
+        if a:
+            nodes.append(encode_node(a, [cur], [f"{lname}.act"]))
+            cur = f"{lname}.act"
+    nodes.append(encode_node("Identity", [cur], ["output"]))
+    out_dim = int(np.asarray(layers[-1][1]["kernel"]).shape[1])
+    return build_model(nodes, inits, [("input", [0, input_dim])],
+                       [("output", [0, out_dim])])
+
+
+# --------------------------------------------------------------------------
+# flax ResNet -> Conv/BatchNormalization/MaxPool/Gemm graph (NCHW)
+# --------------------------------------------------------------------------
+
+class _GraphWriter:
+    """Incremental node/initializer accumulator tracking the running spatial
+    size, so SAME pads resolve to the exact asymmetric explicit pads flax/XLA
+    would use at this input size."""
+
+    def __init__(self, input_hw: int):
+        self.nodes: List[bytes] = []
+        self.inits: Dict[str, np.ndarray] = {}
+        self.hw = input_hw
+        self.n = 0
+
+    def name(self, tag: str) -> str:
+        self.n += 1
+        return f"{tag}_{self.n}"
+
+    def same_pads(self, k: int, s: int) -> List[int]:
+        pt = max((int(np.ceil(self.hw / s)) - 1) * s + k - self.hw, 0)
+        lo = pt // 2
+        hi = pt - lo
+        return [lo, lo, hi, hi]
+
+    def conv(self, x: str, kernel: np.ndarray, strides: Tuple[int, int],
+             pads: Optional[List[int]] = None) -> str:
+        """flax HWIO kernel -> OIHW Conv node; pads=None means flax SAME."""
+        k = kernel.shape[0]
+        s = strides[0]
+        if pads is None:
+            pads = self.same_pads(k, s)
+            self.hw = int(np.ceil(self.hw / s))
+        else:
+            self.hw = (self.hw + pads[0] + pads[2] - k) // s + 1
+        w_name = self.name("w")
+        self.inits[w_name] = np.ascontiguousarray(
+            np.transpose(np.asarray(kernel, np.float32), (3, 2, 0, 1)))
+        out = self.name("conv")
+        self.nodes.append(encode_node(
+            "Conv", [x, w_name], [out], strides=list(strides),
+            pads=pads, kernel_shape=[k, k]))
+        return out
+
+    def bn(self, x: str, scope: Dict[str, Any], stats: Dict[str, Any]) -> str:
+        names = []
+        for key, arr in (("scale", scope.get("scale")),
+                         ("bias", scope.get("bias")),
+                         ("mean", stats["mean"]), ("var", stats["var"])):
+            nm = self.name(key)
+            if arr is None:
+                arr = np.ones_like(np.asarray(stats["mean"])) \
+                    if key == "scale" else np.zeros_like(np.asarray(stats["mean"]))
+            self.inits[nm] = np.asarray(arr, np.float32).reshape(-1)
+            names.append(nm)
+        out = self.name("bn")
+        self.nodes.append(encode_node(
+            "BatchNormalization", [x] + names, [out], epsilon=1e-5))
+        return out
+
+    def op(self, op_type: str, ins: List[str], **attrs) -> str:
+        out = self.name(op_type.lower())
+        self.nodes.append(encode_node(op_type, ins, [out], **attrs))
+        return out
+
+
+def export_resnet(module, variables: Dict[str, Any],
+                  input_hw: int = 224, features_only: bool = False) -> bytes:
+    """flax ``models.resnet.ResNet`` (+ its variables) -> ONNX bytes.
+
+    Walks the module's static structure (``stage_sizes`` / ``block_cls``)
+    against the actual param tree, emitting the NCHW Conv/BN/MaxPool graph
+    ONNX runtimes expect; input is fixed at ``(N, 3, input_hw, input_hw)``
+    because SAME pads are resolved to explicit asymmetric pads per layer.
+    ``features_only`` stops at the pooled embedding (the ImageFeaturizer
+    cut, reference ``ImageFeaturizer.scala:49``)."""
+    params = variables["params"]
+    stats = variables.get("batch_stats", {})
+    g = _GraphWriter(input_hw)
+    x = g.conv("input", params["conv_init"]["kernel"], (2, 2),
+               pads=[3, 3, 3, 3])
+    x = g.bn(x, params["bn_init"], stats["bn_init"])
+    x = g.op("Relu", [x])
+    mp_pads = [1, 1, 1, 1]
+    g.hw = (g.hw + 2 - 3) // 2 + 1
+    x = g.op("MaxPool", [x], kernel_shape=[3, 3], strides=[2, 2],
+             pads=mp_pads)
+    block_name = module.block_cls.__name__
+    bi = 0
+    for i, count in enumerate(module.stage_sizes):
+        for j in range(count):
+            strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+            scope = params[f"{block_name}_{bi}"]
+            bstats = stats[f"{block_name}_{bi}"]
+            x = _export_block(g, x, scope, bstats, strides,
+                              bottleneck=block_name == "BottleneckBlock")
+            bi += 1
+    x = g.op("GlobalAveragePool", [x])
+    x = g.op("Flatten", [x], axis=1)
+    if not features_only:
+        g.inits["head.w"] = np.asarray(params["head"]["kernel"], np.float32)
+        g.inits["head.b"] = np.asarray(params["head"]["bias"], np.float32)
+        x = g.op("Gemm", [x, "head.w", "head.b"])
+    g.nodes.append(encode_node("Identity", [x], ["output"]))
+    return build_model(g.nodes, g.inits,
+                       [("input", [0, 3, input_hw, input_hw])],
+                       [("output", [0, 0])])
+
+
+def _export_block(g: _GraphWriter, x: str, scope, bstats, strides,
+                  bottleneck: bool) -> str:
+    residual = x
+    hw_in = g.hw
+    if bottleneck:
+        y = g.conv(x, scope["Conv_0"]["kernel"], (1, 1))
+        y = g.bn(y, scope["BatchNorm_0"], bstats["BatchNorm_0"])
+        y = g.op("Relu", [y])
+        y = g.conv(y, scope["Conv_1"]["kernel"], strides)
+        y = g.bn(y, scope["BatchNorm_1"], bstats["BatchNorm_1"])
+        y = g.op("Relu", [y])
+        y = g.conv(y, scope["Conv_2"]["kernel"], (1, 1))
+        y = g.bn(y, scope["BatchNorm_2"], bstats["BatchNorm_2"])
+    else:
+        y = g.conv(x, scope["Conv_0"]["kernel"], strides)
+        y = g.bn(y, scope["BatchNorm_0"], bstats["BatchNorm_0"])
+        y = g.op("Relu", [y])
+        y = g.conv(y, scope["Conv_1"]["kernel"], (1, 1))
+        y = g.bn(y, scope["BatchNorm_1"], bstats["BatchNorm_1"])
+    if "conv_proj" in scope:
+        hw_out = g.hw
+        g.hw = hw_in
+        residual = g.conv(residual, scope["conv_proj"]["kernel"], strides)
+        residual = g.bn(residual, scope["norm_proj"], bstats["norm_proj"])
+        assert g.hw == hw_out
+    out = g.op("Add", [residual, y])
+    return g.op("Relu", [out])

@@ -38,16 +38,54 @@ def _is_host(*vals) -> bool:
                for v in vals)
 
 
-def _pool_dims(node: Node, rank: int):
+def _auto_pads(in_spatial, kernel, strides, mode: str):
+    """SAME_UPPER / SAME_LOWER explicit pads from static input dims (under
+    jit every shape is static, so this folds at trace time)."""
+    pads = []
+    for n, k, s in zip(in_spatial, kernel, strides):
+        pt = max((int(np.ceil(n / s)) - 1) * s + k - n, 0)
+        small, big = pt // 2, pt - pt // 2
+        pads.append((small, big) if mode == "SAME_UPPER" else (big, small))
+    return pads
+
+
+def _pool_dims(node: Node, x_shape):
     k = node.attr_ints("kernel_shape")
     s = node.attr_ints("strides", [1] * len(k))
-    p = node.attr_ints("pads", [0] * (2 * len(k)))
-    half = len(p) // 2
-    pads = list(zip(p[:half], p[half:]))
+    auto = node.attr_s("auto_pad", "NOTSET")
+    if auto in ("SAME_UPPER", "SAME_LOWER"):
+        pads = _auto_pads(x_shape[2:], k, s, auto)
+    elif auto == "VALID":
+        pads = [(0, 0)] * len(k)
+    else:
+        p = node.attr_ints("pads", [0] * (2 * len(k)))
+        half = len(p) // 2
+        pads = list(zip(p[:half], p[half:]))
+    extra = [0] * len(k)
+    if node.attr_i("ceil_mode"):
+        # ceil output: extend the trailing pad so floor arithmetic lands on
+        # ceil((n + pl + pr - k)/s) + 1 windows; the extension is init-value
+        # padding (-inf for max, 0 for avg with real-element denominators),
+        # so window contents match the ONNX ignore-out-of-range semantics.
+        # The extension is returned separately: AveragePool's
+        # count_include_pad divisor counts declared pads but NOT these
+        # out-of-range cells.
+        extra = [_ceil_extra(n, pl, pr, kk, ss)
+                 for (pl, pr), n, kk, ss in zip(pads, x_shape[2:], k, s)]
+        pads = [(pl, pr + e) for (pl, pr), e in zip(pads, extra)]
     window = (1, 1) + tuple(k)
     strides = (1, 1) + tuple(s)
     padding = ((0, 0), (0, 0)) + tuple(pads)
-    return window, strides, padding
+    return window, strides, padding, extra
+
+
+def _ceil_extra(n: int, pl: int, pr: int, k: int, s: int) -> int:
+    span = n + pl + pr - k
+    out_ceil = -(-span // s) + 1
+    # ONNX: the last window must start inside the real+explicit-pad region
+    if (out_ceil - 1) * s >= n + pl:
+        out_ceil -= 1
+    return max(0, (out_ceil - 1) * s + k - (n + pl + pr))
 
 
 def _eval_node(node: Node, env: Dict[str, Any], jnp, jax):
@@ -90,9 +128,15 @@ def _eval_node(node: Node, env: Dict[str, Any], jnp, jax):
         s = node.attr_ints("strides", [1] * spatial)
         d = node.attr_ints("dilations", [1] * spatial)
         p = node.attr_ints("pads", [0] * (2 * spatial))
-        if node.attr_s("auto_pad", "NOTSET") not in ("NOTSET", ""):
-            raise NotImplementedError("Conv auto_pad")
-        pads = list(zip(p[:spatial], p[spatial:]))
+        auto = node.attr_s("auto_pad", "NOTSET")
+        if auto in ("SAME_UPPER", "SAME_LOWER"):
+            ksz = [(w.shape[2 + i] - 1) * d[i] + 1 for i in range(spatial)]
+            pads = _auto_pads(x.shape[2:], ksz, s, auto)
+        elif auto in ("NOTSET", "", "VALID"):
+            pads = list(zip(p[:spatial], p[spatial:])) \
+                if auto != "VALID" else [(0, 0)] * spatial
+        else:
+            raise NotImplementedError(f"Conv auto_pad {auto}")
         dn = ("NCHW", "OIHW", "NCHW") if spatial == 2 else \
             (("NCW", "OIW", "NCW") if spatial == 1 else ("NCDHW", "OIDHW", "NCDHW"))
         out = jax.lax.conv_general_dilated(
@@ -155,16 +199,26 @@ def _eval_node(node: Node, env: Dict[str, Any], jnp, jax):
         hi = hi.f if hasattr(hi, "f") else hi
         return jnp.clip(x, lo, hi)
     if op in ("MaxPool", "AveragePool"):
-        if node.attr_i("ceil_mode"):
-            raise NotImplementedError("ceil_mode pooling")
-        window, strides, padding = _pool_dims(node, x.ndim)
+        window, strides, padding, ceil_extra = _pool_dims(node, x.shape)
         if op == "MaxPool":
             return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window,
                                          strides, padding)
         summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides,
                                        padding)
         if node.attr_i("count_include_pad"):
-            denom = float(np.prod(window))
+            if any(ceil_extra):
+                # divisor counts real+declared-pad cells only — a ones array
+                # padded 1 over the declared pads, 0 over the ceil extension
+                ones = jnp.ones(x.shape[2:], x.dtype)[None, None]
+                decl = ((0, 0), (0, 0)) + tuple(
+                    (pl, pr - e) for (pl, pr), e
+                    in zip(padding[2:], ceil_extra))
+                ones = jnp.pad(ones, decl, constant_values=1.0)
+                ext = ((0, 0), (0, 0)) + tuple((0, e) for e in ceil_extra)
+                denom = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                              strides, ext)
+            else:
+                denom = float(np.prod(window))
         else:  # divide by the number of REAL elements under each window
             ones = jnp.ones(x.shape[2:], x.dtype)[None, None]
             denom = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
@@ -235,8 +289,101 @@ def _eval_node(node: Node, env: Dict[str, Any], jnp, jax):
         return x.mean(axis=tuple(axes) if axes else None, keepdims=keep)
     if op == "LSTM":
         return _lstm(node, ins, jnp, jax)
+    if op in ("TreeEnsembleRegressor", "TreeEnsembleClassifier"):
+        return _tree_ensemble(node, x, jnp)
     raise NotImplementedError(f"ONNX op {op} not supported "
                               f"(node {node.name or node.outputs})")
+
+
+def _tree_ensemble(node: Node, X, jnp):
+    """ai.onnx.ml TreeEnsemble{Regressor,Classifier} — the parallel-array
+    tree walk as a fixed-depth vectorized gather chase (same pattern as the
+    GBDT booster's own walker, so it jits onto the VPU).  Supports
+    BRANCH_LEQ / BRANCH_EQ / LEAF (the modes ``onnx_export.export_gbdt``
+    emits); BRANCH_EQ compares exactly (export code values are integral).
+    Classifier returns (label, scores-raw) with post_transform NONE."""
+    a = node.attrs
+    pt = node.attr_s("post_transform", "NONE")
+    if pt not in ("", "NONE"):
+        raise NotImplementedError(
+            f"TreeEnsemble post_transform {pt!r}: raw margins only — apply "
+            f"the link downstream (export_gbdt emits NONE)")
+    tre = node.attr_ints("nodes_treeids")
+    nid = node.attr_ints("nodes_nodeids")
+    n_nodes = len(tre)
+    modes = [s.decode() if isinstance(s, bytes) else s
+             for s in a["nodes_modes"].strings]
+    bad = set(modes) - {"LEAF", "BRANCH_LEQ", "BRANCH_EQ"}
+    if bad:
+        raise NotImplementedError(f"TreeEnsemble node modes {sorted(bad)}")
+    feat = np.asarray(node.attr_ints("nodes_featureids"), np.int32)
+    vals = np.asarray(list(a["nodes_values"].floats), np.float32)
+    track = np.asarray(node.attr_ints(
+        "nodes_missing_value_tracks_true", [0] * n_nodes), bool)
+    pos = {(int(t), int(n)): i for i, (t, n) in enumerate(zip(tre, nid))}
+    tin = node.attr_ints("nodes_truenodeids")
+    fin = node.attr_ints("nodes_falsenodeids")
+    is_leaf = np.asarray([m == "LEAF" for m in modes])
+    is_leq = np.asarray([m == "BRANCH_LEQ" for m in modes])
+    tchild = np.asarray([i if is_leaf[i] else pos[(int(tre[i]), int(tin[i]))]
+                         for i in range(n_nodes)], np.int32)
+    fchild = np.asarray([i if is_leaf[i] else pos[(int(tre[i]), int(fin[i]))]
+                         for i in range(n_nodes)], np.int32)
+    roots = np.asarray([pos[(int(t), 0)] for t in sorted(set(tre))], np.int32)
+
+    # depth bound: host DFS with memo over the (acyclic) child graph
+    depth = {}
+    for r in range(n_nodes):
+        stack = [r]
+        while stack:
+            i = stack[-1]
+            if i in depth:
+                stack.pop()
+                continue
+            if is_leaf[i]:
+                depth[i] = 1
+                stack.pop()
+                continue
+            kids = [int(tchild[i]), int(fchild[i])]
+            missing = [k for k in kids if k not in depth]
+            if missing:
+                stack.extend(missing)
+            else:
+                depth[i] = 1 + max(depth[k] for k in kids)
+                stack.pop()
+    D = max((depth[int(r)] for r in roots), default=1)
+
+    prefix = "class" if node.op_type.endswith("Classifier") else "target"
+    w_tre = node.attr_ints(f"{prefix}_treeids")
+    w_nid = node.attr_ints(f"{prefix}_nodeids")
+    w_ids = node.attr_ints(f"{prefix}_ids")
+    w_val = list(a[f"{prefix}_weights"].floats)
+    K = (max(w_ids) + 1) if w_ids else 1
+    W = np.zeros((n_nodes, K), np.float32)
+    for t_, n_, c_, v_ in zip(w_tre, w_nid, w_ids, w_val):
+        W[pos[(int(t_), int(n_))], c_] += v_
+    base = np.asarray(list(a["base_values"].floats), np.float32) \
+        if "base_values" in a else np.zeros(K, np.float32)
+
+    Xd = jnp.asarray(X, jnp.float32)
+    n = Xd.shape[0]
+    cur = jnp.broadcast_to(jnp.asarray(roots)[None, :], (n, len(roots)))
+    feat_d, vals_d = jnp.asarray(feat), jnp.asarray(vals)
+    t_d, f_d = jnp.asarray(tchild), jnp.asarray(fchild)
+    leq_d, track_d = jnp.asarray(is_leq), jnp.asarray(track)
+    for _ in range(D):
+        xv = Xd[jnp.arange(n)[:, None], feat_d[cur]]
+        v = vals_d[cur]
+        go_true = jnp.where(leq_d[cur],
+                            jnp.where(jnp.isnan(xv), track_d[cur], xv <= v),
+                            xv == v)
+        cur = jnp.where(go_true, t_d[cur], f_d[cur])  # leaves self-loop
+    scores = jnp.asarray(W)[cur].sum(axis=1) + jnp.asarray(base)
+    if prefix == "target":
+        return scores
+    label = jnp.argmax(scores, axis=1) if K > 1 \
+        else (scores[:, 0] > 0).astype(jnp.int32)
+    return (label, scores)
 
 
 def _lstm(node: Node, ins, jnp, jax):

@@ -329,6 +329,10 @@ def encode_attr(name: str, value) -> bytes:
     elif isinstance(value, (list, tuple)) and value and isinstance(value[0], float):
         out += b"".join(_key(7, 5) + struct.pack("<f", f) for f in value)
         out += _key(20, 0) + _varint(6)
+    elif isinstance(value, (list, tuple)) and value \
+            and isinstance(value[0], (str, bytes)):
+        out += b"".join(_str_field(9, s) for s in value)
+        out += _key(20, 0) + _varint(8)
     elif isinstance(value, (list, tuple)):
         out += b"".join(_key(8, 0) + _varint(int(i)) for i in value)
         out += _key(20, 0) + _varint(7)
