@@ -140,8 +140,15 @@ class _VectorExplainer(_LocalExplainerBase):
         bg = self.get("background_data")
         if bg is None:
             return np.zeros((1, d))
-        col = bg.collect()[self.get_or_fail("input_col")]
-        return stack_vector_column(col)
+        data = bg.collect()
+        in_col = self.get_or_fail("input_col")
+        cols = self.get("input_cols") if "input_cols" in self._params else None
+        if in_col not in data and cols:
+            # tabular mode: the vector column is derived; assemble the
+            # background from the raw tabular columns instead
+            return np.column_stack([np.asarray(data[c], np.float64)
+                                    for c in cols])
+        return stack_vector_column(data[in_col])
 
     def _make_samples(self, instance, rng, n):
         x = np.asarray(instance, np.float64)
@@ -166,6 +173,18 @@ class VectorSHAP(_VectorExplainer):
 class TabularLIME(_VectorExplainer):
     kind = "lime"
     input_cols = Param("input_cols", "tabular columns to perturb", "list")
+
+    def transform_schema(self, schema):
+        cols = self.get("input_cols")
+        if cols:  # input_col is DERIVED from the tabular columns in
+            # _transform; require those instead (reference TabularLIME takes
+            # inputCols and assembles internally)
+            for c in cols:
+                schema.require(c)
+            from ..core.schema import ColumnType
+            return schema.add(self.get_or_fail("output_col"),
+                              ColumnType.VECTOR)
+        return super().transform_schema(schema)
 
     def _transform(self, df):
         cols = self.get("input_cols")

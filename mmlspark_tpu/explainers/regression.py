@@ -15,19 +15,24 @@ import numpy as np
 def weighted_least_squares(X: np.ndarray, y: np.ndarray, w: np.ndarray,
                            fit_intercept: bool = True,
                            ridge: float = 1e-6) -> Tuple[np.ndarray, float]:
-    import jax.numpy as jnp
-    X = jnp.asarray(X, jnp.float64)
-    y = jnp.asarray(y, jnp.float64)
-    w = jnp.asarray(w, jnp.float64)
+    """Host float64 normal equations: the SHAP kernel's 1e6 endpoint
+    weights make the system ill-conditioned beyond float32 (jax truncates
+    float64 by default), and the per-row solve is d<=dozens — too small for
+    the device to matter."""
+    X = np.asarray(X, np.float64)
+    y = np.asarray(y, np.float64)
+    w = np.asarray(w, np.float64)
     if fit_intercept:
-        X1 = jnp.concatenate([X, jnp.ones((X.shape[0], 1))], axis=1)
+        X1 = np.concatenate([X, np.ones((X.shape[0], 1))], axis=1)
     else:
         X1 = X
     WX = X1 * w[:, None]
-    A = X1.T @ WX + ridge * jnp.eye(X1.shape[1])
+    A = X1.T @ WX + ridge * np.eye(X1.shape[1])
     b = WX.T @ y
-    beta = jnp.linalg.solve(A, b)
-    beta = np.asarray(beta)
+    try:
+        beta = np.linalg.solve(A, b)
+    except np.linalg.LinAlgError:
+        beta = np.linalg.lstsq(A, b, rcond=None)[0]
     if fit_intercept:
         return beta[:-1], float(beta[-1])
     return beta, 0.0
@@ -40,9 +45,11 @@ def lasso_regression(X: np.ndarray, y: np.ndarray, w: np.ndarray,
     import jax
     import jax.numpy as jnp
 
-    Xj = jnp.asarray(X, jnp.float64)
-    yj = jnp.asarray(y, jnp.float64)
-    wj = jnp.asarray(w, jnp.float64)
+    # explicit float32: jax truncates float64 by default, and the ISTA
+    # iteration is robust at single precision (unlike the WLS solve above)
+    Xj = jnp.asarray(X, jnp.float32)
+    yj = jnp.asarray(y, jnp.float32)
+    wj = jnp.asarray(w, jnp.float32)
     wj = wj / jnp.maximum(wj.sum(), 1e-12)
     n, d = Xj.shape
 
