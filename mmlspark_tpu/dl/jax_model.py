@@ -130,9 +130,23 @@ class JaxModel(Model, HasInputCol, HasOutputCol):
             n_dev = mesh.devices.size
             pure = payload.pure_apply
             if n_dev > 1 and padded_n % n_dev == 0:
-                fn = jax.jit(pure,
-                             in_shardings=(replicated(mesh), batch_sharded(mesh)),
-                             out_shardings=replicated(mesh))
+                sharded = jax.jit(pure,
+                                  in_shardings=(replicated(mesh),
+                                                batch_sharded(mesh)),
+                                  out_shardings=replicated(mesh))
+                if jax.process_count() > 1:
+                    # multi-host: jit refuses host-local numpy for
+                    # non-replicated shardings; every process holds the SAME
+                    # batch (executor model: identical partition per call),
+                    # so stage it as a global array explicitly
+                    bsh = batch_sharded(mesh)
+
+                    def fn(variables, chunk, _inner=sharded, _s=bsh):
+                        garr = jax.make_array_from_callback(
+                            chunk.shape, _s, lambda idx: chunk[idx])
+                        return _inner(variables, garr)
+                else:
+                    fn = sharded
             else:
                 fn = jax.jit(pure)
             self._jit_cache[key] = fn

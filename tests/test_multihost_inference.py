@@ -1,0 +1,131 @@
+"""Multi-host INFERENCE coverage (VERDICT r2 #9): the 2-process executor
+story must cover scoring, not just training.
+
+- ``JaxModel`` batch-sharded inference over a real cross-process mesh: the
+  batch dimension splits across process boundaries, outputs replicate, and
+  every host holds the full (identical, correct) result — the reference's
+  executor-side ``CNTKModel.score`` spread over workers.
+- Distributed serving round trip across processes: the topology driver and
+  a device-backed worker live in process 0, a ``RoutingClient`` in process 1
+  scores through the registry over real sockets (reference
+  ``HTTPSourceStateHolder`` worker registration + routed serving).
+"""
+import numpy as np
+import pytest
+
+
+def _jaxmodel_job(mesh, process_id):
+    import numpy as np
+    from mmlspark_tpu.core import DataFrame
+    from mmlspark_tpu.core.schema import vector_column
+    from mmlspark_tpu.dl import JaxModel
+    from mmlspark_tpu.parallel import active_mesh
+
+    rng = np.random.default_rng(0)           # identical on every process
+    X = rng.normal(size=(64, 8)).astype(np.float32)
+    W = rng.normal(size=(8, 3)).astype(np.float32)
+
+    def apply_fn(variables, batch):
+        import jax.numpy as jnp
+        return jnp.tanh(batch @ variables["w"])
+
+    jm = JaxModel()
+    jm.set_model(apply_fn=apply_fn, variables={"w": W})
+    jm.set_params(input_col="features", output_col="out", batch_size=64)
+    df = DataFrame.from_dict({"features": vector_column(list(X))})
+    with active_mesh(mesh):
+        out = jm.transform(df).collect()["out"]
+    got = np.stack([np.asarray(v, np.float32) for v in out])
+    want = np.tanh(X @ W)
+    return (float(np.abs(got - want).max()), got[:2].tolist())
+
+
+@pytest.mark.slow
+def test_jaxmodel_sharded_inference_two_process():
+    from mmlspark_tpu.parallel.executor import run_local_cluster
+    try:
+        results = run_local_cluster(_jaxmodel_job, num_processes=2,
+                                    devices_per_process=2, timeout_s=240)
+    except RuntimeError as e:
+        if "Unable to initialize backend" in str(e):
+            pytest.skip(f"jax.distributed unavailable: {e}")
+        raise
+    (err0, head0), (err1, head1) = results
+    assert err0 < 1e-5 and err1 < 1e-5  # both hosts hold the full result
+    np.testing.assert_allclose(head0, head1, rtol=1e-6)
+
+
+_PORT = 19377  # fixed so process 1 can find the driver without coordination
+
+
+def _serving_job(mesh, process_id):
+    import time
+
+    import numpy as np
+    from jax.experimental import multihost_utils as mhu
+    from mmlspark_tpu.core import DataFrame, Transformer
+    from mmlspark_tpu.serving import (RoutingClient, TopologyService,
+                                      WorkerServer)
+
+    class DeviceScorer(Transformer):
+        """reply = sum(tanh(x * w)) computed on device via jit."""
+
+        def _transform(self, df):
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def score(v):
+                return jnp.tanh(v * jnp.arange(1.0, 4.0)).sum()
+
+            def per_part(p):
+                vals = np.asarray([float(score(float(v)))
+                                   for v in p["request"]], float)
+                return {**p, "reply": vals}
+            return df.map_partitions(per_part)
+
+        def transform_schema(self, schema):
+            return schema
+
+    if process_id == 0:
+        svc = TopologyService(port=_PORT).start()
+        worker = WorkerServer(DeviceScorer(), server_id="w0",
+                              driver_address=svc.address, port=0).start()
+        mhu.sync_global_devices("serving_ready")       # client may now go
+        mhu.sync_global_devices("serving_done")        # hold until scored
+        worker.stop()
+        svc.stop()
+        return "served"
+    mhu.sync_global_devices("serving_ready")
+    client = RoutingClient(f"http://127.0.0.1:{_PORT}")
+    deadline = time.time() + 30
+    last = None
+    replies = []
+    for x in (0.5, 1.5, 2.5):
+        while time.time() < deadline:
+            try:
+                replies.append(float(client.request(x)))
+                break
+            except Exception as e:  # noqa: BLE001 — worker may still be booting
+                last = e
+                time.sleep(0.5)
+        else:
+            raise RuntimeError(f"no reply: {last}")
+    mhu.sync_global_devices("serving_done")
+    return replies
+
+
+@pytest.mark.slow
+def test_distributed_serving_cross_process_round_trip():
+    from mmlspark_tpu.parallel.executor import run_local_cluster
+    try:
+        results = run_local_cluster(_serving_job, num_processes=2,
+                                    devices_per_process=1, timeout_s=240)
+    except RuntimeError as e:
+        if "Unable to initialize backend" in str(e):
+            pytest.skip(f"jax.distributed unavailable: {e}")
+        raise
+    assert results[0] == "served"
+    want = [float(np.tanh(x * np.arange(1.0, 4.0)).sum())
+            for x in (0.5, 1.5, 2.5)]
+    np.testing.assert_allclose(results[1], want, rtol=1e-5)
