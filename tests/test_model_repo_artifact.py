@@ -1,0 +1,75 @@
+"""The committed pretrained-model artifact loads and scores.
+
+Reference: ``downloader/ModelDownloader.scala:112`` — a repository of
+pretrained models with JSON schema, fetched into a local cache.  The rebuild
+commits a REAL trained checkpoint (``artifacts/model_repo/DigitsMLP``: an
+MLP trained by ``tools/train_zoo_checkpoint.py`` on the UCI handwritten
+digits shipped in scikit-learn, exported to ONNX).  These tests prove the
+repo/schema layer is demonstrably loadable from a local artifact dir and
+that the committed weights reproduce their pinned held-out accuracy —
+random-init weights score ~0.1 here, so this cannot pass by accident.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPO_DIR = os.path.join(ROOT, "artifacts", "model_repo")
+
+
+def _digits_split():
+    sklearn = pytest.importorskip("sklearn")
+    from sklearn.datasets import load_digits
+    d = load_digits()
+    X = (d.data / 16.0).astype(np.float32)
+    y = d.target.astype(np.int32)
+    rng = np.random.default_rng(0)          # the training script's split
+    order = rng.permutation(len(y))
+    cut = int(len(y) * 0.85)
+    return X[order[cut:]], y[order[cut:]]
+
+
+def test_repo_lists_schema_and_loads_payload():
+    from mmlspark_tpu.dl.model_downloader import ModelRepo
+    repo = ModelRepo(REPO_DIR)
+    schemas = {s.name: s for s in repo.list_models()}
+    assert "DigitsMLP" in schemas
+    s = schemas["DigitsMLP"]
+    assert s.model_type == "onnx" and s.input_shape == [64]
+    payload = repo.load_model("DigitsMLP")
+    out = np.asarray(payload.apply(np.zeros((2, 64), np.float32)))
+    assert out.shape == (2, 10)
+
+
+def test_committed_checkpoint_reproduces_pinned_accuracy():
+    from mmlspark_tpu.dl.model_downloader import ModelDownloader
+    with open(os.path.join(REPO_DIR, "DigitsMLP", "eval.json")) as f:
+        pinned = json.load(f)
+    Xte, yte = _digits_split()
+    payload = ModelDownloader(local_cache=REPO_DIR) \
+        .download_by_name("DigitsMLP")
+    logits = np.asarray(payload.apply(Xte))
+    acc = float((logits.argmax(1) == yte).mean())
+    assert acc > 0.95, acc
+    # small tolerance: the ONNX Gemm graph and the flax apply differ in
+    # summation order at float32
+    assert abs(acc - pinned["held_out_accuracy"]) < 0.01, (acc, pinned)
+
+
+def test_committed_checkpoint_drives_jax_model_transformer():
+    from mmlspark_tpu.core import DataFrame
+    from mmlspark_tpu.core.schema import vector_column
+    from mmlspark_tpu.dl import JaxModel
+    from mmlspark_tpu.dl.model_downloader import ModelDownloader
+    Xte, yte = _digits_split()
+    payload = ModelDownloader(local_cache=REPO_DIR) \
+        .download_by_name("DigitsMLP")
+    jm = JaxModel()
+    jm.set("model", payload)
+    jm.set_params(input_col="features", output_col="logits", batch_size=128)
+    df = DataFrame.from_dict({"features": vector_column(list(Xte))})
+    out = jm.transform(df).collect()["logits"]
+    pred = np.asarray([np.argmax(v) for v in out])
+    assert float((pred == yte).mean()) > 0.95
