@@ -1,0 +1,104 @@
+"""On-chip histogram-backend shootout (VERDICT r2 "do this" #1 tail).
+
+Times one depth-5 binary-objective boosting iteration END TO END per
+backend (scatter / matmul / pallas) at the bench shape (1M x 200, 255
+bins) on whatever platform jax resolves (run WITHOUT platform overrides to
+hit the TPU), plus the raw ``hist_ops.build`` kernel at level widths.
+
+Relay-safe: single process, no external kills expected — run it detached
+(`nohup python tools/hist_backend_probe.py > probe.log 2>&1 &`) and read
+the log; every result prints as its own line immediately.
+"""
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main():
+    from __graft_entry__ import enable_compilation_cache
+    enable_compilation_cache()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    print(f"devices: {jax.devices()}", flush=True)
+    t0 = time.perf_counter()
+    x = jnp.ones((256, 256))
+    float((x @ x).sum())
+    print(f"health ok ({time.perf_counter() - t0:.1f}s)", flush=True)
+
+    from mmlspark_tpu.lightgbm import GBDTParams, train
+    from mmlspark_tpu.ops import histogram as hist_ops
+
+    n, f, B = 1_000_000, 200, 255
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1]
+         + rng.normal(scale=0.3, size=n) > 0).astype(np.float32)
+
+    # raw kernel probe: one frontier build at level widths 1 and 16
+    binned = jnp.asarray(rng.integers(0, B, size=(n, f), dtype=np.uint8))
+    g = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    h = jnp.ones((n,), jnp.float32)
+    for backend in ("scatter", "matmul", "pallas"):
+        for nodes in (1, 16):
+            node = jnp.asarray(rng.integers(0, nodes, size=n,
+                                            dtype=np.int32))
+            try:
+                t0 = time.perf_counter()
+                out = hist_ops.build(binned, g, h, node, nodes, B,
+                                     backend=backend)
+                float(out.sum())
+                compile_s = time.perf_counter() - t0
+                reps = 4
+                t0 = time.perf_counter()
+                acc = 0.0
+                for i in range(reps):
+                    out = hist_ops.build(binned, g + i, h, node, nodes, B,
+                                         backend=backend)
+                    acc += float(out[0, 0, 0, 2])
+                dt = (time.perf_counter() - t0) / reps
+                print(json.dumps({"probe": "raw", "backend": backend,
+                                  "nodes": nodes,
+                                  "compile_s": round(compile_s, 1),
+                                  "build_ms": round(1000 * dt, 2)}),
+                      flush=True)
+            except Exception as e:  # noqa: BLE001 — e.g. pallas lowering
+                print(json.dumps({"probe": "raw", "backend": backend,
+                                  "nodes": nodes,
+                                  "error": f"{type(e).__name__}: {e}"[:300]}),
+                      flush=True)
+
+    # end-to-end: marginal boosting rate per backend (bench.py formula)
+    for backend in ("matmul", "scatter", "pallas"):
+        os.environ["MMLSPARK_TPU_HIST_BACKEND"] = backend
+        try:
+            t0 = time.perf_counter()
+            train(X, y, GBDTParams(num_iterations=1, objective="binary",
+                                   max_depth=5))
+            warm = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            train(X, y, GBDTParams(num_iterations=2, objective="binary",
+                                   max_depth=5))
+            t_a = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            train(X, y, GBDTParams(num_iterations=12, objective="binary",
+                                   max_depth=5))
+            t_b = time.perf_counter() - t0
+            rps = n * 10 / max(t_b - t_a, 1e-9)
+            print(json.dumps({"probe": "train", "backend": backend,
+                              "warm_s": round(warm, 1),
+                              "rows_per_sec": round(rps)}), flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({"probe": "train", "backend": backend,
+                              "error": f"{type(e).__name__}: {e}"[:300]}),
+                  flush=True)
+    print("PROBE_DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()
