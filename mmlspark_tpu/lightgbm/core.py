@@ -1121,6 +1121,17 @@ def default_metric(objective: str) -> str:
 # training driver
 # ---------------------------------------------------------------------------
 
+def _resolve_hist_backend() -> str:
+    """The histogram backend (and MXU block size) the growers will trace
+    with.  Resolved ONCE per train() call and made part of every jit cache
+    key: the env overrides are read at trace time, so without keying on them
+    a cached program would silently keep serving a previously-selected
+    configuration."""
+    import os
+    return (os.environ.get("MMLSPARK_TPU_HIST_BACKEND", "auto"),
+            os.environ.get("MMLSPARK_TPU_HIST_BLOCK_ROWS", ""))
+
+
 def _make_grower(p: GBDTParams, F: int, B: int, axis_name: str = None,
                  backend: str = "auto"):
     """Growth-mode dispatch (call with resolved params)."""
@@ -1197,7 +1208,9 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
                 sub.append(int(f_i))
         p = dataclasses.replace(p, cat_subset=tuple(sub))
 
-    sig = _params_sig(p)
+    hist_cfg = _resolve_hist_backend()
+    hist_backend = hist_cfg[0]
+    sig = _params_sig(p) + (hist_cfg,)
     if shard_rows:
         from jax.sharding import PartitionSpec as P
         from ..parallel import get_active_mesh, batch_sharded
@@ -1216,7 +1229,8 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
 
         # explicit SPMD: each shard builds local histograms, psum over ICI
         def _build_sharded():
-            grow_raw = _make_grower(p, F, B, axis_name=AXIS_DATA)
+            grow_raw = _make_grower(p, F, B, axis_name=AXIS_DATA,
+                                    backend=hist_backend)
             return jax.jit(jax.shard_map(
                 grow_raw, mesh=mesh,
                 in_specs=(P(AXIS_DATA), P(AXIS_DATA), P(AXIS_DATA), P(AXIS_DATA),
@@ -1226,7 +1240,8 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
     else:
         binned = jnp.asarray(binned_np)
         grower = _cached(("grower", sig, F),
-                         lambda: jax.jit(_make_grower(p, F, B)))
+                         lambda: jax.jit(_make_grower(p, F, B,
+                                                      backend=hist_backend)))
     objective = make_objective(p)
     D = p.depth_bound                 # static walk bound during training
     L = p.num_leaves                  # leaf slots (level-wise: 2^max_depth)
@@ -1313,7 +1328,8 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
     # tree grows + score updates in ONE jitted XLA program — eager per-op
     # dispatch through the device relay costs ~10-100 ms per op, which
     # dominated the loop before fusion.
-    grow_fn = None if shard_rows else _make_grower(p, F, B)
+    grow_fn = None if shard_rows else _make_grower(p, F, B,
+                                                   backend=hist_backend)
     shrink_const = 1.0 if p.boosting_type == "rf" else p.learning_rate
     is_goss = p.boosting_type == "goss"
     a_n = int(p.top_rate * n) if is_goss else 0

@@ -253,13 +253,19 @@ def build(binned, grad, hess, node_ids, num_nodes, num_bins,
         # not request a specific backend (ADVICE r2)
     if backend == "auto":
         backend = "scatter" if jax.default_backend() == "cpu" else "matmul"
+    # MXU block size knob for on-chip tuning (read at trace time; train()
+    # keys its jit caches on it)
+    block_rows = int(os.environ.get("MMLSPARK_TPU_HIST_BLOCK_ROWS", "0")) or None
     if backend == "pallas":
         from .pallas_histogram import build_histograms_pallas
+        kw = {"block_rows": block_rows} if block_rows else {}
         return build_histograms_pallas(
             binned, grad, hess, node_ids, num_nodes, num_bins, sample_weight,
-            interpret=jax.default_backend() == "cpu")
+            interpret=jax.default_backend() == "cpu", **kw)
     if backend == "matmul":
+        kw = {"block_rows": block_rows} if block_rows else {}
         return build_histograms_matmul(binned, grad, hess, node_ids,
-                                       num_nodes, num_bins, sample_weight)
+                                       num_nodes, num_bins, sample_weight,
+                                       **kw)
     return build_histograms(binned, grad, hess, node_ids, num_nodes, num_bins,
                             sample_weight)

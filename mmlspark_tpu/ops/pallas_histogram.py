@@ -12,18 +12,29 @@ construction, weight channel broadcast, MXU contraction, and accumulation —
 in VMEM.  Layout mirrors the matmul backend (shared ``_node_pure_layout``):
 
 - rows sorted by node, padded so each R-row block is node-pure;
-- grid = one step per block, sequential on TPU;
+- grid = (feature-block OUTER, row-block INNER), sequential on TPU; every
+  index the kernel body touches is STATIC — Mosaic TC lowering has no
+  dynamic_slice, so the feature dimension lives in the grid (BlockSpec
+  index maps) and the FB features inside a block unroll as a python loop
+  (first Mosaic attempt used a ``fori_loop`` + ``lo[:, f]`` and failed to
+  lower on exactly that);
 - the OUTPUT BlockSpec's index map routes each step to its node's histogram
-  buffer via a scalar-prefetched ``node_blk`` array; consecutive blocks of
-  the same node hit the same VMEM-resident buffer (Pallas only writes back
-  on index change), and ``pl.when(first-visit)`` zero-initialises it;
-- inside, a ``fori_loop`` over features issues (5*HI, R) x (R, 16) MXU dots
-  in bf16 with f32 accumulation (the bf16x2 residual channels keep grad/hess
-  exact to ~f32).
+  buffer via a scalar-prefetched ``node_blk`` array, and
+  ``pl.when(first-block-of-node)`` zero-initialises each buffer.  The grid
+  order keeps every output buffer's visits CONSECUTIVE (all of a node's row
+  blocks inside one feature sweep) — Mosaic's reload of a non-consecutively
+  revisited output block is undefined (observed: duplicated accumulation);
+- per feature, a (5*HI, R) x (R, 16) MXU dot in bf16 with f32 accumulation
+  (the bf16x2 residual channels keep grad/hess exact to ~f32).
 
-Numerics are identical to the matmul backend by construction.  On CPU the
-kernel runs under ``interpret=True`` (pure-jax semantics) for tests; real
-Mosaic lowering is exercised on the TPU platform.
+Numerics: exact count/hess channels on-chip; the grad channel lands within
+~1%% of the f32 scatter truth under real Mosaic lowering (interpret mode is
+exact to ~1e-4 — the residual deviation is a Mosaic-side rounding of the
+channel pipeline, measured in bench_attempts/, and does not move split
+decisions: the pallas-trained booster passes the same held-out accuracy
+gates).  On CPU the kernel runs under ``interpret=True`` (pure-jax
+semantics) for tests; real Mosaic lowering is exercised on the TPU platform
+(tools/hist_backend_probe).
 """
 from __future__ import annotations
 
@@ -59,11 +70,22 @@ def build_histograms_pallas(binned: jnp.ndarray, grad: jnp.ndarray,
 
     bb_all, w5, node_blk, NB = _node_pure_layout(binned, grad, hess, node_ids,
                                                  P, R, sample_weight)
-    bb_blocks = bb_all.reshape(NB, R, F)
+    FB = 8                                            # features per grid step
+    F_pad = ((F + FB - 1) // FB) * FB
+    FM = F_pad // FB
+    # (NB, F_pad, R): BlockSpec slices FB whole feature COLUMNS per step, so
+    # in-kernel feature indexing is a static python unroll
+    bb_fmajor = jnp.transpose(bb_all.reshape(NB, R, F), (0, 2, 1))
+    if F_pad != F:
+        bb_fmajor = jnp.pad(bb_fmajor, ((0, 0), (0, F_pad - F), (0, 0)))
     w_blocks = jnp.moveaxis(w5.reshape(5, NB, R), 1, 0)   # (NB, 5, R)
 
     def kernel(nb_ref, bb_ref, w_ref, out_ref):
-        i = pl.program_id(0)
+        # grid = (feature-block j OUTER, row-block i INNER): within one
+        # j-sweep a node's output buffer is visited by CONSECUTIVE steps
+        # only — Mosaic revisit semantics for non-consecutive output blocks
+        # are undefined (observed: duplicated accumulation at 1M rows)
+        i = pl.program_id(1)
         prev = nb_ref[jnp.maximum(i - 1, 0)]
         first = (i == 0) | (nb_ref[i] != prev)
 
@@ -71,47 +93,46 @@ def build_histograms_pallas(binned: jnp.ndarray, grad: jnp.ndarray,
         def _init():
             out_ref[...] = jnp.zeros(out_ref.shape, out_ref.dtype)
 
-        b32 = bb_ref[0].astype(jnp.int32)             # (R, F)
-        w = w_ref[0].astype(jnp.bfloat16)             # (5, R)
-        hi = b32 >> 4
-        lo = b32 & 15
-        lo_iota = jnp.arange(LO, dtype=jnp.int32)
-        hi_iota = jnp.arange(HI, dtype=jnp.int32)
-
-        def per_feature(f, carry):
-            onehot_lo = (lo[:, f][:, None] == lo_iota).astype(jnp.bfloat16)
-            onehot_hi = (hi[:, f][:, None] == hi_iota).astype(jnp.bfloat16)
+        w32 = w_ref[0]                                # (5, R) f32
+        # 2-D iotas: Mosaic rejects 1-D iota
+        lo_iota = jax.lax.broadcasted_iota(jnp.int32, (R, LO), 1)
+        hi_iota = jax.lax.broadcasted_iota(jnp.int32, (R, HI), 1)
+        for fl in range(FB):                          # static unroll
+            b32 = bb_ref[0, fl].astype(jnp.int32)     # (R,)
+            onehot_lo = ((b32 & 15)[:, None] == lo_iota).astype(jnp.bfloat16)
+            onehot_hi = ((b32 >> 4)[:, None] == hi_iota).astype(jnp.float32)
             # channel-weighted hi one-hots on the MXU M axis, (5, HI) order
-            # matching the matmul backend's channel flattening;
+            # matching the matmul backend's channel flattening; the
+            # broadcast-multiply runs in f32 (Mosaic only lowers minor-dim
+            # insertion for 32-bit types), the MXU dot takes bf16:
             # (5*HI, R) x (R, 16) -> (5*HI, 16) f32
-            a = jnp.transpose(w[:, :, None] * onehot_hi[None, :, :],
-                              (0, 2, 1)).reshape(5 * HI, R)
+            a = jnp.transpose(w32[:, :, None] * onehot_hi[None, :, :],
+                              (0, 2, 1)).reshape(5 * HI, R) \
+                .astype(jnp.bfloat16)
             blk = jax.lax.dot(a, onehot_lo,
                               preferred_element_type=jnp.float32)
-            out_ref[0, f] = out_ref[0, f] + blk
-            return carry
-
-        jax.lax.fori_loop(0, F, per_feature, 0)
+            out_ref[0, fl] = out_ref[0, fl] + blk
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,                         # node_blk
-        grid=(NB,),
+        grid=(FM, NB),                                 # j outer, i inner
         in_specs=[
-            pl.BlockSpec((1, R, F), lambda i, nb: (i, 0, 0)),
-            pl.BlockSpec((1, 5, R), lambda i, nb: (i, 0, 0)),
+            pl.BlockSpec((1, FB, R), lambda j, i, nb: (i, j, 0)),
+            pl.BlockSpec((1, 5, R), lambda j, i, nb: (i, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, F, 5 * HI, LO),
-                               lambda i, nb: (nb[i], 0, 0, 0)),
+        out_specs=pl.BlockSpec((1, FB, 5 * HI, LO),
+                               lambda j, i, nb: (nb[i], j, 0, 0)),
     )
 
     acc = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((P + 1, F, 5 * HI, LO), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((P + 1, F_pad, 5 * HI, LO),
+                                       jnp.float32),
         interpret=interpret,
-    )(node_blk, bb_blocks, w_blocks)
+    )(node_blk, bb_fmajor, w_blocks)
 
-    acc = acc[:P].reshape(P, F, 5, HI, LO)
+    acc = acc[:P, :F].reshape(P, F, 5, HI, LO)
     acc3 = jnp.stack([acc[:, :, 0] + acc[:, :, 1],
                       acc[:, :, 2] + acc[:, :, 3], acc[:, :, 4]], axis=0)
     hist = acc3.reshape(3, P, F, HI * LO)[..., :B]      # (3, P, F, B)
