@@ -1,0 +1,76 @@
+"""HttpOnSpark - Working with Arbitrary Web APIs.
+
+Equivalent of the reference's ``HttpOnSpark`` notebook: a column of data
+flows through HTTP calls to an external service as part of the pipeline
+(reference ``SimpleHTTPTransformer``), with error rows captured instead of
+failing the job.  The web API here is a local mock (zero-egress analogue
+of the notebook's public endpoint).
+"""
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import numpy as np
+
+from _common import setup
+
+
+class SentimentAPI(BaseHTTPRequestHandler):
+    """POST {'text': ...} -> {'sentiment': score} (toy lexicon)."""
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        body = json.loads(self.rfile.read(
+            int(self.headers.get("Content-Length", 0))).decode())
+        if self.path == "/flaky" and "bad" in body.get("text", ""):
+            self.send_response(500)
+            self.end_headers()
+            return
+        pos = sum(w in body.get("text", "") for w in ("good", "great", "love"))
+        neg = sum(w in body.get("text", "") for w in ("bad", "awful", "hate"))
+        out = json.dumps({"sentiment": pos - neg}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+
+def main():
+    setup()
+    from mmlspark_tpu.core import DataFrame
+    from mmlspark_tpu.io import SimpleHTTPTransformer
+
+    httpd = HTTPServer(("127.0.0.1", 0), SentimentAPI)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_port}"
+    try:
+        texts = ["a good great film", "an awful bad mess", "love this",
+                 "plain neutral prose"]
+        col = np.array([{"text": t} for t in texts], dtype=object)
+        df = DataFrame.from_dict({"data": col}, num_partitions=2)
+        t = SimpleHTTPTransformer(input_col="data", output_col="scored",
+                                  url=url + "/score")
+        out = t.transform(df).collect()
+        scores = [v["sentiment"] for v in out["scored"]]
+        print("sentiments:", scores)
+        assert scores == [2, -2, 1, 0]
+
+        # error rows are captured per-row, not fatal
+        t2 = SimpleHTTPTransformer(input_col="data", output_col="scored",
+                                   url=url + "/flaky")
+        out2 = t2.transform(df).collect()
+        errs = [e is not None for e in out2["errors"]]
+        print("error mask:", errs)
+        assert errs == [False, True, False, False]
+        assert out2["scored"][0]["sentiment"] == 2
+        print("HTTP-on-frame OK")
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+if __name__ == "__main__":
+    main()
