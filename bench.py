@@ -70,7 +70,7 @@ def phase_health() -> None:
     print(f"HEALTH_OK {val}", flush=True)
 
 
-def phase_gbdt(n=1_000_000, f=200, iters_a=2, iters_b=12) -> None:
+def phase_gbdt(n=1_000_000, f=200, iters_a=8, iters_b=24) -> None:
     """Marginal boosting rate: rows * (B - A) / (t_B - t_A).  Subtracts the
     shared fixed costs (compile — cached across calls since the jitted
     per-iteration program's key excludes num_iterations — binning, host->
@@ -85,7 +85,11 @@ def phase_gbdt(n=1_000_000, f=200, iters_a=2, iters_b=12) -> None:
     X = rng.normal(size=(n, f)).astype(np.float32)
     y = (X[:, 0] + 0.5 * X[:, 1] + rng.normal(scale=0.3, size=n) > 0).astype(np.float32)
     t0 = time.perf_counter()
-    train(X, y, GBDTParams(num_iterations=1, objective="binary", max_depth=5))
+    # warm at iters_a so BOTH timed runs hit the chunked program (default
+    # CH=4 engages from 2*CH iterations; 1-iteration warm would only
+    # compile the unchunked path)
+    train(X, y, GBDTParams(num_iterations=iters_a, objective="binary",
+                           max_depth=5))
     _log(f"[bench] gbdt warm(compile) {time.perf_counter() - t0:.0f}s")
     t0 = time.perf_counter()
     train(X, y, GBDTParams(num_iterations=iters_a, objective="binary", max_depth=5))

@@ -1374,10 +1374,18 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
     start_iter = len(tree_weights) // K
 
     # ---- scan-chunked multi-iteration path: CH boosting iterations per
-    # device dispatch.  Opt-in (MMLSPARK_TPU_GBDT_CHUNK=8): on a single chip
-    # the async dispatch queue already pipelines iterations (measured wash),
-    # but on multi-host meshes chunking amortizes collective launch latency.
-    CH = max(1, int(__import__("os").environ.get("MMLSPARK_TPU_GBDT_CHUNK", "1")))
+    # device dispatch.  Default ON for accelerators: round-3 v5e
+    # measurements through the device relay put CH=4 at 1.4-3.2M rows/s on
+    # 1Mx200 vs a stable 1.43M unchunked — per-iteration dispatch latency
+    # dominates when the relay is loaded and lax.scan amortizes it, never
+    # losing within noise (CH=8/16 regressed; the round-2 "measured wash"
+    # note was taken on a wedged relay).  CPU keeps CH=1: scan compile cost
+    # dominates there.  MMLSPARK_TPU_GBDT_CHUNK overrides either way.
+    _ch_env = __import__("os").environ.get("MMLSPARK_TPU_GBDT_CHUNK")
+    if _ch_env is not None:
+        CH = max(1, int(_ch_env))
+    else:
+        CH = 4 if jax.default_backend() != "cpu" else 1
     chunk_ok = (CH > 1 and not shard_rows and p.objective != "lambdarank"
                 and not p.categorical_features  # valid-walk is numerical-only
                 and p.boosting_type != "dart" and p.bagging_freq <= 1
