@@ -1,0 +1,89 @@
+"""DeepLearning - CIFAR10 Convolutional Network.
+
+Equivalent of the reference's ``DeepLearning - CIFAR10 Convolutional
+Network`` notebook: train a small convnet on CIFAR-shaped images with the
+jitted optax loop, then serve it through the JaxModel transformer for
+frame-level scoring.  Images are synthetic class-colored tiles (offline
+stand-in with the CIFAR tensor shape)."""
+import time
+
+import numpy as np
+
+from _common import setup
+
+
+def make_cifar_like(n=2048, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(0, 1, (n, 32, 32, 3)).astype(np.float32)
+    y = rng.integers(0, 4, n)
+    for i in range(n):  # each class tints one channel/half
+        c = y[i]
+        if c < 3:
+            X[i, :, :, c] += 0.8
+        else:
+            X[i, 16:, :, :] += 0.6
+    return np.clip(X, 0, 2), y.astype(np.int32)
+
+
+def main():
+    setup()
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from mmlspark_tpu.core import DataFrame
+    from mmlspark_tpu.dl import JaxModel
+
+    class ConvNet(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            for feat in (16, 32):
+                x = nn.relu(nn.Conv(feat, (3, 3), strides=(2, 2))(x))
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(64)(x))
+            return nn.Dense(4)(x)
+
+    X, y = make_cifar_like()
+    cut = int(len(y) * 0.85)
+    m = ConvNet()
+    params = m.init(jax.random.PRNGKey(0), X[:1])
+    tx = optax.adam(1e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt, xb, yb):
+        def loss(p):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                m.apply(p, xb), yb).mean()
+        l, g = jax.value_and_grad(loss)(params)
+        up, opt = tx.update(g, opt)
+        return optax.apply_updates(params, up), opt, l
+
+    t0 = time.perf_counter()
+    bs = 256
+    for epoch in range(6):
+        for s in range(0, cut, bs):
+            params, opt, l = step(params, opt, jnp.asarray(X[s:s + bs]),
+                                  jnp.asarray(y[s:s + bs]))
+    print(f"trained 6 epochs in {time.perf_counter() - t0:.1f}s, "
+          f"final loss {float(l):.3f}")
+
+    # frame-level scoring through the JaxModel transformer
+    jm = JaxModel()
+    jm.set_model(apply_fn=lambda v, b: m.apply(v, b), variables=params)
+    jm.set_params(input_col="image", output_col="logits", batch_size=256,
+                  input_shape=[32, 32, 3])
+    col = np.empty(len(X) - cut, dtype=object)
+    for i in range(len(col)):
+        col[i] = X[cut + i]
+    df = DataFrame.from_dict({"image": col})
+    out = jm.transform(df).collect()["logits"]
+    pred = np.asarray([np.argmax(v) for v in out])
+    acc = float((pred == y[cut:]).mean())
+    print(f"held-out accuracy: {acc:.3f}")
+    assert acc > 0.9, acc
+    print("CIFAR convnet OK")
+
+
+if __name__ == "__main__":
+    main()

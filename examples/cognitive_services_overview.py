@@ -1,0 +1,104 @@
+"""Cognitive Services - Overview.
+
+Equivalent of the reference's ``Cognitive Services - Overview`` notebook:
+several cognitive transformers (sentiment, key phrases, translation,
+anomaly detection) run as pipeline stages over frame columns, with
+value-or-column ServiceParams, per-row error capture and the standard
+subscription-key header plumbing.  The endpoint is a local echo mock
+(zero-egress analogue of the Azure endpoints — the transformer side,
+which is what this repo rebuilds, is identical).
+"""
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from _common import setup
+
+
+class EchoService:
+    def __init__(self):
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                body = self.rfile.read(
+                    int(self.headers.get("Content-Length", 0)))
+                outer.requests.append({"path": self.path,
+                                       "headers": dict(self.headers),
+                                       "body": body})
+                resp = json.dumps({"echo": json.loads(body or b"null"),
+                                   "path": self.path}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(resp)))
+                self.end_headers()
+                self.wfile.write(resp)
+
+        self.requests = []
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_port}"
+
+
+def main():
+    setup()
+    from mmlspark_tpu.cognitive import (DetectLastAnomaly, KeyPhraseExtractor,
+                                        TextSentiment, Translate)
+    from mmlspark_tpu.core import DataFrame
+
+    svc = EchoService()
+    try:
+        texts = np.array(["the service was excellent",
+                          "slow and unhelpful support"], dtype=object)
+        series = np.empty(2, dtype=object)
+        for i in range(2):
+            series[i] = [{"timestamp": f"2024-01-0{d+1}T00:00:00Z",
+                          "value": float(d + i)} for d in range(5)]
+        df = DataFrame.from_dict({"text": texts, "series": series})
+
+        sent = TextSentiment(output_col="sentiment")
+        sent.set("url", svc.url + "/text/analytics/v3.0/sentiment")
+        sent.set("subscription_key", "key")
+        sent.set_col("text", "text")
+
+        phrases = KeyPhraseExtractor(output_col="phrases")
+        phrases.set("url", svc.url + "/text/analytics/v3.0/keyPhrases")
+        phrases.set("subscription_key", "key")
+        phrases.set_col("text", "text")
+
+        trans = Translate(output_col="translated")
+        trans.set("url", svc.url + "/translate?api-version=3.0")
+        trans.set("subscription_key", "key")
+        trans.set_col("text", "text")
+        trans.set("to_language", ["fr"])
+
+        anom = DetectLastAnomaly(output_col="anomaly")
+        anom.set("url", svc.url + "/anomalydetector/v1.0/timeseries/last/detect")
+        anom.set("subscription_key", "key")
+        anom.set_col("series", "series")
+
+        out = df
+        for stage in (sent, phrases, trans, anom):
+            out = stage.transform(out)
+        rows = out.collect()
+        doc = rows["sentiment"][0]["echo"]["documents"][0]
+        print("sentiment request doc:", doc)
+        assert doc["text"] == texts[0]
+        assert rows["phrases"][1]["echo"]["documents"][0]["text"] == texts[1]
+        assert rows["translated"][0]["echo"] == [{"Text": texts[0]}]
+        assert rows["anomaly"][0]["echo"]["granularity"] == "daily"
+        keys = {r["headers"].get("Ocp-Apim-Subscription-Key")
+                for r in svc.requests}
+        assert keys == {"key"}
+        print(f"{len(svc.requests)} service calls, 4 stages chained OK")
+    finally:
+        svc.httpd.shutdown()
+        svc.httpd.server_close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""Regression - Auto Imports (with data cleaning).
+
+Equivalent of the reference's ``Regression - Auto Imports`` /
+``Flight Delays with DataCleaning`` notebooks: a messy mixed-type frame
+(missing numerics, string categoricals, wrong dtypes) is repaired with
+SummarizeData -> DataConversion -> CleanMissingData -> ValueIndexer, then
+TrainRegressor fits price, scored with ComputeModelStatistics.
+"""
+import numpy as np
+
+from _common import setup
+
+
+def make_autos(n=1500, seed=0):
+    rng = np.random.default_rng(seed)
+    hp = rng.uniform(48, 288, n)
+    curb = rng.uniform(1500, 4000, n)
+    mpg = rng.uniform(13, 49, n)
+    make = rng.choice(["toyota", "bmw", "mazda", "volvo"], n)
+    prestige = {"toyota": 0.0, "mazda": 0.0, "volvo": 3000.0, "bmw": 9000.0}
+    price = (80 * hp + 3.2 * curb - 120 * mpg
+             + np.array([prestige[m] for m in make])
+             + rng.normal(scale=900, size=n))
+    hp[rng.random(n) < 0.08] = np.nan          # missing horsepower
+    hp_str = np.array([f"{v:.1f}" if np.isfinite(v) else "?" for v in hp],
+                      dtype=object)            # ...and stored as strings
+    return hp_str, curb, mpg, make, price
+
+
+def main():
+    setup()
+    from mmlspark_tpu.core import DataFrame
+    from mmlspark_tpu.featurize import (CleanMissingData, DataConversion,
+                                        ValueIndexer)
+    from mmlspark_tpu.lightgbm import LightGBMRegressor
+    from mmlspark_tpu.stages import SummarizeData
+    from mmlspark_tpu.train import ComputeModelStatistics, TrainRegressor
+
+    hp_str, curb, mpg, make, price = make_autos()
+    df = DataFrame.from_dict({
+        "horsepower": hp_str, "curb_weight": curb, "city_mpg": mpg,
+        "make": np.array(make, dtype=object), "price": price},
+        num_partitions=3)
+
+    # the notebook's first move: eyeball the damage
+    summary = SummarizeData().transform(df).collect()
+    print("summary columns:", list(summary)[:6])
+
+    conv = DataConversion().set_params(cols=["horsepower"],
+                                       convert_to="double")
+    df2 = conv.transform(df)
+    assert np.isnan(np.asarray(df2.collect()["horsepower"], float)).any()
+
+    clean = CleanMissingData().set_params(input_cols=["horsepower"],
+                                          cleaning_mode="Median").fit(df2)
+    df3 = clean.transform(df2)
+    assert not np.isnan(np.asarray(df3.collect()["horsepower"], float)).any()
+
+    vi = ValueIndexer().set_params(input_col="make",
+                                   output_col="make_idx").fit(df3)
+    df4 = vi.transform(df3).drop("make")
+
+    train, test = df4.random_split([0.8, 0.2], seed=1)
+    model = TrainRegressor(
+        LightGBMRegressor().set_params(num_iterations=80, num_leaves=31),
+        label_col="price").fit(train)
+    scored = model.transform(test)
+    stats = ComputeModelStatistics().set_params(
+        label_col="price", scores_col="prediction",
+        evaluation_metric="regression").transform(scored).collect()
+    r2 = float(stats["R^2"][0])
+    print({k: round(float(v[0]), 3) for k, v in stats.items()})
+    assert r2 > 0.9, r2
+    print("auto imports regression OK")
+
+
+if __name__ == "__main__":
+    main()

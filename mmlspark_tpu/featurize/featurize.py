@@ -172,7 +172,26 @@ class DataConversion(Transformer):
                     [datetime.datetime.fromisoformat(str(v)) for v in p[c]]))
             else:
                 cast = self._CASTS[to]
-                out = out.with_column(c, lambda p, c=c, cast=cast: p[c].astype(cast))
+                out = out.with_column(
+                    c, lambda p, c=c, cast=cast: _cast_coerce(p[c], cast))
+        return out
+
+
+def _cast_coerce(col: np.ndarray, cast) -> np.ndarray:
+    """Spark cast semantics (reference DataConversion.scala): values that
+    cannot be parsed become null (NaN here), they do not fail the job —
+    '?'-style missing markers in imported CSVs rely on this."""
+    try:
+        return col.astype(cast)
+    except (ValueError, TypeError):
+        if not np.issubdtype(np.dtype(cast), np.floating):
+            raise  # int/bool have no NaN; surface the bad value
+        out = np.empty(len(col), np.dtype(cast))
+        for i, v in enumerate(col):
+            try:
+                out[i] = cast(v)
+            except (ValueError, TypeError):
+                out[i] = np.nan
         return out
 
 

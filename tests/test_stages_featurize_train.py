@@ -72,6 +72,25 @@ def test_text_featurizer_and_pagesplitter():
     assert "a b" in ng and "c" in ng
 
 
+def test_data_conversion_coerces_bad_values_to_nan():
+    """Spark cast semantics (reference DataConversion.scala): unparseable
+    strings become null, not job failures — '?' missing markers in
+    imported CSVs depend on this."""
+    from mmlspark_tpu.featurize import DataConversion
+    df = DataFrame.from_dict({"x": np.array(["1.5", "?", "3"], dtype=object),
+                              "n": np.array(["7", "8", "9"], dtype=object)})
+    out = DataConversion().set_params(cols=["x"], convert_to="double") \
+        .transform(df).collect()["x"]
+    assert out[0] == 1.5 and np.isnan(out[1]) and out[2] == 3.0
+    # integer targets have no NaN: the bad value must surface, not corrupt
+    with pytest.raises((ValueError, TypeError)):
+        DataConversion().set_params(cols=["x"], convert_to="integer") \
+            .transform(df).collect()
+    ok = DataConversion().set_params(cols=["n"], convert_to="integer") \
+        .transform(df).collect()["n"]
+    assert ok.tolist() == [7, 8, 9]
+
+
 def test_clean_missing_value_indexer_roundtrip():
     from mmlspark_tpu.featurize import CleanMissingData, ValueIndexer, IndexToValue
     df = DataFrame.from_dict({"x": np.array([1.0, np.nan, 3.0]),
