@@ -70,35 +70,59 @@ def phase_health() -> None:
     print(f"HEALTH_OK {val}", flush=True)
 
 
-def phase_gbdt(n=1_000_000, f=200, iters_a=8, iters_b=24) -> None:
-    """Marginal boosting rate: rows * (B - A) / (t_B - t_A).  Subtracts the
-    shared fixed costs (compile — cached across calls since the jitted
-    per-iteration program's key excludes num_iterations — binning, host->
-    device transfer), leaving the steady-state training rate both backends
-    are judged by.  Scores evolve every iteration, so each dispatch is a
-    distinct (computation, args) pair — no relay result caching."""
+def phase_gbdt(n=1_000_000, f=200, iters_a=8, iters_b=24, reps=3) -> None:
+    """Marginal boosting rate: rows * (B - A) / (t_B - t_A), median of
+    ``reps`` repetitions.  The marginal form subtracts the shared fixed
+    costs (compile — cached across calls since the jitted per-iteration
+    program's key excludes num_iterations — binning, host->device
+    transfer), leaving the steady-state training rate both backends are
+    judged by.
+
+    Cache-busting (round-4 finding): the device relay serves REPEATED
+    identical (computation, args) dispatches from cache without executing —
+    round 3's 3.16M rows/s outlier was exactly the 2x inflation a cached
+    A-run produces.  Every train() call here flips a fresh window of
+    labels, so init_score and the whole score trajectory differ and every
+    dispatch is a first-sight args tuple.  Median-of-reps then absorbs
+    relay-load variance (round 3 measured 1.4-3.2M for one config measured
+    once)."""
     from __graft_entry__ import enable_compilation_cache
     enable_compilation_cache()
     import numpy as np
     from mmlspark_tpu.lightgbm import GBDTParams, train
     rng = np.random.default_rng(0)
     X = rng.normal(size=(n, f)).astype(np.float32)
-    y = (X[:, 0] + 0.5 * X[:, 1] + rng.normal(scale=0.3, size=n) > 0).astype(np.float32)
+    y0 = (X[:, 0] + 0.5 * X[:, 1] + rng.normal(scale=0.3, size=n) > 0).astype(np.float32)
+    nonce = [0]
+
+    def fresh_y():
+        nonce[0] += 1
+        y = y0.copy()
+        a = (37 * nonce[0]) % (n - 64)
+        y[a:a + 64] = 1.0 - y[a:a + 64]
+        return y
+
     t0 = time.perf_counter()
     # warm at iters_a so BOTH timed runs hit the chunked program (default
-    # CH=4 engages from 2*CH iterations; 1-iteration warm would only
+    # CH engages from 2*CH iterations; 1-iteration warm would only
     # compile the unchunked path)
-    train(X, y, GBDTParams(num_iterations=iters_a, objective="binary",
-                           max_depth=5))
+    train(X, fresh_y(), GBDTParams(num_iterations=iters_a, objective="binary",
+                                   max_depth=5))
     _log(f"[bench] gbdt warm(compile) {time.perf_counter() - t0:.0f}s")
-    t0 = time.perf_counter()
-    train(X, y, GBDTParams(num_iterations=iters_a, objective="binary", max_depth=5))
-    t_a = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    train(X, y, GBDTParams(num_iterations=iters_b, objective="binary", max_depth=5))
-    t_b = time.perf_counter() - t0
-    rps = n * (iters_b - iters_a) / max(t_b - t_a, 1e-9)
-    print(f"GBDT_RPS {rps} {n}", flush=True)
+    rates = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        train(X, fresh_y(), GBDTParams(num_iterations=iters_a,
+                                       objective="binary", max_depth=5))
+        t_a = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        train(X, fresh_y(), GBDTParams(num_iterations=iters_b,
+                                       objective="binary", max_depth=5))
+        t_b = time.perf_counter() - t0
+        rates.append(n * (iters_b - iters_a) / max(t_b - t_a, 1e-9))
+        _log(f"[bench] gbdt rep rate {rates[-1]:.0f}")
+    rates.sort()
+    print(f"GBDT_RPS {rates[len(rates) // 2]} {n}", flush=True)
 
 
 def phase_resnet(batch=32, steps=10, hw=224) -> None:
@@ -134,28 +158,46 @@ def phase_resnet(batch=32, steps=10, hw=224) -> None:
     print(f"IMAGES_SEC {ips}", flush=True)
 
 
-def phase_ranker(n=200_000, f=50, group=100, iters_a=2, iters_b=8) -> None:
-    """LambdaRank marginal rows/sec — the lambda pass is device-resident
-    (make_lambdarank_grad_fn), so this measures the fused iteration rate."""
+def phase_ranker(n=200_000, f=50, group=100, iters_a=2, iters_b=8,
+                 reps=3) -> None:
+    """LambdaRank marginal rows/sec, median of ``reps`` — the lambda pass is
+    device-resident (make_lambdarank_grad_fn), so this measures the fused
+    iteration rate.  Labels perturb per call (relay result-cache busting,
+    same as phase_gbdt)."""
     from __graft_entry__ import enable_compilation_cache
     enable_compilation_cache()
     import numpy as np
     from mmlspark_tpu.lightgbm import GBDTParams, train
     rng = np.random.default_rng(0)
     X = rng.normal(size=(n, f)).astype(np.float32)
-    rel = (X[:, 0] + 0.3 * rng.normal(size=n) > 0.5).astype(np.float32) \
+    rel0 = (X[:, 0] + 0.3 * rng.normal(size=n) > 0.5).astype(np.float32) \
         + (X[:, 1] > 1.0)
     gp = np.arange(0, n + 1, group)
     p = dict(objective="lambdarank", max_depth=5)
-    train(X, rel, GBDTParams(num_iterations=1, **p), group_ptr=gp)
-    t0 = time.perf_counter()
-    train(X, rel, GBDTParams(num_iterations=iters_a, **p), group_ptr=gp)
-    t_a = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    train(X, rel, GBDTParams(num_iterations=iters_b, **p), group_ptr=gp)
-    t_b = time.perf_counter() - t0
-    print(f"RANKER_RPS {n * (iters_b - iters_a) / max(t_b - t_a, 1e-9)}",
-          flush=True)
+    nonce = [0]
+
+    def fresh_rel():
+        nonce[0] += 1
+        rel = rel0.copy()
+        a = (53 * nonce[0]) % (n - 32)
+        rel[a:a + 32] = 2.0 - rel[a:a + 32]
+        return rel
+
+    train(X, fresh_rel(), GBDTParams(num_iterations=iters_a, **p),
+          group_ptr=gp)
+    rates = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        train(X, fresh_rel(), GBDTParams(num_iterations=iters_a, **p),
+              group_ptr=gp)
+        t_a = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        train(X, fresh_rel(), GBDTParams(num_iterations=iters_b, **p),
+              group_ptr=gp)
+        t_b = time.perf_counter() - t0
+        rates.append(n * (iters_b - iters_a) / max(t_b - t_a, 1e-9))
+    rates.sort()
+    print(f"RANKER_RPS {rates[len(rates) // 2]}", flush=True)
 
 
 def phase_serving(n_requests=1000) -> None:
@@ -290,10 +332,11 @@ def main() -> None:
     tpu_rps = 0.0
     if tpu_ok:
         # Phase 1 — headline metric: GBDT rows/sec on the real chip.
-        got = _collect(_spawn("gbdt", _tpu_env()), "GBDT_RPS", 420)
+        got = _collect(_spawn("gbdt", _tpu_env()), "GBDT_RPS", 640)
         if got is None:  # degraded fallback: quarter-size, same trainer
             got = _collect(_spawn("gbdt", _tpu_env(),
-                                  ["--n", "250000", "--iters_b", "10"]),
+                                  ["--n", "250000", "--iters_b", "10",
+                                   "--reps", "1"]),
                            "GBDT_RPS", 240)
             if got:
                 RESULT["extras"]["note"] = (
@@ -309,7 +352,7 @@ def main() -> None:
 
     if tpu_ok:
         # Phase 3 — LambdaRank iteration rate (device-resident lambdas).
-        got = _collect(_spawn("ranker", _tpu_env()), "RANKER_RPS", 180)
+        got = _collect(_spawn("ranker", _tpu_env()), "RANKER_RPS", 300)
         if got:
             RESULT["extras"]["lambdarank_train_rows_per_sec_200kx50"] = \
                 round(got[0], 1)

@@ -115,8 +115,15 @@ def export_gbdt(booster, name: str = "gbdt") -> bytes:
     Regression/ranking objectives emit ``TreeEnsembleRegressor``; binary and
     multiclass emit ``TreeEnsembleClassifier`` (scores output, post_transform
     NONE — the raw margins, so consumers apply their own link exactly as
-    ``raw_scores`` callers do here).  RF averaging folds ``1/T_c`` into the
-    leaf weights.  Input: float tensor (N, num_features)."""
+    ``raw_scores`` callers do here; binary mirrors weights into two score
+    columns, column 1 = positive-class margin).  RF averaging folds
+    ``1/T_c`` into the leaf weights.  Input: float tensor (N, num_features).
+
+    Categorical caveat: categorical nodes use ``BRANCH_EQ`` with EXACT
+    float equality, while the in-repo booster walk rounds first
+    (``np.round(x)`` — 2.9999 scores as code 3).  Feed the exported model
+    exactly-integral category codes; non-integral inputs route right here
+    but left in-repo."""
     K = booster.num_class if booster.objective == "multiclass" else 1
     T = booster.num_trees
     classifier = booster.objective in ("binary", "multiclass")
@@ -132,6 +139,15 @@ def export_gbdt(booster, name: str = "gbdt") -> bytes:
         weight_rows = [(t, n, cid, wt / wsum[cid])
                        for (t, n, cid, wt) in weight_rows]
     base = [float(booster.init_score)] * K
+    if classifier and K == 1:
+        # binary: mirror weights onto both declared classes ([-s, +s]
+        # columns) so the scores output matches classlabels_int64s=[0,1]
+        # and external ai.onnx.ml consumers (onnxruntime expands two-label
+        # single-target ensembles to two columns) see the declared shape.
+        # Column 1 carries the positive-class raw margin.
+        weight_rows = [row for (t, n_, cid, wt) in weight_rows
+                       for row in ((t, n_, 0, -wt), (t, n_, 1, wt))]
+        base = [-base[0], base[0]]
 
     prefix = "class" if classifier else "target"
     attrs: Dict[str, Any] = {
@@ -151,7 +167,7 @@ def export_gbdt(booster, name: str = "gbdt") -> bytes:
     }
     if classifier:
         attrs["classlabels_int64s"] = list(range(max(K, 2)))
-        outputs = [("label", [0]), ("scores", [0, K])]
+        outputs = [("label", [0]), ("scores", [0, max(K, 2)])]
         out_names = ["label", "scores"]
     else:
         attrs["n_targets"] = K
@@ -162,8 +178,10 @@ def export_gbdt(booster, name: str = "gbdt") -> bytes:
     # domain field (NodeProto field 7) marks the ai.onnx.ml op
     from .onnx_wire import _str_field
     node += _str_field(7, ML_DOMAIN)
+    # the IR requires an opset_import for EVERY domain a node uses —
+    # onnx.checker/onnxruntime reject the model without this entry
     return build_model([node], {}, [("input", [0, booster.num_features])],
-                       outputs)
+                       outputs, extra_domains=[(ML_DOMAIN, 2)])
 
 
 def _strings(vals: Sequence[str]) -> list:

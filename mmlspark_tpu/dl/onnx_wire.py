@@ -359,8 +359,14 @@ def _encode_value_info(name: str, shape, elem_type: int = 1) -> bytes:
 def build_model(nodes: List[bytes], initializers: Dict[str, np.ndarray],
                 inputs: List[Tuple[str, List[int]]],
                 outputs: List[Tuple[str, List[int]]],
-                opset: int = 13) -> bytes:
-    """Assemble a serialized ModelProto from encoded nodes + named arrays."""
+                opset: int = 13,
+                extra_domains: List[Tuple[str, int]] = ()) -> bytes:
+    """Assemble a serialized ModelProto from encoded nodes + named arrays.
+
+    ``extra_domains``: (domain, version) opset imports beyond the default
+    domain — the ONNX IR requires every domain a node uses to be declared
+    (onnx.checker/onnxruntime reject models that omit one), so TreeEnsemble
+    exporters pass ``[("ai.onnx.ml", 2)]``."""
     g = b"".join(_len_field(1, n) for n in nodes)
     g += _str_field(2, "graph")
     g += b"".join(_len_field(5, encode_tensor(k, v))
@@ -368,7 +374,10 @@ def build_model(nodes: List[bytes], initializers: Dict[str, np.ndarray],
     g += b"".join(_len_field(11, _encode_value_info(n, s)) for n, s in inputs)
     g += b"".join(_len_field(12, _encode_value_info(n, s)) for n, s in outputs)
     opset_b = _str_field(1, "") + _key(2, 0) + _varint(opset)
-    return (_key(1, 0) + _varint(8)            # ir_version
-            + _str_field(2, "mmlspark_tpu")    # producer
-            + _len_field(7, g)
-            + _len_field(8, opset_b))
+    out = (_key(1, 0) + _varint(8)            # ir_version
+           + _str_field(2, "mmlspark_tpu")    # producer
+           + _len_field(7, g)
+           + _len_field(8, opset_b))
+    for dom, ver in extra_domains:
+        out += _len_field(8, _str_field(1, dom) + _key(2, 0) + _varint(ver))
+    return out

@@ -1121,15 +1121,18 @@ def default_metric(objective: str) -> str:
 # training driver
 # ---------------------------------------------------------------------------
 
-def _resolve_hist_backend() -> str:
-    """The histogram backend (and MXU block size) the growers will trace
-    with.  Resolved ONCE per train() call and made part of every jit cache
-    key: the env overrides are read at trace time, so without keying on them
-    a cached program would silently keep serving a previously-selected
-    configuration."""
+def _resolve_hist_backend() -> tuple:
+    """(backend, block_rows, lo_width, residuals) env knobs the growers will
+    trace with.  Resolved ONCE per train() call and made part of every jit
+    cache key: the env overrides are read at trace time, so without keying
+    on EVERY knob a cached program would silently keep serving a
+    previously-selected configuration.  Add any new histogram env knob to
+    this tuple."""
     import os
     return (os.environ.get("MMLSPARK_TPU_HIST_BACKEND", "auto"),
-            os.environ.get("MMLSPARK_TPU_HIST_BLOCK_ROWS", ""))
+            os.environ.get("MMLSPARK_TPU_HIST_BLOCK_ROWS", ""),
+            os.environ.get("MMLSPARK_TPU_HIST_LO", ""),
+            os.environ.get("MMLSPARK_TPU_HIST_RESID", ""))
 
 
 def _make_grower(p: GBDTParams, F: int, B: int, axis_name: str = None,

@@ -162,11 +162,14 @@ class GBDTBooster(Saveable):
             W = min(B, self.cat_bitset.shape[-1])
             out[:, :, :W] = self.cat_bitset[:, :, :W]
             return out
+        # codes >= B stay UNSET (they can never match a bin of width B);
+        # clipping them to B-1 would silently remap out-of-range categories
+        # onto the last bin when merge() mixes boosters of unequal widths
         is_cat_node = (self.split_feature >= 0) & \
-            self._is_cat[np.maximum(self.split_feature, 0)]
-        codes = np.clip(self.threshold_bin, 0, B - 1)
+            self._is_cat[np.maximum(self.split_feature, 0)] & \
+            (self.threshold_bin < B)
         t_i, m_i = np.nonzero(is_cat_node)
-        out[t_i, m_i, codes[t_i, m_i]] = True
+        out[t_i, m_i, self.threshold_bin[t_i, m_i]] = True
         return out
 
     # ------------------------------------------------------------------ predict

@@ -113,13 +113,14 @@ def bin_matrix(x: jnp.ndarray, edges: jnp.ndarray, num_bins: int) -> jnp.ndarray
 # ---------------------------------------------------------------------------
 
 def _node_pure_layout(binned, grad, hess, node_ids, num_nodes, R,
-                      sample_weight=None):
+                      sample_weight=None, residuals=True):
     """Shared host/device prep for the MXU + Pallas histogram backends:
     sort rows by node and pad so every R-row block is node-pure, then build
-    the bf16x2-decomposed weight channels.
+    the bf16x2-decomposed weight channels (``residuals=False`` keeps just
+    bf16-rounded grad/hess + count — 3 channels instead of 5).
 
-    Returns (bb_all (N_pad, F) u8, w5 (5, N_pad) f32, node_blk (NB,) i32,
-    NB).  Masked rows (node < 0) land in dummy node P whose buffer is
+    Returns (bb_all (N_pad, F) u8, w_ch (5 or 3, N_pad) f32, node_blk (NB,)
+    i32, NB).  Masked rows (node < 0) land in dummy node P whose buffer is
     dropped by the caller.
     """
     import jax
@@ -168,6 +169,9 @@ def _node_pure_layout(binned, grad, hess, node_ids, num_nodes, R,
     cp = c[safe_idx] * valid
     g_hi = gp.astype(jnp.bfloat16).astype(jnp.float32)
     h_hi = hp.astype(jnp.bfloat16).astype(jnp.float32)
+    if not residuals:
+        w_ch = jnp.stack([g_hi, h_hi, cp], axis=0)                  # (3, N_pad)
+        return bb_all, w_ch, node_blk, NB
     w5 = jnp.stack([g_hi, gp - g_hi, h_hi, hp - h_hi, cp], axis=0)  # (5, N_pad)
     return bb_all, w5, node_blk, NB
 
@@ -176,7 +180,9 @@ def build_histograms_matmul(binned: jnp.ndarray, grad: jnp.ndarray,
                             hess: jnp.ndarray, node_ids: jnp.ndarray,
                             num_nodes: int, num_bins: int,
                             sample_weight: Optional[jnp.ndarray] = None,
-                            block_rows: int = 1024) -> jnp.ndarray:
+                            block_rows: int = 4096,
+                            lo_width: int = 0,
+                            residuals: bool = True) -> jnp.ndarray:
     """Histogram build as batched one-hot matmuls on the MXU.
 
     TPU scatter runs ~100M updates/s — far below what the n*F histogram pass
@@ -185,14 +191,24 @@ def build_histograms_matmul(binned: jnp.ndarray, grad: jnp.ndarray,
 
     1. rows are sorted by node and padded so every `block_rows` block is
        node-pure (one bounded-size scatter of int32 row ids, not n*F floats);
-    2. each 8-bit bin splits into hi/lo nibbles; a block's histogram is the
-       pair of one-hot indicators contracted over rows —
-       ``einsum('rfh,rfl->fhl', onehot_hi * weight, onehot_lo)`` — which XLA
-       lowers to F-batched (16, R) x (R, 16) matmuls on the systolic array;
+    2. each 8-bit bin splits into hi/lo parts (``lo_width`` lanes wide); a
+       block's histogram is the pair of one-hot indicators contracted over
+       rows — ``einsum('rfm,rfl->fml', onehot_hi * weight, onehot_lo)`` —
+       which XLA lowers to F-batched matmuls on the systolic array;
     3. block results accumulate into per-node buffers in a `lax.scan`.
 
     Masked rows (node < 0) land in a dummy node whose buffer is dropped.
     Exact: every (row, feature) contributes to exactly one (hi, lo) cell.
+
+    The pass is HBM-bound, not MXU-bound (measured r4): traffic per
+    (row, feature) is ``2*(C*HI + LO)`` bytes of materialized bf16 one-hot
+    operands plus the per-block f32 accumulator round-trip.  Hence the
+    knobs: larger ``block_rows`` cuts accumulator traffic ~linearly;
+    ``lo_width=64`` (hi=4) shrinks the weighted operand from 5*16 to 5*4
+    channels (the MXU time is invariant to the split — M*N stays C*B);
+    ``residuals=False`` drops the two bf16-residual channels (inputs round
+    to bf16, accumulation stays exact f32 — LightGBM's own histograms are
+    f32) for another ~40% operand-traffic cut.
     """
     import jax
     import jax.numpy as jnp
@@ -201,41 +217,51 @@ def build_histograms_matmul(binned: jnp.ndarray, grad: jnp.ndarray,
     B = num_bins
     if B > 256:
         raise ValueError("matmul backend supports max_bin <= 256")
-    HI = (B + 15) // 16
-    LO = 16
+    LO = lo_width or 16
+    if LO not in (16, 32, 64, 128):
+        raise ValueError("lo_width must be one of 16/32/64/128")
+    HI = (B + LO - 1) // LO
+    shift = LO.bit_length() - 1
     P = num_nodes
-    R = block_rows
+    # small inputs: shrink the block so padding (one block minimum per node)
+    # stays proportionate
+    R = min(block_rows, max(256, 1 << max(0, (n - 1)).bit_length()))
 
-    bb_all, w5, node_blk, NB = _node_pure_layout(binned, grad, hess, node_ids,
-                                                 num_nodes, R, sample_weight)
+    bb_all, w_ch, node_blk, NB = _node_pure_layout(
+        binned, grad, hess, node_ids, num_nodes, R, sample_weight,
+        residuals=residuals)
+    C = w_ch.shape[0]                                # 5 or 3 channels
 
     hi_iota = jnp.arange(HI, dtype=jnp.int32)
     lo_iota = jnp.arange(LO, dtype=jnp.int32)
 
     def body(acc, args):
-        bb, w, nb = args                             # (R,F) u8, (5,R), ()
+        bb, w, nb = args                             # (R,F) u8, (C,R), ()
         b32 = bb.astype(jnp.int32)
-        hi = b32 >> 4
-        lo = b32 & 15
-        onehot_lo = (lo[:, :, None] == lo_iota).astype(jnp.bfloat16)   # (R,F,16)
+        hi = b32 >> shift
+        lo = b32 & (LO - 1)
+        onehot_lo = (lo[:, :, None] == lo_iota).astype(jnp.bfloat16)   # (R,F,LO)
         onehot_hi = (hi[:, :, None] == hi_iota).astype(jnp.bfloat16)   # (R,F,HI)
-        # channels merged into the matmul M axis: M = 5*HI instead of
-        # batched M=16 matmuls -> 5x less systolic-array padding waste
+        # channels merged into the matmul M axis: M = C*HI instead of
+        # batched M=LO matmuls -> C x less systolic-array padding waste
         a = (onehot_hi[:, :, None, :] *
-             w.T[:, None, :, None].astype(jnp.bfloat16))               # (R,F,5,HI)
-        a = a.reshape(R, F, 5 * HI)
+             w.T[:, None, :, None].astype(jnp.bfloat16))               # (R,F,C,HI)
+        a = a.reshape(R, F, C * HI)
         blk = jnp.einsum("rfm,rfl->fml", a, onehot_lo,
-                         preferred_element_type=jnp.float32)           # (F,5*HI,16)
+                         preferred_element_type=jnp.float32)           # (F,C*HI,LO)
         return acc.at[nb].add(blk), None
 
-    acc0 = jnp.zeros((P + 1, F, 5 * HI, LO), jnp.float32)
+    acc0 = jnp.zeros((P + 1, F, C * HI, LO), jnp.float32)
     acc, _ = jax.lax.scan(
         body, acc0,
-        (bb_all.reshape(NB, R, F), jnp.moveaxis(w5.reshape(5, NB, R), 1, 0),
+        (bb_all.reshape(NB, R, F), jnp.moveaxis(w_ch.reshape(C, NB, R), 1, 0),
          node_blk))
-    acc = acc[:P].reshape(P, F, 5, HI, LO)                             # split channels
-    acc3 = jnp.stack([acc[:, :, 0] + acc[:, :, 1],
-                      acc[:, :, 2] + acc[:, :, 3], acc[:, :, 4]], axis=0)
+    acc = acc[:P].reshape(P, F, C, HI, LO)                             # split channels
+    if residuals:
+        acc3 = jnp.stack([acc[:, :, 0] + acc[:, :, 1],
+                          acc[:, :, 2] + acc[:, :, 3], acc[:, :, 4]], axis=0)
+    else:
+        acc3 = jnp.moveaxis(acc, 2, 0)
     hist = acc3.reshape(3, P, F, HI * LO)[..., :B]                     # (3,P,F,B)
     return jnp.moveaxis(hist, 0, -1)                                    # (P,F,B,3)
 
@@ -253,8 +279,8 @@ def build(binned, grad, hess, node_ids, num_nodes, num_bins,
         # not request a specific backend (ADVICE r2)
     if backend == "auto":
         backend = "scatter" if jax.default_backend() == "cpu" else "matmul"
-    # MXU block size knob for on-chip tuning (read at trace time; train()
-    # keys its jit caches on it)
+    # MXU tuning knobs (read at trace time; train() keys its jit caches on
+    # them): block size, lo one-hot width, residual channels on/off
     block_rows = int(os.environ.get("MMLSPARK_TPU_HIST_BLOCK_ROWS", "0")) or None
     if backend == "pallas":
         from .pallas_histogram import build_histograms_pallas
@@ -263,7 +289,14 @@ def build(binned, grad, hess, node_ids, num_nodes, num_bins,
             binned, grad, hess, node_ids, num_nodes, num_bins, sample_weight,
             interpret=jax.default_backend() == "cpu", **kw)
     if backend == "matmul":
-        kw = {"block_rows": block_rows} if block_rows else {}
+        kw = {}
+        if block_rows:
+            kw["block_rows"] = block_rows
+        lo = int(os.environ.get("MMLSPARK_TPU_HIST_LO", "0"))
+        if lo:
+            kw["lo_width"] = lo
+        if os.environ.get("MMLSPARK_TPU_HIST_RESID", "1") == "0":
+            kw["residuals"] = False
         return build_histograms_matmul(binned, grad, hess, node_ids,
                                        num_nodes, num_bins, sample_weight,
                                        **kw)

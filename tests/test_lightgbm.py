@@ -179,6 +179,36 @@ def test_histogram_backends_agree():
     assert float(jnp.max(jnp.abs(a - m))) < 1e-3
     # count channel must be exactly integral
     assert float(jnp.max(jnp.abs(m[..., 2] - jnp.round(m[..., 2])))) == 0.0
+    # every tuning-knob combination (production-reachable via the
+    # MMLSPARK_TPU_HIST_LO / _RESID / _BLOCK_ROWS envs) must agree too:
+    # residual channels keep f32-exactness, bf16-rounded inputs bound 2e-3
+    for lo in (32, 64, 128):
+        for resid, tol in ((True, 1e-3), (False, 2e-3)):
+            m2 = build_histograms_matmul(binned, g, h, node, p, b,
+                                         block_rows=1024, lo_width=lo,
+                                         residuals=resid)
+            scale = float(jnp.max(jnp.abs(a)))
+            err = float(jnp.max(jnp.abs(a - m2))) / max(scale, 1.0)
+            assert err < tol, (lo, resid, err)
+            assert float(jnp.max(jnp.abs(
+                m2[..., 2] - jnp.round(m2[..., 2])))) == 0.0
+
+
+def test_histogram_env_knobs_drive_training(monkeypatch):
+    # the env-tuned matmul path must produce an equivalent booster through
+    # the full train() flow (the jit cache is keyed on the knobs)
+    monkeypatch.setenv("MMLSPARK_TPU_HIST_BACKEND", "matmul")
+    monkeypatch.setenv("MMLSPARK_TPU_HIST_BLOCK_ROWS", "512")
+    monkeypatch.setenv("MMLSPARK_TPU_HIST_LO", "64")
+    monkeypatch.setenv("MMLSPARK_TPU_HIST_RESID", "0")
+    from mmlspark_tpu.lightgbm import GBDTParams, train
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(2000, 8)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    r = train(X, y, GBDTParams(num_iterations=5, max_depth=4,
+                               objective="binary"))
+    acc = ((r.booster.predict(X) > 0.5) == y).mean()
+    assert acc > 0.9, acc
 
 
 def test_chunked_training_matches_unchunked(monkeypatch):

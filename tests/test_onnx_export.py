@@ -55,8 +55,13 @@ def test_gbdt_binary_classifier_roundtrip():
     r, X = _train("binary")
     fn, variables = onnx_to_jax(export_gbdt(r.booster))
     label, scores = fn(variables, X)
-    np.testing.assert_allclose(np.asarray(scores),
-                               r.booster.raw_scores(X), rtol=1e-5, atol=1e-5)
+    # binary emits the two-column ai.onnx.ml convention: [-margin, +margin]
+    raw = r.booster.raw_scores(X)
+    assert np.asarray(scores).shape == (len(X), 2)
+    np.testing.assert_allclose(np.asarray(scores)[:, 1:], raw,
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(scores)[:, :1], -raw,
+                               rtol=1e-5, atol=1e-5)
     np.testing.assert_array_equal(np.asarray(label),
                                   (r.booster.predict(X) > 0.5).astype(int))
 
@@ -94,7 +99,7 @@ def test_gbdt_categorical_subset_chain_roundtrip():
     Xp[1::9, 0] = 99.0  # unseen code
     fn, variables = onnx_to_jax(export_gbdt(r.booster))
     _, scores = fn(variables, Xp)
-    np.testing.assert_allclose(np.asarray(scores),
+    np.testing.assert_allclose(np.asarray(scores)[:, 1:],
                                r.booster.raw_scores(Xp), rtol=1e-5, atol=1e-5)
 
 
@@ -110,7 +115,7 @@ def test_gbdt_categorical_onehot_roundtrip():
     assert r.booster.cat_bitset is None  # one-vs-rest regime
     fn, variables = onnx_to_jax(export_gbdt(r.booster))
     _, scores = fn(variables, X)
-    np.testing.assert_allclose(np.asarray(scores),
+    np.testing.assert_allclose(np.asarray(scores)[:, 1:],
                                r.booster.raw_scores(X), rtol=1e-5, atol=1e-5)
 
 

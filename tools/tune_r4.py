@@ -1,0 +1,119 @@
+"""Round-4 on-chip GBDT tuning harness.
+
+Measures, with in-process repetitions (median-of-k), what round 3 measured
+only once per config:
+
+  1. relay dispatch RTT (trivial jitted add, forced fetch) — the fixed
+     per-dispatch cost that scan-chunking amortizes;
+  2. one histogram-build pass at the bench shape (einsum time);
+  3. GBDT marginal training rate at several scan-chunk sizes CH, with
+     iters chosen so BOTH the A and B runs satisfy the chunked path's
+     ``num_iterations >= 2*CH`` guard (round-3 tune runs violated this for
+     CH=8/16: their A-runs — and for CH=16 the B-run too — silently fell
+     back to per-iteration dispatch, so those configs were never measured).
+
+Run detached (the relay wedges if killed mid-compile):
+  nohup python tools/tune_r4.py > bench_attempts/tune_r4.log 2>&1 &
+"""
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def emit(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+def main():
+    from __graft_entry__ import enable_compilation_cache
+    enable_compilation_cache()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    emit(event="start", backend=jax.default_backend(),
+         devices=len(jax.devices()))
+
+    # ---- probe 1: dispatch RTT --------------------------------------------
+    @jax.jit
+    def tick(x, s):
+        return (x * 1.000001 + s).sum()
+
+    x = jnp.ones((256, 256))
+    float(tick(x, jnp.float32(0)))  # compile
+    rtts = []
+    for i in range(20):
+        t0 = time.perf_counter()
+        float(tick(x, jnp.float32(i + 1)))  # distinct args: no relay cache
+        rtts.append(time.perf_counter() - t0)
+    emit(event="dispatch_rtt_ms", median=1000 * statistics.median(rtts),
+         p90=1000 * sorted(rtts)[17], min=1000 * min(rtts))
+
+    # ---- probe 2: single histogram pass at bench shape --------------------
+    from mmlspark_tpu.ops.histogram import build_histograms_matmul
+
+    n, F, B = 1_000_000, 200, 255
+    rng = np.random.default_rng(0)
+    binned = jnp.asarray(rng.integers(0, B, size=(n, F), dtype=np.uint8))
+    g = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.1, 1, size=n).astype(np.float32))
+
+    hist_j = jax.jit(lambda b, g_, h_, nid: build_histograms_matmul(
+        b, g_, h_, nid, 16, B))
+    nid16 = jnp.asarray(rng.integers(0, 16, size=n, dtype=np.int32))
+    t0 = time.perf_counter()
+    float(hist_j(binned, g, h, nid16).sum())
+    emit(event="hist_pass_compile_s", value=time.perf_counter() - t0)
+    times = []
+    for i in range(5):
+        gv = g * (1.0 + 1e-6 * i)  # distinct args each rep
+        t0 = time.perf_counter()
+        float(hist_j(binned, gv, h, nid16).sum())
+        times.append(time.perf_counter() - t0)
+    emit(event="hist_pass_16node_s", median=statistics.median(times),
+         all=[round(t, 4) for t in times])
+    del binned, g, h, nid16, hist_j
+
+    # ---- probe 3: CH sweep with valid chunking ----------------------------
+    from mmlspark_tpu.lightgbm import GBDTParams, train
+
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1]
+         + rng.normal(scale=0.3, size=n) > 0).astype(np.float32)
+
+    for ch in (8, 16, 4, 32):
+        os.environ["MMLSPARK_TPU_GBDT_CHUNK"] = str(ch)
+        ia, ib = 2 * ch, 6 * ch  # both >= 2*CH: both runs take the scan path
+        t0 = time.perf_counter()
+        train(X, y, GBDTParams(num_iterations=ia, objective="binary",
+                               max_depth=5))
+        warm = time.perf_counter() - t0
+        rates = []
+        for rep in range(3):
+            t0 = time.perf_counter()
+            train(X, y, GBDTParams(num_iterations=ia, objective="binary",
+                                   max_depth=5))
+            ta = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            train(X, y, GBDTParams(num_iterations=ib, objective="binary",
+                                   max_depth=5))
+            tb = time.perf_counter() - t0
+            rates.append(n * (ib - ia) / max(tb - ta, 1e-9))
+            emit(event="ch_rep", ch=ch, rep=rep, rate=round(rates[-1], 1),
+                 ta=round(ta, 2), tb=round(tb, 2))
+        emit(event="ch_result", ch=ch, warm_s=round(warm, 1),
+             median=round(statistics.median(rates), 1),
+             rates=[round(r, 1) for r in rates])
+
+    emit(event="done")
+
+
+if __name__ == "__main__":
+    main()
