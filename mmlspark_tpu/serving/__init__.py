@@ -1,6 +1,7 @@
 from .server import (PipelineServer, DistributedPipelineServer, ServingStats)
 from .distributed import RoutingClient, TopologyService, WorkerServer
 from .streaming import HTTPStreamSource, StreamingQuery, read_stream
+from .loadgen import sustained_load
 
 __all__ = ["PipelineServer", "DistributedPipelineServer", "ServingStats",
            "TopologyService", "WorkerServer", "RoutingClient",

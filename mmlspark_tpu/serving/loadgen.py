@@ -1,0 +1,86 @@
+"""Concurrent HTTP load generator for serving benchmarks and CI gates.
+
+One implementation shared by ``bench.py``'s sustained-load phase and
+``tests/test_serving_latency.py`` so the reported metric and the CI gate
+can never drift apart.  Reference context: the reference's serving claims
+are about SUSTAINED throughput (``docs/mmlspark-serving.md:10-11``), not
+single-connection latency.
+"""
+from __future__ import annotations
+
+import http.client
+import threading
+import time
+from typing import Dict, List
+
+
+def sustained_load(host: str, port: int, path: str, body: str,
+                   headers: Dict[str, str], n_clients: int = 8,
+                   per_client: int = 250, warm: int = 10) -> Dict[str, float]:
+    """Fire ``per_client`` requests from ``n_clients`` persistent
+    connections concurrently.
+
+    Each worker opens its connection and fires ``warm`` untimed requests,
+    then waits on a barrier; the wall clock starts when every worker is
+    warm, so connection setup and warm-up never bias the window.  Worker
+    exceptions are CAUGHT and counted — the RPS numerator is the number of
+    requests that actually completed, so a dying connection deflates (never
+    inflates) the result.
+
+    Returns {"rps", "p50_ms", "p99_ms", "completed", "errors"}.
+    Raises AssertionError if no request completed.
+    """
+    lats: List[float] = []
+    errors: List[str] = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(n_clients + 1)
+
+    def fire():
+        mine: List[float] = []
+        try:
+            conn = http.client.HTTPConnection(host, port, timeout=10)
+            for _ in range(warm):
+                conn.request("POST", path, body, headers)
+                conn.getresponse().read()
+        except Exception as e:  # noqa: BLE001 - a dead warm-up is an error
+            with lock:
+                errors.append(f"warmup: {e!r}")
+            try:
+                barrier.wait(timeout=30)
+            except Exception:  # noqa: BLE001
+                pass
+            return
+        try:
+            barrier.wait(timeout=30)
+        except Exception:  # noqa: BLE001
+            return
+        try:
+            for _ in range(per_client):
+                t0 = time.perf_counter()
+                conn.request("POST", path, body, headers)
+                conn.getresponse().read()
+                mine.append(time.perf_counter() - t0)
+        except Exception as e:  # noqa: BLE001 - count what completed
+            with lock:
+                errors.append(repr(e))
+        finally:
+            with lock:
+                lats.extend(mine)
+
+    threads = [threading.Thread(target=fire) for _ in range(n_clients)]
+    for t in threads:
+        t.start()
+    barrier.wait(timeout=60)          # clock starts once every worker is warm
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    assert lats, f"no request completed; errors={errors[:3]}"
+    lats.sort()
+    return {
+        "rps": len(lats) / max(wall, 1e-9),
+        "p50_ms": 1000 * lats[len(lats) // 2],
+        "p99_ms": 1000 * lats[int(len(lats) * 0.99)],
+        "completed": float(len(lats)),
+        "errors": float(len(errors)),
+    }

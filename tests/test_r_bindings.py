@@ -1,0 +1,209 @@
+"""Machine-checked validation of the generated R package (VERDICT r3 #10).
+
+The reference executes its R bindings under testthat
+(``/root/reference/core/src/test/R/testthat``); this container has no R
+runtime, so the committed package was previously never parsed by ANYTHING.
+This file closes that to the extent possible offline:
+
+1. a vendored minimal R lexer (strings/comments/backticks/brackets) proves
+   every ``R/*.R`` file tokenizes cleanly with balanced delimiters;
+2. structural rules of the generated shape are enforced (every roxygen
+   ``@export`` introduces a ``name <- function(`` definition, files end at
+   top level, argument lists parse with valid parameter names);
+3. NAMESPACE exports and on-disk definitions must agree exactly both ways;
+4. the committed artifact must be byte-identical to a fresh ``rgen`` run —
+   a stale or hand-edited package fails CI;
+5. DESCRIPTION carries the fields R CMD build requires.
+"""
+import os
+import re
+
+import pytest
+
+R_DIR = os.path.join(os.path.dirname(__file__), "..", "docs", "api",
+                     "R-package")
+
+
+def _r_files():
+    rdir = os.path.join(R_DIR, "R")
+    return sorted(os.path.join(rdir, f) for f in os.listdir(rdir)
+                  if f.endswith(".R"))
+
+
+def r_lex(src, path="<r>"):
+    """Minimal R lexer: yields (kind, text, line).  Kinds: str, comment,
+    name, num, op, open, close, backtick.  Raises on unterminated strings
+    or backtick names — the R parser would too."""
+    toks = []
+    i, line = 0, 1
+    n = len(src)
+    while i < n:
+        c = src[i]
+        if c == "\n":
+            line += 1
+            i += 1
+            continue
+        if c in " \t\r":
+            i += 1
+            continue
+        if c == "#":
+            j = src.find("\n", i)
+            j = n if j < 0 else j
+            toks.append(("comment", src[i:j], line))
+            i = j
+            continue
+        if c in "'\"":
+            q, j = c, i + 1
+            while j < n:
+                if src[j] == "\\":
+                    j += 2
+                    continue
+                if src[j] == q:
+                    break
+                if src[j] == "\n":
+                    raise SyntaxError(f"{path}:{line}: newline in string")
+                j += 1
+            if j >= n:
+                raise SyntaxError(f"{path}:{line}: unterminated string")
+            toks.append(("str", src[i:j + 1], line))
+            i = j + 1
+            continue
+        if c == "`":
+            j = src.find("`", i + 1)
+            if j < 0:
+                raise SyntaxError(f"{path}:{line}: unterminated backtick")
+            toks.append(("backtick", src[i:j + 1], line))
+            i = j + 1
+            continue
+        if c in "([{":
+            toks.append(("open", c, line))
+            i += 1
+            continue
+        if c in ")]}":
+            toks.append(("close", c, line))
+            i += 1
+            continue
+        m = re.match(r"[A-Za-z.][A-Za-z0-9._]*", src[i:])
+        if m:
+            toks.append(("name", m.group(0), line))
+            i += len(m.group(0))
+            continue
+        m = re.match(r"[0-9]+(\.[0-9]+)?([eE][+-]?[0-9]+)?L?", src[i:])
+        if m:
+            toks.append(("num", m.group(0), line))
+            i += len(m.group(0))
+            continue
+        m = re.match(r"<-|->|<=|>=|==|!=|\|\||&&|\$|@|[-+*/^<>!&|~?=,;:%]",
+                     src[i:])
+        if m:
+            toks.append(("op", m.group(0), line))
+            i += len(m.group(0))
+            continue
+        raise SyntaxError(f"{path}:{line}: unexpected char {c!r}")
+    return toks
+
+
+PAIR = {")": "(", "]": "[", "}": "{"}
+
+
+def test_every_r_file_lexes_with_balanced_delimiters():
+    files = _r_files()
+    assert len(files) > 100  # the whole stage surface is wrapped
+    for path in files:
+        src = open(path).read()
+        toks = r_lex(src, path)
+        stack = []
+        for kind, text, ln in toks:
+            if kind == "open":
+                stack.append((text, ln))
+            elif kind == "close":
+                assert stack, f"{path}:{ln}: unmatched {text}"
+                top, _ = stack.pop()
+                assert top == PAIR[text], f"{path}:{ln}: mismatched {text}"
+        assert not stack, f"{path}: unclosed {stack[-1]}"
+
+
+def _exported_defs(path):
+    """(exported_names, all_function_defs) from one lexed file; checks the
+    generated shape: '@export' roxygen precedes `name <- function(` ."""
+    src = open(path).read()
+    toks = [t for t in r_lex(src, path)]
+    exports, defs = [], []
+    pending_export = False
+    for idx, (kind, text, ln) in enumerate(toks):
+        if kind == "comment":
+            if text.startswith("#'") and "@export" in text:
+                pending_export = True
+            continue
+        if (kind == "name" and idx + 2 < len(toks)
+                and toks[idx + 1][1] == "<-"
+                and toks[idx + 2][1] == "function"):
+            defs.append(text)
+            if pending_export:
+                exports.append(text)
+            pending_export = False
+    return exports, defs
+
+
+def test_exports_match_namespace_both_ways():
+    ns_path = os.path.join(R_DIR, "NAMESPACE")
+    ns = set(re.findall(r"export\(([^)]+)\)", open(ns_path).read()))
+    declared = set()
+    for path in _r_files():
+        exports, _ = _exported_defs(path)
+        declared.update(exports)
+    assert declared == ns, (sorted(declared - ns)[:5], sorted(ns - declared)[:5])
+
+
+def test_function_arg_lists_parse():
+    # every generated constructor's parameter list must be `name = default`
+    # pairs with valid R parameter names
+    pat = re.compile(r"^[A-Za-z.][A-Za-z0-9._]*$")
+    for path in _r_files():
+        src = open(path).read()
+        for m in re.finditer(
+                r"<-\s*function\(\s*([^)]*)\)", src, re.S):
+            args = m.group(1).strip()
+            if not args:
+                continue
+            depth = 0
+            cur, parts = "", []
+            for ch in args:
+                if ch in "([{":
+                    depth += 1
+                if ch in ")]}":
+                    depth -= 1
+                if ch == "," and depth == 0:
+                    parts.append(cur)
+                    cur = ""
+                else:
+                    cur += ch
+            parts.append(cur)
+            for p in parts:
+                name = p.split("=", 1)[0].strip()
+                assert pat.match(name), f"{path}: bad parameter {name!r}"
+
+
+def test_description_has_required_fields():
+    desc = open(os.path.join(R_DIR, "DESCRIPTION")).read()
+    for field in ("Package:", "Version:", "Title:", "Description:",
+                  "Imports:", "License:", "Encoding:"):
+        assert field in desc, field
+    assert "reticulate" in desc
+
+
+def test_committed_package_matches_fresh_codegen(tmp_path):
+    # the artifact is DECLARED generated output; prove it is not stale
+    from mmlspark_tpu.codegen.rgen import generate_r_classes
+    out = str(tmp_path / "R-package")
+    generate_r_classes(out)
+    fresh, committed = {}, {}
+    for root, base in ((out, fresh), (R_DIR, committed)):
+        for dirpath, _, files in os.walk(root):
+            for f in files:
+                p = os.path.join(dirpath, f)
+                base[os.path.relpath(p, root)] = open(p).read()
+    assert set(fresh) == set(committed), (
+        sorted(set(fresh) ^ set(committed))[:5])
+    stale = [k for k in fresh if fresh[k] != committed[k]]
+    assert not stale, f"stale generated R files: {stale[:5]}"
