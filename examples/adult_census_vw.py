@@ -1,0 +1,51 @@
+"""Classification - Adult Census with Vowpal Wabbit.
+
+Equivalent of the reference's ``Classification - Adult Census with Vowpal
+Wabbit`` notebook: derive a numeric label from the income string, hash the
+raw mixed-type columns with ``VowpalWabbitFeaturizer`` (string categoricals
+hash directly — no one-hot pass), fit ``VowpalWabbitClassifier`` in a
+``Pipeline``, and report ``ComputeModelStatistics``.
+"""
+import numpy as np
+
+from _common import setup
+from adult_census import make_census
+
+
+def main():
+    setup()
+    from mmlspark_tpu.core import Pipeline
+    from mmlspark_tpu.train import ComputeModelStatistics
+    from mmlspark_tpu.vw import VowpalWabbitClassifier, VowpalWabbitFeaturizer
+
+    data = make_census()
+    # label = income contains "<" -> 0.0 else 1.0 (the notebook's withColumn)
+    def add_label(df):
+        inc = df.collect()["income"]
+        return df.with_column("label",
+                              np.asarray(["<" not in v for v in inc], float))
+
+    train, test = data.random_split([0.75, 0.25], seed=123)
+    train, test = add_label(train), add_label(test)
+    print(f"train rows: {train.count()}")
+
+    vw_featurizer = VowpalWabbitFeaturizer(
+        input_cols=["education", "marital-status", "hours-per-week"],
+        output_col="features")
+    vw_model = VowpalWabbitClassifier().set_params(
+        num_passes=10, label_col="label", loss_function="logistic")
+    vw_pipeline = Pipeline([vw_featurizer, vw_model])
+
+    vw_trained = vw_pipeline.fit(train)
+    prediction = vw_trained.transform(test)
+    metrics = ComputeModelStatistics().set_params(
+        evaluation_metric="classification", label_col="label",
+        scores_col="prediction").transform(prediction).collect()
+    acc = float(metrics["accuracy"][0])
+    print(f"accuracy={acc:.3f} f1={float(metrics['f1_score'][0]):.3f}")
+    assert acc > 0.75, acc
+    print("adult census with VW OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,90 @@
+"""TextAnalytics - Amazon Book Reviews with Word2Vec.
+
+Equivalent of the reference's ``TextAnalytics - Amazon Book Reviews with
+Word2Vec`` notebook: tokenizer + ``Word2Vec`` document embeddings feed a
+small model zoo (several LightGBM configurations — the notebook's
+LogisticRegression/RandomForest/GBT grid), ``FindBestModel`` picks the
+winner on the test split by AUC, and ``ComputeModelStatistics`` reports
+validation accuracy.  Review text is synthesized (zero egress) with the
+same shape: free text + a 1-5 rating thresholded at > 3.
+"""
+import numpy as np
+
+from _common import setup
+
+GOOD = ["gripping", "masterpiece", "loved", "beautiful", "inspiring",
+        "brilliant", "excellent", "wonderful"]
+BAD = ["boring", "dull", "hated", "waste", "awful", "predictable",
+       "terrible", "disappointing"]
+NEUTRAL = ["book", "story", "chapter", "author", "plot", "character",
+           "read", "pages", "series", "writing", "the", "a", "was", "it"]
+
+
+def make_reviews(n=6000, seed=0):
+    from mmlspark_tpu.core import DataFrame
+    rng = np.random.default_rng(seed)
+    texts = np.empty(n, dtype=object)
+    rating = np.zeros(n)
+    for i in range(n):
+        r = int(rng.integers(1, 6))
+        rating[i] = r
+        words = list(rng.choice(NEUTRAL, rng.integers(8, 16)))
+        pool, k = (GOOD, r - 3) if r > 3 else (BAD, 4 - r)
+        for _ in range(max(1, k)):
+            words.insert(int(rng.integers(0, len(words))),
+                         str(rng.choice(pool)))
+        texts[i] = " ".join(words)
+    return DataFrame.from_dict({"text": texts, "rating": rating},
+                               num_partitions=4)
+
+
+def main():
+    setup()
+    from mmlspark_tpu.automl import FindBestModel
+    from mmlspark_tpu.core import Pipeline
+    from mmlspark_tpu.featurize import Word2Vec
+    from mmlspark_tpu.lightgbm import LightGBMClassifier
+    from mmlspark_tpu.train import ComputeModelStatistics, TrainClassifier
+
+    data = make_reviews()
+    processed = data.with_column(
+        "label", lambda p: (np.asarray(p["rating"]) > 3).astype(float))
+    train, test, validation = processed.random_split([0.60, 0.20, 0.20],
+                                                     seed=42)
+
+    # tokenizer + Word2Vec = the notebook's textFeaturizer pipeline
+    word2vec = Word2Vec(input_col="text", output_col="features",
+                        vector_size=32, max_iter=3, min_count=2, seed=42)
+    featurizer = word2vec.fit(train)
+    ptrain = featurizer.transform(train)
+    ptest = featurizer.transform(test)
+    pvalidation = featurizer.transform(validation)
+    syn = featurizer.find_synonyms("loved", 3)
+    print(f"synonyms of 'loved': {[w for w, _ in syn]}")
+
+    # the notebook's hyperparameter grid -> TrainClassifier wrappers
+    grid = [dict(num_iterations=it, learning_rate=lr)
+            for it in (20, 40) for lr in (0.1, 0.3)]
+    trained = [TrainClassifier().set_params(
+        model=LightGBMClassifier().set_params(min_data_in_leaf=5, **hp),
+        label_col="label").fit(ptrain) for hp in grid]
+
+    best = FindBestModel().set_params(evaluation_metric="accuracy",
+                                      models=trained).fit(ptest)
+    print(f"grid accuracies on test: "
+          f"{[round(v, 4) for v in best.get_evaluation_results()]}")
+    print(f"best model test accuracy: "
+          f"{float(best.get('best_model_metrics')):.4f}")
+
+    predictions = best.transform(pvalidation)
+    metrics = ComputeModelStatistics().set_params(
+        evaluation_metric="classification", label_col="label",
+        scores_col="prediction").transform(predictions).collect()
+    acc = float(metrics["accuracy"][0])
+    print(f"best model accuracy on validation = {100 * acc:.2f}%")
+    assert acc > 0.85, acc
+    print("book reviews with word2vec OK")
+
+
+if __name__ == "__main__":
+    main()

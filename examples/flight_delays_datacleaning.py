@@ -1,0 +1,95 @@
+"""Regression - Flight Delays with DataCleaning.
+
+Equivalent of the reference's ``Regression - Flight Delays with
+DataCleaning`` notebook: ``DataConversion(convertTo="double")`` repairs
+integer-typed schedule columns, ``DataConversion(convertTo="toCategorical")``
+recodes the string carrier/time-block columns, ``TrainRegressor`` fits
+ArrDelay, and both ``ComputeModelStatistics`` and per-row
+``ComputePerInstanceStatistics`` report quality.
+"""
+import numpy as np
+
+from _common import setup
+
+CARRIERS = ["AA", "DL", "UA", "WN", "B6"]
+BLOCKS = ["0600-0659", "0900-0959", "1200-1259", "1700-1759", "2100-2159"]
+
+
+def make_flights(n=6000, seed=0):
+    from mmlspark_tpu.core import DataFrame
+    rng = np.random.default_rng(seed)
+    month = rng.integers(1, 13, n)
+    day_of_week = rng.integers(1, 8, n)
+    carrier = rng.choice(CARRIERS, n)
+    dep_blk = rng.choice(BLOCKS, n)
+    crs_dep = np.array([int(b[:4]) for b in dep_blk]) + rng.integers(0, 59, n)
+    carrier_delay = {"AA": 4.0, "DL": 1.0, "UA": 6.0, "WN": 3.0, "B6": 9.0}
+    evening = np.array([int(b[:4]) >= 1700 for b in dep_blk])
+    arr_delay = (np.array([carrier_delay[c] for c in carrier])
+                 + evening * 11.0 + (day_of_week >= 6) * -2.5
+                 + rng.gamma(2.0, 4.0, n) - 6.0)
+    return DataFrame.from_dict({
+        "Month": month.astype(np.int32),          # integer-typed on purpose:
+        "DayOfWeek": day_of_week.astype(np.int32),  # DataConversion repairs
+        "CRSDepTime": crs_dep.astype(np.int32),
+        "Carrier": carrier.astype(object),
+        "DepTimeBlk": dep_blk.astype(object),
+        "ArrDelay": arr_delay}, num_partitions=4)
+
+
+def main():
+    setup()
+    from mmlspark_tpu.featurize import DataConversion
+    from mmlspark_tpu.lightgbm import LightGBMRegressor
+    from mmlspark_tpu.train import (ComputeModelStatistics,
+                                    ComputePerInstanceStatistics,
+                                    TrainRegressor)
+
+    flights = make_flights()
+    print(f"records read: {flights.count()}")
+
+    # the notebook's first cleaning pass: int schedule columns -> double
+    flights = DataConversion().set_params(
+        cols=["Month", "DayOfWeek", "CRSDepTime"],
+        convert_to="double").transform(flights)
+    assert isinstance(flights.collect()["Month"][0], float)
+
+    train, test = flights.random_split([0.75, 0.25], seed=42)
+
+    # second cleaning pass: string columns -> categorical codes
+    conv = DataConversion().set_params(cols=["Carrier", "DepTimeBlk"],
+                                       convert_to="toCategorical")
+    train_cat = conv.transform(train)
+    test_cat = conv.transform(test)
+
+    model = TrainRegressor().set_params(
+        model=LightGBMRegressor().set_params(num_iterations=60,
+                                             min_data_in_leaf=10),
+        label_col="ArrDelay").fit(train_cat)
+    scored = model.transform(test_cat)
+
+    metrics = ComputeModelStatistics().set_params(
+        evaluation_metric="regression", label_col="ArrDelay",
+        scores_col="prediction").transform(scored).collect()
+    mae = float(metrics["mean_absolute_error"][0])
+    print(f"MAE={mae:.2f} RMSE={float(metrics['root_mean_squared_error'][0]):.2f}")
+
+    per_row = ComputePerInstanceStatistics().set_params(
+        label_col="ArrDelay", scores_col="prediction").transform(scored)
+    cols = per_row.collect()
+    assert {"L1_loss", "L2_loss"} <= set(cols)
+    print("per-instance rows:",
+          [(round(float(cols['L1_loss'][i]), 2),
+            round(float(cols['L2_loss'][i]), 2)) for i in range(3)])
+
+    # the model must beat predicting the training mean
+    base_mae = float(np.mean(np.abs(
+        np.asarray(test.collect()["ArrDelay"])
+        - float(np.mean(train.collect()["ArrDelay"])))))
+    print(f"baseline (mean) MAE={base_mae:.2f}")
+    assert mae < base_mae - 1.0, (mae, base_mae)
+    print("flight delays with data cleaning OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -2,6 +2,7 @@ from .base import CognitiveServicesBase
 from .services import (TextSentiment, LanguageDetector, EntityDetector, NER,
                        PII, KeyPhraseExtractor, OCR, AnalyzeImage,
                        DescribeImage, TagImage, RecognizeText,
+                       RecognizeDomainSpecificContent,
                        GenerateThumbnails, DetectFace, VerifyFaces,
                        GroupFaces, IdentifyFaces, FindSimilarFace,
                        DetectLastAnomaly, DetectAnomalies, Translate,
@@ -15,6 +16,7 @@ from .speech import (SpeechToTextSDK, ConversationTranscription,
 __all__ = ["CognitiveServicesBase", "TextSentiment", "LanguageDetector",
            "EntityDetector", "NER", "PII", "KeyPhraseExtractor", "OCR",
            "AnalyzeImage", "DescribeImage", "TagImage", "RecognizeText",
+           "RecognizeDomainSpecificContent",
            "GenerateThumbnails", "DetectFace", "VerifyFaces", "GroupFaces",
            "IdentifyFaces", "FindSimilarFace", "DetectLastAnomaly",
            "DetectAnomalies", "Translate", "Transliterate", "BreakSentence",

@@ -125,6 +125,19 @@ class RecognizeText(_ImageServiceBase):
     _url_path = "/vision/v3.2/read/analyze"
 
 
+class RecognizeDomainSpecificContent(_ImageServiceBase):
+    """Domain-model image analysis (celebrities/landmarks) — reference
+    ``RecognizeDomainSpecificContent`` (Celebrity Quote Analysis notebook).
+    The domain model is part of the endpoint path, so set ``model`` BEFORE
+    ``set_location`` (or pass the full ``url`` directly)."""
+    model = Param("model", "domain model name (celebrities|landmarks)",
+                  "string", default="celebrities")
+
+    @property
+    def _url_path(self) -> str:  # type: ignore[override]
+        return f"/vision/v3.2/models/{self.get('model')}/analyze"
+
+
 class GenerateThumbnails(_ImageServiceBase):
     _url_path = "/vision/v3.2/generateThumbnail"
     width = Param("width", "thumbnail width", "int", default=64)

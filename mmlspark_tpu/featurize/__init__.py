@@ -3,9 +3,11 @@ from .featurize import (Featurize, CleanMissingData, CleanMissingDataModel,
                         CountSelector, CountSelectorModel, DataConversion,
                         assemble_vector_column)
 from .text import TextFeaturizer, TextFeaturizerModel, MultiNGram, PageSplitter
+from .word2vec import Word2Vec, Word2VecModel
 
 __all__ = ["Featurize", "CleanMissingData", "CleanMissingDataModel",
            "ValueIndexer", "ValueIndexerModel", "IndexToValue",
            "CountSelector", "CountSelectorModel", "DataConversion",
            "assemble_vector_column", "TextFeaturizer", "TextFeaturizerModel",
+           "Word2Vec", "Word2VecModel",
            "MultiNGram", "PageSplitter"]

@@ -151,10 +151,15 @@ class CountSelectorModel(Model, HasInputCol, HasOutputCol):
 
 
 class DataConversion(Transformer):
-    """Column dtype conversion (reference ``DataConversion.scala``)."""
+    """Column dtype conversion (reference ``DataConversion.scala``),
+    including ``toCategorical`` (string/value column -> stable integer
+    codes, sorted-distinct order — the Spark categorical-metadata
+    analogue) and ``clearCategorical`` (codes stay plain doubles)."""
     cols = Param("cols", "columns to convert", "list")
     convert_to = Param("convert_to", "boolean|byte|short|integer|long|float|"
-                                     "double|string|date", "string", default="double")
+                                     "double|string|date|toCategorical|"
+                                     "clearCategorical", "string",
+                       default="double")
 
     _CASTS = {"boolean": bool, "byte": np.int8, "short": np.int16,
               "integer": np.int32, "long": np.int64, "float": np.float32,
@@ -170,6 +175,17 @@ class DataConversion(Transformer):
                 import datetime
                 out = out.with_column(c, lambda p, c=c: _as_column(
                     [datetime.datetime.fromisoformat(str(v)) for v in p[c]]))
+            elif to == "toCategorical":
+                # frame-global code table (sorted distinct values) so every
+                # partition recodes identically
+                levels = sorted({str(v) for v in out.collect()[c]})
+                table = {v: float(i) for i, v in enumerate(levels)}
+                out = out.with_column(c, lambda p, c=c, t=table: np.asarray(
+                    [t[str(v)] for v in p[c]], np.float64))
+            elif to == "clearCategorical":
+                out = out.with_column(
+                    c, lambda p, c=c: _cast_coerce(np.asarray(p[c]),
+                                                   np.float64))
             else:
                 cast = self._CASTS[to]
                 out = out.with_column(

@@ -1,0 +1,80 @@
+"""Word2Vec estimator: embeddings must capture co-occurrence structure,
+document averaging must match MLlib semantics, synonyms must rank by usage.
+
+Reference context: the Amazon Book Reviews notebook's Word2Vec+classifier
+pipeline (``TextAnalytics - Amazon Book Reviews with Word2Vec.ipynb``).
+"""
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame, save, load
+from mmlspark_tpu.featurize import Word2Vec, Word2VecModel
+
+
+def _corpus(n=400, seed=0):
+    # two topical clusters that never co-occur: food words vs tech words
+    rng = np.random.default_rng(seed)
+    food = ["pizza", "pasta", "cheese", "tomato", "basil", "oven"]
+    tech = ["cpu", "gpu", "memory", "compiler", "kernel", "cache"]
+    docs = np.empty(n, dtype=object)
+    for i in range(n):
+        pool = food if i % 2 == 0 else tech
+        docs[i] = " ".join(rng.choice(pool, 8))
+    return DataFrame.from_dict({"text": docs})
+
+
+def test_word2vec_separates_topics_and_averages_docs():
+    df = _corpus()
+    m = Word2Vec(input_col="text", output_col="features", vector_size=16,
+                 max_iter=3, min_count=1, seed=1).fit(df)
+    # in-topic similarity must beat cross-topic similarity
+    vec = np.asarray(m.get("vectors"), np.float32)
+    idx = {w: i for i, w in enumerate(m.get("vocab"))}
+
+    def cos(a, b):
+        va, vb = vec[idx[a]], vec[idx[b]]
+        return float(va @ vb / (np.linalg.norm(va) * np.linalg.norm(vb)))
+
+    in_topic = np.mean([cos("pizza", "pasta"), cos("cpu", "gpu"),
+                        cos("cheese", "tomato"), cos("memory", "cache")])
+    cross = np.mean([cos("pizza", "cpu"), cos("pasta", "gpu"),
+                     cos("basil", "compiler")])
+    assert in_topic > cross + 0.2, (in_topic, cross)
+
+    # document transform: mean of in-vocab word vectors
+    out = m.transform(DataFrame.from_dict(
+        {"text": np.asarray(["pizza cheese", "zzz-unknown"], dtype=object)}))
+    feats = out.collect()["features"]
+    want = (vec[idx["pizza"]] + vec[idx["cheese"]]) / 2
+    np.testing.assert_allclose(np.asarray(feats[0]), want, rtol=1e-5)
+    assert np.allclose(np.asarray(feats[1]), 0.0)  # OOV doc -> zero vector
+
+
+def test_word2vec_synonyms_and_persistence(tmp_path):
+    m = Word2Vec(input_col="text", output_col="features", vector_size=16,
+                 max_iter=3, min_count=1, seed=2).fit(_corpus(seed=3))
+    syn = m.find_synonyms("pizza", num=3)
+    assert len(syn) == 3 and all(isinstance(s, float) for _, s in syn)
+    food = {"pasta", "cheese", "tomato", "basil", "oven"}
+    assert {w for w, _ in syn} <= food, syn  # neighbours stay in-topic
+    with pytest.raises(KeyError):
+        m.find_synonyms("nonexistent-token")
+
+    save(m, str(tmp_path / "w2v"))
+    m2 = load(str(tmp_path / "w2v"))
+    assert isinstance(m2, Word2VecModel)
+    np.testing.assert_allclose(np.asarray(m2.get("vectors")),
+                               np.asarray(m.get("vectors")))
+
+
+def test_word2vec_tokenized_input_and_validation():
+    # pre-tokenized list columns pass through untouched
+    docs = np.empty(2, dtype=object)
+    docs[0] = ["a", "b", "a", "b", "a", "b"]
+    docs[1] = ["b", "a", "b", "a", "b", "a"]
+    df = DataFrame.from_dict({"toks": docs})
+    m = Word2Vec(input_col="toks", output_col="v", vector_size=4,
+                 min_count=1, max_iter=1).fit(df)
+    assert sorted(m.get("vocab")) == ["a", "b"]
+    with pytest.raises(ValueError, match="vocabulary"):
+        Word2Vec(input_col="toks", output_col="v", min_count=99).fit(df)
