@@ -5,8 +5,10 @@ native ML engines (LightGBM, VowpalWabbit, CNTK, OpenCV), web services, and
 serving infrastructure.  This package re-creates that capability surface
 TPU-first:
 
-- compute is JAX/XLA (jit, shard_map over a `jax.sharding.Mesh`), with Pallas
-  kernels for the hot ops (histogram builds, ring attention);
+- compute is JAX/XLA (jit, shard_map over a `jax.sharding.Mesh`); the hot
+  ops are formulated MXU-first (histograms as one-hot matmul contractions,
+  blockwise ring attention) and left to XLA to fuse — a hand-written Pallas
+  histogram kernel was raced and retired (PARITY.md);
 - cross-device communication is XLA collectives over ICI/DCN (`psum`,
   `all_gather`, `ppermute`) instead of the reference's socket allreduce rings
   (LightGBM ring, VW spanning tree — see reference `TrainUtils.scala:236-343`,
@@ -20,7 +22,7 @@ Layout mirrors the reference's module map (SURVEY.md §1-2):
 - ``core``      — DataFrame, Params, Pipeline, serialization (ref L1)
 - ``utils``     — cluster topology, stopwatch, fault tolerance (ref L1)
 - ``parallel``  — device-mesh bootstrap, shardings, collectives, ring attention
-- ``ops``       — Pallas/XLA kernels (histogram, segment ops, image, hashing)
+- ``ops``       — XLA kernels (histogram, segment ops, image, hashing)
 - ``models``    — flax model zoo (ResNet, BiLSTM, transformer) + GBDT booster
 - ``lightgbm``  — LightGBMClassifier/Regressor/Ranker (ref ``lightgbm/``)
 - ``vw``        — VowpalWabbit learners + featurizer (ref ``vw/``)

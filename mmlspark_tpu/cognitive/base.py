@@ -29,6 +29,8 @@ from ..io.http import AsyncHTTPClient, HTTPRequestData, HTTPResponseData
 class CognitiveServicesBase(Transformer, HasOutputCol):
     subscription_key = ServiceParam("subscription_key", "API key (value or column)")
     url = Param("url", "full endpoint URL", "string")
+    location = Param("location", "Azure region; endpoint URL is resolved from "
+                     "it at request-build time", "string")
     error_col = Param("error_col", "error output column", "string", default="error")
     concurrency = Param("concurrency", "max in-flight requests", "int", default=4)
     timeout = Param("timeout", "per-request timeout seconds", "float", default=60.0)
@@ -46,9 +48,25 @@ class CognitiveServicesBase(Transformer, HasOutputCol):
 
     # ------------------------------------------------------------- url setup
     def set_location(self, location: str):
-        """Reference HasSetLocation (:244): region -> standard endpoint."""
-        self.set("url", f"https://{location}.{self._service}{self._url_path}")
+        """Reference HasSetLocation (:244): region -> standard endpoint.
+
+        Only the region is stored; the URL is resolved lazily by
+        ``_base_url`` so params that feed ``_url_path`` (e.g.
+        RecognizeDomainSpecificContent.model) can be set in any order."""
+        self.set("location", location)
         return self
+
+    def _base_url(self) -> str:
+        """Endpoint resolved at request-build time: an explicitly set ``url``
+        wins; otherwise it is recomputed from location + the CURRENT
+        ``_url_path`` so param-set order cannot leave a stale endpoint."""
+        url = self.get("url")
+        if url is not None:
+            return url
+        loc = self.get("location")
+        if loc is not None:
+            return f"https://{loc}.{self._service}{self._url_path}"
+        return self.get_or_fail("url")  # raises the standard missing-param error
 
     def set_linked_service(self, name: str):
         """Accepted for parity (reference HasSetLinkedService:223 resolves

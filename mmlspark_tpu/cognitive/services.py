@@ -36,7 +36,7 @@ class _TextAnalyticsBase(CognitiveServicesBase):
         langs = lang if isinstance(lang, (list, tuple)) else [lang] * len(texts)
         docs = [{"id": str(i), "text": t, "language": l}
                 for i, (t, l) in enumerate(zip(texts, langs))]
-        return HTTPRequestData.post_json(self.get_or_fail("url"),
+        return HTTPRequestData.post_json(self._base_url(),
                                          {"documents": docs},
                                          self._headers(row))
 
@@ -89,7 +89,7 @@ class _ImageServiceBase(CognitiveServicesBase):
         return self._image_request(row, self._full_url(row))
 
     def _full_url(self, row: Row) -> str:
-        return self.get_or_fail("url")
+        return self._base_url()
 
 
 class OCR(_ImageServiceBase):
@@ -97,7 +97,7 @@ class OCR(_ImageServiceBase):
     detect_orientation = Param("detect_orientation", "detect text orientation", "bool", default=True)
 
     def _full_url(self, row):
-        return f"{self.get_or_fail('url')}?detectOrientation={str(self.get('detect_orientation')).lower()}"
+        return f"{self._base_url()}?detectOrientation={str(self.get('detect_orientation')).lower()}"
 
 
 class AnalyzeImage(_ImageServiceBase):
@@ -106,7 +106,7 @@ class AnalyzeImage(_ImageServiceBase):
                             default=["Categories", "Tags", "Description"])
 
     def _full_url(self, row):
-        return f"{self.get_or_fail('url')}?visualFeatures={','.join(self.get('visual_features'))}"
+        return f"{self._base_url()}?visualFeatures={','.join(self.get('visual_features'))}"
 
 
 class DescribeImage(_ImageServiceBase):
@@ -114,7 +114,7 @@ class DescribeImage(_ImageServiceBase):
     max_candidates = Param("max_candidates", "caption candidates", "int", default=1)
 
     def _full_url(self, row):
-        return f"{self.get_or_fail('url')}?maxCandidates={self.get('max_candidates')}"
+        return f"{self._base_url()}?maxCandidates={self.get('max_candidates')}"
 
 
 class TagImage(_ImageServiceBase):
@@ -128,8 +128,9 @@ class RecognizeText(_ImageServiceBase):
 class RecognizeDomainSpecificContent(_ImageServiceBase):
     """Domain-model image analysis (celebrities/landmarks) — reference
     ``RecognizeDomainSpecificContent`` (Celebrity Quote Analysis notebook).
-    The domain model is part of the endpoint path, so set ``model`` BEFORE
-    ``set_location`` (or pass the full ``url`` directly)."""
+    The domain model is part of the endpoint path; the URL is resolved at
+    request-build time, so ``model`` and ``set_location`` may be set in any
+    order."""
     model = Param("model", "domain model name (celebrities|landmarks)",
                   "string", default="celebrities")
 
@@ -145,7 +146,7 @@ class GenerateThumbnails(_ImageServiceBase):
     smart_cropping = Param("smart_cropping", "smart crop", "bool", default=True)
 
     def _full_url(self, row):
-        return (f"{self.get_or_fail('url')}?width={self.get('width')}"
+        return (f"{self._base_url()}?width={self.get('width')}"
                 f"&height={self.get('height')}&smartCropping="
                 f"{str(self.get('smart_cropping')).lower()}")
 
@@ -164,7 +165,7 @@ class DetectFace(_ImageServiceBase):
     def _full_url(self, row):
         attrs = ",".join(self.get("return_face_attributes") or [])
         suffix = f"?returnFaceAttributes={attrs}" if attrs else ""
-        return self.get_or_fail("url") + suffix
+        return self._base_url() + suffix
 
 
 class _JsonBodyService(CognitiveServicesBase):
@@ -175,7 +176,7 @@ class _JsonBodyService(CognitiveServicesBase):
         body = self._resolve_service("body", row)
         if body is None:
             return None
-        return HTTPRequestData.post_json(self.get_or_fail("url"), body,
+        return HTTPRequestData.post_json(self._base_url(), body,
                                          self._headers(row))
 
 
@@ -213,7 +214,7 @@ class _AnomalyBase(CognitiveServicesBase):
         sens = self._resolve_service("sensitivity", row)
         if sens is not None:
             body["sensitivity"] = sens
-        return HTTPRequestData.post_json(self.get_or_fail("url"), body,
+        return HTTPRequestData.post_json(self._base_url(), body,
                                          self._headers(row))
 
 
@@ -254,7 +255,7 @@ class _TranslatorBase(CognitiveServicesBase):
                                          self._headers(row))
 
     def _full_url(self, row):
-        return self.get_or_fail("url")
+        return self._base_url()
 
 
 class Translate(_TranslatorBase):
@@ -263,7 +264,7 @@ class Translate(_TranslatorBase):
     def _full_url(self, row):
         to = self._resolve_service("to_language", row, "en")
         tos = to if isinstance(to, (list, tuple)) else [to]
-        return self.get_or_fail("url") + "".join(f"&to={t}" for t in tos)
+        return self._base_url() + "".join(f"&to={t}" for t in tos)
 
 
 class Transliterate(_TranslatorBase):
@@ -327,7 +328,7 @@ class SpeechToText(CognitiveServicesBase):
         fmt = self._resolve_service("format", row, "simple")
         headers = self._headers(row)
         headers["Content-Type"] = "audio/wav; codecs=audio/pcm; samplerate=16000"
-        url = f"{self.get_or_fail('url')}?language={lang}&format={fmt}"
+        url = f"{self._base_url()}?language={lang}&format={fmt}"
         return HTTPRequestData(url=url, method="POST", headers=headers,
                                entity=bytes(audio))
 
@@ -348,7 +349,7 @@ class BingImageSearch(CognitiveServicesBase):
         if q is None:
             return None
         import urllib.parse
-        url = (f"{self.get_or_fail('url')}?q={urllib.parse.quote(str(q))}"
+        url = (f"{self._base_url()}?q={urllib.parse.quote(str(q))}"
                f"&count={self.get('count')}&offset={self.get('offset')}")
         return HTTPRequestData(url=url, method="GET", headers=self._headers(row))
 

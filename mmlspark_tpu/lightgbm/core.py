@@ -415,9 +415,9 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
     from ..models.gbdt import perfect_tree_children
     from ..ops import histogram as hist_ops
 
-    def hist(binned, g, h, node, num_nodes):
+    def hist(binned, g, h, node, num_nodes, max_rows=None):
         out = hist_ops.build(binned, g, h, node, num_nodes, num_bins,
-                             backend=backend)
+                             backend=backend, max_rows=max_rows)
         if axis_name is not None:
             out = jax.lax.psum(out, axis_name)
         return out
@@ -515,6 +515,7 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
         use_voting = axis_name is not None and 0 < voting_k < F
         prev_hist = None
         best_stats = None
+        small_left = None      # set per level; read from the NEXT level on
         for d in range(D):
             nodes_d = 2 ** d
             off = nodes_d - 1                       # BFS offset of this level
@@ -566,13 +567,26 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
                     hist_d = hist(binned, grad, hess,
                                   jnp.where(hist_mask, node, -1), 1)
                 else:
-                    # sibling-subtraction (LightGBM's histogram halving):
-                    # scatter only rows in LEFT children, right = parent - left
-                    left_node = jnp.where(hist_mask & (node % 2 == 0),
-                                          node // 2, -1)
-                    hist_left = hist(binned, grad, hess, left_node, nodes_d // 2)
-                    hist_right = prev_hist - hist_left
-                    hist_d = jnp.stack([hist_left, hist_right], axis=1) \
+                    # sibling-subtraction with LightGBM's SMALLER-child rule:
+                    # scatter only each parent's smaller child (by the
+                    # previous level's split counts), sibling = parent -
+                    # small.  At most floor(n/2) rows are ever scattered,
+                    # which — single-shard — is a STATIC bound that truncates
+                    # the matmul backend's block scan to half the blocks
+                    # (sharded: a shard's rows may concentrate in globally
+                    # smaller children, so no bound is claimed there).
+                    is_left = node % 2 == 0
+                    in_small = is_left == small_left[node // 2]
+                    small_node = jnp.where(hist_mask & in_small,
+                                           node // 2, -1)
+                    cap = None if axis_name is not None else n // 2 + nodes_d
+                    hist_small = hist(binned, grad, hess, small_node,
+                                      nodes_d // 2, max_rows=cap)
+                    hist_sib = prev_hist - hist_small
+                    sl4 = small_left[:, None, None, None]
+                    hist_d = jnp.stack(
+                        [jnp.where(sl4, hist_small, hist_sib),
+                         jnp.where(sl4, hist_sib, hist_small)], axis=1) \
                         .reshape(nodes_d, F, B, 3)
                 prev_hist = hist_d
                 gain, pick, (Gp0, Hp0, Cp0) = split_gains(
@@ -613,6 +627,9 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
             left_stats = jnp.where(do_split[:, None], bsel, tot3)
             right_stats = tot3 - left_stats
             best_stats = (left_stats, right_stats, do_split, tot3)
+            # the next level scatters only each parent's smaller child
+            # (unsplit parents: right is empty -> small, contributing 0 rows)
+            small_left = left_stats[:, 2] <= right_stats[:, 2]
 
             # route all rows (bagged-out rows too: they need leaf ids for scores)
             f_of_row = bf[node]

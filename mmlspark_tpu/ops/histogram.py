@@ -113,8 +113,8 @@ def bin_matrix(x: jnp.ndarray, edges: jnp.ndarray, num_bins: int) -> jnp.ndarray
 # ---------------------------------------------------------------------------
 
 def _node_pure_layout(binned, grad, hess, node_ids, num_nodes, R,
-                      sample_weight=None, residuals=True):
-    """Shared host/device prep for the MXU + Pallas histogram backends:
+                      sample_weight=None, residuals=True, max_rows=None):
+    """Shared host/device prep for the MXU histogram backend:
     sort rows by node and pad so every R-row block is node-pure, then build
     the bf16x2-decomposed weight channels (``residuals=False`` keeps just
     bf16-rounded grad/hess + count — 3 channels instead of 5).
@@ -122,6 +122,17 @@ def _node_pure_layout(binned, grad, hess, node_ids, num_nodes, R,
     Returns (bb_all (N_pad, F) u8, w_ch (5 or 3, N_pad) f32, node_blk (NB,)
     i32, NB).  Masked rows (node < 0) land in dummy node P whose buffer is
     dropped by the caller.
+
+    ``max_rows`` is a STATIC caller GUARANTEE that at most that many rows
+    are unmasked (node >= 0).  It truncates the padded layout — and with it
+    the block scan — to ``ceil(max_rows/R) + P + 1`` blocks instead of
+    covering all n rows; surplus masked rows fall off the end of the
+    (smaller) scatter and are dropped.  The level-wise grower uses this with
+    LightGBM's smaller-child rule: levels below the root only ever scatter
+    the smaller sibling of each parent (<= n/2 rows total), halving the
+    one-hot operand traffic of every build after the root.  If the caller's
+    guarantee is violated, UNMASKED rows are silently dropped — callers must
+    pass a true bound.
     """
     import jax
     import jax.numpy as jnp
@@ -141,13 +152,14 @@ def _node_pure_layout(binned, grad, hess, node_ids, num_nodes, R,
                                  num_segments=P + 1)
     start = jnp.concatenate([jnp.zeros((1,), jnp.int32),
                              jnp.cumsum(counts)[:-1]])
-    # every node gets AT LEAST one (possibly all-padding) block: the Pallas
-    # backend zero-initialises a node's output buffer on its first block
-    # visit, so an empty node with no blocks would keep uninitialized memory
-    padded_counts = jnp.maximum(((counts + R - 1) // R) * R, R)
+    # empty nodes get ZERO blocks (their buffer stays at acc0's zeros);
+    # node_blk's searchsorted('right')-1 naturally skips past zero-width
+    # offsets to the node that actually owns the rows
+    padded_counts = ((counts + R - 1) // R) * R
     padded_off = jnp.concatenate([jnp.zeros((1,), jnp.int32),
                                   jnp.cumsum(padded_counts)[:-1]])
-    N_pad = ((n + R - 1) // R + P + 1) * R           # static upper bound, R-aligned
+    n_cap = n if max_rows is None else min(n, int(max_rows))
+    N_pad = ((n_cap + R - 1) // R + P + 1) * R       # static upper bound, R-aligned
     rank = jnp.arange(n, dtype=jnp.int32) - start[ns]
     pos = padded_off[ns] + rank
     padded_idx = jnp.full((N_pad,), -1, jnp.int32).at[pos].set(order)
@@ -182,7 +194,8 @@ def build_histograms_matmul(binned: jnp.ndarray, grad: jnp.ndarray,
                             sample_weight: Optional[jnp.ndarray] = None,
                             block_rows: int = 4096,
                             lo_width: int = 0,
-                            residuals: bool = True) -> jnp.ndarray:
+                            residuals: bool = True,
+                            max_rows: Optional[int] = None) -> jnp.ndarray:
     """Histogram build as batched one-hot matmuls on the MXU.
 
     TPU scatter runs ~100M updates/s — far below what the n*F histogram pass
@@ -208,7 +221,9 @@ def build_histograms_matmul(binned: jnp.ndarray, grad: jnp.ndarray,
     channels (the MXU time is invariant to the split — M*N stays C*B);
     ``residuals=False`` drops the two bf16-residual channels (inputs round
     to bf16, accumulation stays exact f32 — LightGBM's own histograms are
-    f32) for another ~40% operand-traffic cut.
+    f32) for another ~40% operand-traffic cut; ``max_rows`` (a static caller
+    guarantee on the unmasked row count — see ``_node_pure_layout``)
+    truncates the scan itself, LightGBM's smaller-child halving.
     """
     import jax
     import jax.numpy as jnp
@@ -229,7 +244,7 @@ def build_histograms_matmul(binned: jnp.ndarray, grad: jnp.ndarray,
 
     bb_all, w_ch, node_blk, NB = _node_pure_layout(
         binned, grad, hess, node_ids, num_nodes, R, sample_weight,
-        residuals=residuals)
+        residuals=residuals, max_rows=max_rows)
     C = w_ch.shape[0]                                # 5 or 3 channels
 
     hi_iota = jnp.arange(HI, dtype=jnp.int32)
@@ -267,27 +282,29 @@ def build_histograms_matmul(binned: jnp.ndarray, grad: jnp.ndarray,
 
 
 def build(binned, grad, hess, node_ids, num_nodes, num_bins,
-          sample_weight=None, backend: str = "auto"):
+          sample_weight=None, backend: str = "auto", max_rows=None):
     """Backend dispatcher.  'auto' picks the MXU matmul build on accelerator
     platforms (13x faster than scatter on v5e, measured) and the scatter
-    build on CPU (where one-hot matmuls lose).  'pallas' selects the fused
-    VMEM kernel (``pallas_histogram.py``; interpret-mode on CPU); override
-    via MMLSPARK_TPU_HIST_BACKEND."""
+    build on CPU (where one-hot matmuls lose).  A hand-written Pallas VMEM
+    kernel was evaluated in rounds 3-4 and RETIRED in round 5 — it lost the
+    end-to-end shootout 3.5x to this XLA matmul formulation and carried a
+    ~1%% grad-channel deviation under Mosaic lowering (decision recorded in
+    PARITY.md); override the surviving backends via
+    MMLSPARK_TPU_HIST_BACKEND=matmul|scatter."""
     import os
     if backend == "auto":  # env override only applies when the caller did
         backend = os.environ.get("MMLSPARK_TPU_HIST_BACKEND", backend)
         # not request a specific backend (ADVICE r2)
+    if backend == "pallas":
+        raise ValueError(
+            "the Pallas histogram backend was retired in round 5 (lost the "
+            "end-to-end shootout to the XLA matmul build; see PARITY.md) — "
+            "use backend='matmul' or 'scatter'")
     if backend == "auto":
         backend = "scatter" if jax.default_backend() == "cpu" else "matmul"
     # MXU tuning knobs (read at trace time; train() keys its jit caches on
     # them): block size, lo one-hot width, residual channels on/off
     block_rows = int(os.environ.get("MMLSPARK_TPU_HIST_BLOCK_ROWS", "0")) or None
-    if backend == "pallas":
-        from .pallas_histogram import build_histograms_pallas
-        kw = {"block_rows": block_rows} if block_rows else {}
-        return build_histograms_pallas(
-            binned, grad, hess, node_ids, num_nodes, num_bins, sample_weight,
-            interpret=jax.default_backend() == "cpu", **kw)
     if backend == "matmul":
         kw = {}
         if block_rows:
@@ -299,6 +316,7 @@ def build(binned, grad, hess, node_ids, num_nodes, num_bins,
             kw["residuals"] = False
         return build_histograms_matmul(binned, grad, hess, node_ids,
                                        num_nodes, num_bins, sample_weight,
-                                       **kw)
+                                       max_rows=max_rows, **kw)
+    # scatter drops masked rows natively; the max_rows bound is a no-op there
     return build_histograms(binned, grad, hess, node_ids, num_nodes, num_bins,
                             sample_weight)

@@ -262,3 +262,20 @@ def test_vw_classifier_extreme_margin_no_overflow():
         out = model.transform(scaled).collect()
     probs = np.stack(list(out["probability"]))
     assert np.isfinite(probs).all()
+
+
+def test_domain_specific_content_url_resolved_lazily():
+    """ADVICE r4: set('model', ...) AFTER set_location must not leave a stale
+    'celebrities' endpoint — the URL is resolved at request-build time."""
+    from mmlspark_tpu.cognitive.services import RecognizeDomainSpecificContent
+    t = RecognizeDomainSpecificContent()
+    t.set_location("eastus")
+    t.set("model", "landmarks")
+    url = t._base_url()
+    assert "/models/landmarks/analyze" in url, url
+    assert "celebrities" not in url
+    # explicit url always wins over location
+    t2 = RecognizeDomainSpecificContent()
+    t2.set("url", "https://custom.example/v1")
+    t2.set_location("eastus")
+    assert t2._base_url() == "https://custom.example/v1"

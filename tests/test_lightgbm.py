@@ -194,6 +194,30 @@ def test_histogram_backends_agree():
                 m2[..., 2] - jnp.round(m2[..., 2])))) == 0.0
 
 
+def test_histogram_max_rows_compaction_exact():
+    """The smaller-child static bound (max_rows) must be exact whenever the
+    caller's guarantee holds — including at the boundary and with heavily
+    masked inputs (the level-wise grower's smaller-child builds)."""
+    import jax.numpy as jnp
+    from mmlspark_tpu.ops.histogram import build_histograms, build_histograms_matmul
+    rng = np.random.default_rng(3)
+    n, f, b, p = 4000, 7, 255, 8
+    binned = jnp.asarray(rng.integers(0, b, (n, f)).astype(np.uint8))
+    g = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.1, 1, n).astype(np.float32))
+    # mask ~70% of rows out: unmasked count <= n//2 like a smaller-child pass
+    node_np = rng.integers(0, p, n).astype(np.int32)
+    keep = rng.uniform(size=n) < 0.3
+    node_np[~keep] = -1
+    unmasked = int((node_np >= 0).sum())
+    node = jnp.asarray(node_np)
+    ref = build_histograms(binned, g, h, node, p, b)
+    for cap in (unmasked, unmasked + 1, n // 2, n):
+        m = build_histograms_matmul(binned, g, h, node, p, b,
+                                    block_rows=256, max_rows=cap)
+        assert float(jnp.max(jnp.abs(ref - m))) < 1e-3, cap
+
+
 def test_histogram_env_knobs_drive_training(monkeypatch):
     # the env-tuned matmul path must produce an equivalent booster through
     # the full train() flow (the jit cache is keyed on the knobs)

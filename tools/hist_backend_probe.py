@@ -1,9 +1,10 @@
 """On-chip histogram-backend shootout (VERDICT r2 "do this" #1 tail).
 
 Times one depth-5 binary-objective boosting iteration END TO END per
-backend (scatter / matmul / pallas) at the bench shape (1M x 200, 255
-bins) on whatever platform jax resolves (run WITHOUT platform overrides to
-hit the TPU), plus the raw ``hist_ops.build`` kernel at level widths.
+backend (scatter / matmul) at the bench shape (1M x 200, 255 bins) on
+whatever platform jax resolves (run WITHOUT platform overrides to hit the
+TPU), plus the raw ``hist_ops.build`` kernel at level widths.  (The Pallas
+backend was retired in round 5 — see PARITY.md.)
 
 Relay-safe: single process, no external kills expected — run it detached
 (`nohup python tools/hist_backend_probe.py > probe.log 2>&1 &`) and read
@@ -44,7 +45,7 @@ def main():
     binned = jnp.asarray(rng.integers(0, B, size=(n, f), dtype=np.uint8))
     g = jnp.asarray(rng.normal(size=n).astype(np.float32))
     h = jnp.ones((n,), jnp.float32)
-    for backend in ("scatter", "matmul", "pallas"):
+    for backend in ("scatter", "matmul"):
         for nodes in (1, 16):
             node = jnp.asarray(rng.integers(0, nodes, size=n,
                                             dtype=np.int32))
@@ -67,14 +68,14 @@ def main():
                                   "compile_s": round(compile_s, 1),
                                   "build_ms": round(1000 * dt, 2)}),
                       flush=True)
-            except Exception as e:  # noqa: BLE001 — e.g. pallas lowering
+            except Exception as e:  # noqa: BLE001 — e.g. lowering failure
                 print(json.dumps({"probe": "raw", "backend": backend,
                                   "nodes": nodes,
                                   "error": f"{type(e).__name__}: {e}"[:300]}),
                       flush=True)
 
     # end-to-end: marginal boosting rate per backend (bench.py formula)
-    for backend in ("matmul", "scatter", "pallas"):
+    for backend in ("matmul", "scatter"):
         os.environ["MMLSPARK_TPU_HIST_BACKEND"] = backend
         try:
             t0 = time.perf_counter()
