@@ -10,9 +10,12 @@ BASELINE.md).  ResNet-50 featurize images/sec/chip rides in the extras.
 Resilience design (round 2, after BENCH_r01 ended rc=124 / parsed=null):
 
 - The PARENT process never touches the device.  Every TPU phase runs in a
-  child process with a parent-side wall-clock kill: a wedged device relay
-  (observed: jax.devices() itself can block forever, and SIGALRM cannot
-  preempt a blocked relay RPC) costs one child, never the bench.
+  child process; the parent streams the child's merged output and kills
+  ONLY on silence (round-4 post-mortem: a wall-clock kill landed mid-compile
+  and wedged the relay for hours, costing every later phase AND the next
+  session's runs).  The idle window is sized past the longest observed
+  compile, so a kill now implies the child was already hung or the relay
+  already wedged.
 - A valid JSON result line is printed after EVERY phase, so an outer
   timeout can never erase completed measurements.
 - A 120s health-check child gates the TPU phases: if a trivial matmul
@@ -22,8 +25,13 @@ Resilience design (round 2, after BENCH_r01 ended rc=124 / parsed=null):
   shapes match __graft_entry__.entry() exactly so the driver's compile
   check pre-warms the cache.
 - The CPU probe runs pinned to the CPU platform with sitecustomize TPU
-  hooks scrubbed, concurrent only with the ResNet phase (host contention
-  would skew the GBDT phase's host-side binning).
+  hooks scrubbed, FIRST and STRICTLY ALONE (VERDICT r4 weak #1: the host is
+  one Xeon core — any concurrent phase halves the denominator), median-of-3
+  with the host fingerprint (nproc/model/load) stamped into extras.
+- TPU phases that miss their (compile-aware) deadline get ONE retry — a
+  completed first-attempt compile lands in the persistent cache, making the
+  retry measurement-only — and a killed phase leaves a note in
+  extras.phase_notes instead of silence.
 - Timed loops vary their inputs every step and end with a host fetch: the
   relay can serve repeated (computation, args) pairs from cache without
   executing (.claude/skills/verify/SKILL.md).
@@ -125,9 +133,18 @@ def phase_gbdt(n=1_000_000, f=200, iters_a=8, iters_b=24, reps=3) -> None:
     print(f"GBDT_RPS {rates[len(rates) // 2]} {n}", flush=True)
 
 
-def phase_resnet(batch=32, steps=10, hw=224) -> None:
-    """Same program as __graft_entry__.entry() (shapes, dtype, step-scalar),
-    so the driver's compile check warms the persistent cache for this."""
+def phase_resnet(batch=256, steps=8, hw=224, reps=3) -> None:
+    """ResNet-50 featurize throughput (reference CNTKModel's flagship
+    inference path).  Round-3/4 measured 2544 img/s at batch 32 with one
+    relay dispatch per step — the ~10-100 ms per-dispatch relay latency
+    dominated the ~13 ms of compute, capping MFU at ~10% (VERDICT r4 #5).
+    Fixes here: batch 256 (MXU-filling), and the step loop moved INSIDE the
+    jitted program (lax.scan over per-step input perturbations — ONE relay
+    dispatch per timed rep, steps*batch images).  Each scan step perturbs
+    the batch and every rep shifts the offset: first-sight args per
+    dispatch, so the relay result-cache cannot serve repeats.  Prints
+    images/sec and model FLOPs utilization (4.09 GFLOP/img fwd at 224^2,
+    ~197 bf16 TFLOP/s peak per v5e chip)."""
     from __graft_entry__ import enable_compilation_cache
     enable_compilation_cache()
     import jax
@@ -142,20 +159,28 @@ def phase_resnet(batch=32, steps=10, hw=224) -> None:
                            jnp.float32, 0, 255)
 
     @jax.jit
-    def featurize(variables, batch, step):
-        return module.apply(variables, image_ops.normalize(batch + step),
-                            features=True)
+    def featurize_many(variables, x, step_offsets):
+        def body(acc, s):
+            f = module.apply(variables, image_ops.normalize(x + s),
+                             features=True)
+            return acc + f.astype(jnp.float32).mean(), None
+        acc, _ = jax.lax.scan(body, jnp.float32(0.0), step_offsets)
+        return acc
 
+    offs = jnp.arange(steps, dtype=jnp.float32)
     t0 = time.perf_counter()
-    float(featurize(variables, x, jnp.float32(-1.0)).sum())  # warm, forced
+    float(featurize_many(variables, x, offs - 7.0))  # warm, forced fetch
     _log(f"[bench] resnet warm(compile) {time.perf_counter() - t0:.0f}s")
-    t0 = time.perf_counter()
-    out = None
-    for i in range(steps):
-        out = featurize(variables, x, jnp.float32(i))  # distinct args/step
-    float(out.sum())  # drain the async dispatch queue
-    ips = batch * steps / (time.perf_counter() - t0)
-    print(f"IMAGES_SEC {ips}", flush=True)
+    rates = []
+    for r in range(1, reps + 1):
+        t0 = time.perf_counter()
+        float(featurize_many(variables, x, offs + 0.1 * r))
+        rates.append(batch * steps / (time.perf_counter() - t0))
+        _log(f"[bench] resnet rep img/s {rates[-1]:.0f}")
+    rates.sort()
+    ips = rates[len(rates) // 2]
+    mfu_pct = 100.0 * ips * 4.09e9 / 197e12
+    print(f"IMAGES_SEC {ips} {mfu_pct}", flush=True)
 
 
 def phase_ranker(n=200_000, f=50, group=100, iters_a=2, iters_b=8,
@@ -261,21 +286,53 @@ def phase_serving(n_requests=1000) -> None:
         srv.stop()
 
 
-def phase_cpu(n=200_000, f=200) -> None:
-    """CPU-executor baseline: identical trainer on the host CPU."""
+def phase_cpu(n=200_000, f=200, reps=3) -> None:
+    """CPU-executor baseline: identical trainer on the host CPU — run
+    STRICTLY ALONE (VERDICT r4 weak #1: on a 1-core host any concurrent
+    phase halves the denominator), median of ``reps`` marginal rates, with
+    the host fingerprint printed next to the number so the artifact records
+    what machine produced the denominator."""
+    import json as _json
     import numpy as np
     from mmlspark_tpu.lightgbm import GBDTParams, train
+
+    fp = {"nproc": os.cpu_count()}
+    try:
+        with open("/proc/cpuinfo") as fcpu:
+            for line in fcpu:
+                if line.startswith("model name"):
+                    fp["cpu_model"] = line.split(":", 1)[1].strip()
+                    break
+        fp["loadavg_1m"] = round(os.getloadavg()[0], 2)
+    except OSError:
+        pass
+    print(f"CPU_HOST {_json.dumps(fp)}", flush=True)
+
     rng = np.random.default_rng(0)
     X = rng.normal(size=(n, f)).astype(np.float32)
-    y = (X[:, 0] + 0.5 * X[:, 1] + rng.normal(scale=0.3, size=n) > 0).astype(np.float32)
-    train(X, y, GBDTParams(num_iterations=1, objective="binary", max_depth=5))
-    t0 = time.perf_counter()
-    train(X, y, GBDTParams(num_iterations=2, objective="binary", max_depth=5))
-    ta = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    train(X, y, GBDTParams(num_iterations=7, objective="binary", max_depth=5))
-    tb = time.perf_counter() - t0
-    print(f"CPU_RPS {n * 5 / max(tb - ta, 1e-9)}", flush=True)
+    y0 = (X[:, 0] + 0.5 * X[:, 1] + rng.normal(scale=0.3, size=n) > 0).astype(np.float32)
+    nonce = [0]
+
+    def fresh_y():  # same busting discipline as the TPU phases
+        nonce[0] += 1
+        y = y0.copy()
+        a = (37 * nonce[0]) % (n - 64)
+        y[a:a + 64] = 1.0 - y[a:a + 64]
+        return y
+
+    train(X, fresh_y(), GBDTParams(num_iterations=1, objective="binary", max_depth=5))
+    rates = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        train(X, fresh_y(), GBDTParams(num_iterations=2, objective="binary", max_depth=5))
+        ta = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        train(X, fresh_y(), GBDTParams(num_iterations=7, objective="binary", max_depth=5))
+        tb = time.perf_counter() - t0
+        rates.append(n * 5 / max(tb - ta, 1e-9))
+        _log(f"[bench] cpu rep rate {rates[-1]:.0f}")
+    rates.sort()
+    print(f"CPU_RPS {rates[len(rates) // 2]}", flush=True)
 
 
 # --------------------------------------------------------------------------
@@ -295,57 +352,102 @@ def _cpu_env() -> dict:
 
 
 def _spawn(phase: str, env: dict, extra_args=()) -> subprocess.Popen:
+    # stderr merges into the captured stdout so the parent's streaming
+    # reader can treat ANY child output (rep logs, jax warnings) as a sign
+    # of life; every line is echoed to the parent's stderr for live logs
     return subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--phase", phase,
          *extra_args],
-        cwd=_REPO, env=env, stdout=subprocess.PIPE, stderr=sys.stderr,
-        text=True)
+        cwd=_REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT)   # binary pipe: parent reads raw fd
 
 
-def _collect(proc: subprocess.Popen, marker: str, timeout: float):
-    """Wait for the child; return the marker line's floats or None.  A hung
-    child is killed — the relay may already be wedged at that point, and a
-    salvaged partial result beats an erased bench."""
-    try:
-        out, _ = proc.communicate(timeout=timeout)
-    except subprocess.TimeoutExpired:
-        proc.kill()
-        _log(f"[bench] phase {marker} timed out after {timeout:.0f}s; killed")
-        try:  # reap + salvage anything already printed (a child can finish
-            out, _ = proc.communicate(timeout=10)  # its work then wedge in
-        except Exception:  # noqa: BLE001          # relay teardown at exit)
-            return None
-    for line in (out or "").splitlines():
-        if line.startswith(marker):
-            return [float(v) for v in line.split()[1:]]
-    _log(f"[bench] phase {marker} exited rc={proc.returncode} without result")
-    return None
+def _collect_multi(proc: subprocess.Popen, markers, idle: float,
+                   hard: float = 1500.0) -> dict:
+    """Stream the child's merged output; return {marker: floats-or-raw}.
 
-
-def _collect_multi(proc: subprocess.Popen, markers, timeout: float) -> dict:
-    """Like _collect but salvages several marker lines from one child."""
-    try:
-        out, _ = proc.communicate(timeout=timeout)
-    except subprocess.TimeoutExpired:
-        proc.kill()
-        _log(f"[bench] phase {markers[0]} timed out after {timeout:.0f}s; killed")
-        try:
-            out, _ = proc.communicate(timeout=10)
-        except Exception:  # noqa: BLE001
-            return {}
+    Round-4 post-mortem: whole-phase kill deadlines landed MID-COMPILE and
+    wedged the device relay for hours (RANKER killed at 300s -> every later
+    TPU client blocked).  The parent therefore kills only on SILENCE: the
+    ``idle`` window (sized to cover the longest observed compile) resets on
+    every output line, so a child that is computing, compiling noisily, or
+    printing reps is never killed; a child that produces nothing for
+    ``idle`` seconds is either host-hung or behind a relay that is already
+    wedged — killing it then cannot make the relay worse.  ``hard`` is the
+    absolute backstop."""
+    import selectors
     got = {}
-    for line in (out or "").splitlines():
+
+    def parse(line):
         for m in markers:
             if line.startswith(m):
-                got[m] = [float(v) for v in line.split()[1:]]
+                rest = line[len(m):].strip()
+                try:
+                    got[m] = [float(v) for v in rest.split()]
+                except ValueError:   # non-numeric payload (e.g. JSON)
+                    got[m] = rest
+
+    # raw-fd reads with manual line splitting: readline() on a buffered
+    # wrapper can block on a partial line (disabling the deadline checks)
+    # and slurps lines select() then never reports again
+    fd = proc.stdout.fileno()
+    os.set_blocking(fd, False)
+    sel = selectors.DefaultSelector()
+    sel.register(proc.stdout, selectors.EVENT_READ)
+    buf = b""
+    t_start = last = time.perf_counter()
+    while True:
+        now = time.perf_counter()
+        if now - last > idle or now - t_start > hard:
+            proc.kill()
+            _log(f"[bench] phase {markers[0]} killed: "
+                 f"{'silent ' + str(round(now - last)) + 's' if now - last > idle else 'hard cap'}")
+            break
+        if not sel.select(timeout=5.0):
+            if proc.poll() is not None:
+                break
+            continue
+        try:
+            chunk = os.read(fd, 65536)
+        except BlockingIOError:
+            continue
+        if chunk == b"":                     # EOF: child exited
+            break
+        last = time.perf_counter()
+        sys.stderr.write(chunk.decode("utf-8", "replace"))
+        sys.stderr.flush()
+        buf += chunk
+        *lines, buf = buf.split(b"\n")
+        for raw in lines:
+            parse(raw.decode("utf-8", "replace"))
+    try:
+        rem = proc.communicate(timeout=10)[0]
+        for line in (buf + (rem or b"")).decode("utf-8", "replace").splitlines():
+            parse(line)
+    except Exception:  # noqa: BLE001
+        pass
     return got
+
+
+def _collect(proc: subprocess.Popen, marker: str, idle: float,
+             hard: float = 1500.0):
+    got = _collect_multi(proc, (marker,), idle, hard)
+    val = got.get(marker)
+    if val is None:
+        _log(f"[bench] phase {marker} ended rc={proc.returncode} without result")
+    return val
+
+
+def _note(phase: str, msg: str) -> None:
+    RESULT["extras"].setdefault("phase_notes", {})[phase] = msg
 
 
 def main() -> None:
     wall0 = time.perf_counter()
 
     # Phase 0 — relay health gate.
-    health = _collect(_spawn("health", _tpu_env()), "HEALTH_OK", 150)
+    health = _collect(_spawn("health", _tpu_env()), "HEALTH_OK", 150,
+                      hard=200)
     _log(f"[bench] health: {'ok' if health else 'FAILED'} "
          f"({time.perf_counter() - wall0:.0f}s)")
     tpu_ok = health is not None
@@ -355,15 +457,35 @@ def main() -> None:
             "in 150s); TPU phases skipped, CPU baseline only")
         _emit()
 
+    # Phase 1 — CPU-executor baseline, FIRST and STRICTLY ALONE (VERDICT r4
+    # weak #1: concurrency halves the denominator on a 1-core host).  It is
+    # host-only, so a sick relay cannot cost us the denominator either.
+    got = _collect_multi(_spawn("cpu", _cpu_env()), ("CPU_RPS", "CPU_HOST"),
+                         idle=350, hard=700)
+    cpu_rps = 0.0
+    if got.get("CPU_RPS"):
+        cpu_rps = got["CPU_RPS"][0]
+        RESULT["extras"]["cpu_executor_rows_per_sec"] = round(cpu_rps, 1)
+    else:
+        _note("cpu", "CPU baseline child died or stalled; no vs_baseline")
+    if isinstance(got.get("CPU_HOST"), str):
+        try:
+            RESULT["extras"]["cpu_host"] = json.loads(got["CPU_HOST"])
+        except ValueError:
+            pass
+    _emit()
+
     tpu_rps = 0.0
     if tpu_ok:
-        # Phase 1 — headline metric: GBDT rows/sec on the real chip.
-        got = _collect(_spawn("gbdt", _tpu_env()), "GBDT_RPS", 640)
+        # Phase 2 — headline metric: GBDT rows/sec on the real chip.
+        got = _collect(_spawn("gbdt", _tpu_env()), "GBDT_RPS", idle=600,
+                       hard=1200)
         if got is None:  # degraded fallback: quarter-size, same trainer
+            _note("gbdt", "1M run stalled/overran; retried quarter-size")
             got = _collect(_spawn("gbdt", _tpu_env(),
                                   ["--n", "250000", "--iters_b", "10",
                                    "--reps", "1"]),
-                           "GBDT_RPS", 240)
+                           "GBDT_RPS", idle=300, hard=500)
             if got:
                 RESULT["extras"]["note"] = (
                     "measured at 250k x 200 (1M run exceeded its deadline); "
@@ -371,45 +493,59 @@ def main() -> None:
         if got:
             tpu_rps = got[0]
             RESULT["value"] = round(tpu_rps, 1)
+            if cpu_rps:
+                RESULT["vs_baseline"] = round(tpu_rps / cpu_rps, 3)
+        else:
+            _note("gbdt", "both attempts failed; no TPU headline number")
         _emit()
 
-    # Phase 2 — CPU baseline launches now; concurrent only with ResNet.
-    cpu_proc = _spawn("cpu", _cpu_env())
-
-    if tpu_ok:
         # Phase 3 — LambdaRank iteration rate (device-resident lambdas).
-        got = _collect(_spawn("ranker", _tpu_env()), "RANKER_RPS", 300)
+        # Compile-aware deadline + one retry: the first attempt may spend
+        # its window inside a fresh XLA compile (r4: killed at 300s
+        # mid-compile, number lost).  A completed compile lands in the
+        # persistent cache, so a second attempt is measurement-only.
+        got = _collect(_spawn("ranker", _tpu_env()), "RANKER_RPS", idle=480,
+                       hard=900)
+        if got is None:
+            _note("ranker", "attempt 1 stalled (likely compile); retried")
+            # the retry gets a LARGER idle window: if attempt 1 died inside
+            # a silent fresh compile, a smaller window would deterministically
+            # kill the retry mid-compile too (the relay-wedge scenario)
+            got = _collect(_spawn("ranker", _tpu_env()), "RANKER_RPS",
+                           idle=700, hard=1000)
         if got:
             RESULT["extras"]["lambdarank_train_rows_per_sec_200kx50"] = \
                 round(got[0], 1)
+        else:
+            _note("ranker", "both attempts failed; no lambdarank number")
         _emit()
 
-        # Phase 4 — ResNet-50 featurize (riskiest compile last).
-        got = _collect(_spawn("resnet", _tpu_env()), "IMAGES_SEC", 240)
+        # Phase 4 — ResNet-50 featurize (same retry discipline).
+        got = _collect(_spawn("resnet", _tpu_env()), "IMAGES_SEC", idle=420,
+                       hard=800)
+        if got is None:
+            _note("resnet", "attempt 1 stalled (likely compile); retried")
+            got = _collect(_spawn("resnet", _tpu_env()), "IMAGES_SEC",
+                           idle=600, hard=900)
         if got:
             RESULT["extras"]["resnet50_featurize_images_per_sec_per_chip"] = \
                 round(got[0], 1)
+            if len(got) > 1:
+                RESULT["extras"]["resnet50_featurize_mfu_pct"] = round(got[1], 1)
+        else:
+            _note("resnet", "both attempts failed; no featurize number")
         _emit()
 
     # Phase 5 — serving latency + sustained load (pure host, CPU platform).
     sproc = _spawn("serving", _cpu_env())
-    got = _collect_multi(sproc, ("SERVING_P50_MS", "SERVING_LOAD"), 300)
+    got = _collect_multi(sproc, ("SERVING_P50_MS", "SERVING_LOAD"),
+                         idle=200, hard=400)
     if got.get("SERVING_P50_MS"):
         RESULT["extras"]["serving_http_p50_ms"] = round(got["SERVING_P50_MS"][0], 2)
         RESULT["extras"]["serving_http_p95_ms"] = round(got["SERVING_P50_MS"][1], 2)
     if got.get("SERVING_LOAD"):
         RESULT["extras"]["serving_sustained_rps_8conn"] = round(got["SERVING_LOAD"][0], 1)
         RESULT["extras"]["serving_sustained_p99_ms"] = round(got["SERVING_LOAD"][1], 2)
-    _emit()
-
-    # Phase 6 — collect the CPU baseline.
-    remaining = max(60.0, 900.0 - (time.perf_counter() - wall0))
-    got = _collect(cpu_proc, "CPU_RPS", remaining)
-    if got:
-        cpu_rps = got[0]
-        RESULT["extras"]["cpu_executor_rows_per_sec"] = round(cpu_rps, 1)
-        if tpu_rps:
-            RESULT["vs_baseline"] = round(tpu_rps / cpu_rps, 3)
     _emit()
     _log(f"[bench] done in {time.perf_counter() - wall0:.0f}s")
 

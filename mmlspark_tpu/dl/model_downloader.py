@@ -44,6 +44,8 @@ def _zoo() -> Dict[str, Callable[..., Any]]:
         "ResNet34": lambda **kw: resnet.resnet34(**kw),
         "ResNet50": lambda **kw: resnet.resnet50(**kw),
         "ResNet101": lambda **kw: resnet.resnet101(**kw),
+        "ShapesResNet20": lambda **kw: resnet.cifar_resnet20(
+            num_classes=kw.pop("num_classes", 10), **kw),
         "BiLSTM": lambda **kw: bilstm.BiLSTMTagger(
             vocab_size=kw.pop("vocab_size", 32768), num_tags=kw.pop("num_tags", 32), **kw),
     }

@@ -1149,7 +1149,8 @@ def _resolve_hist_backend() -> tuple:
     return (os.environ.get("MMLSPARK_TPU_HIST_BACKEND", "auto"),
             os.environ.get("MMLSPARK_TPU_HIST_BLOCK_ROWS", ""),
             os.environ.get("MMLSPARK_TPU_HIST_LO", ""),
-            os.environ.get("MMLSPARK_TPU_HIST_RESID", ""))
+            os.environ.get("MMLSPARK_TPU_HIST_RESID", ""),
+            os.environ.get("MMLSPARK_TPU_HIST_LAYOUT", ""))
 
 
 def _make_grower(p: GBDTParams, F: int, B: int, axis_name: str = None,
@@ -1383,11 +1384,18 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
             tree_out.append((lch, rch, sf, th, tb, sg, iv, ic, lv_s, lc, cbs))
         return scores, tree_out
 
+    # scores is donated: each iteration consumes the previous score buffer
+    # in place instead of allocating a fresh (n, K) f32 per dispatch.  The
+    # use_pre=False variant binds g_pre/h_pre statically to None so the
+    # donated scores buffer is never also passed as another (aliased) arg.
     _iter_jit = {} if shard_rows else {
         False: _cached(("iter", sig, F, K, n, False),
-                       lambda: jax.jit(partial(_iter_body, use_pre=False))),
+                       lambda: jax.jit(partial(_iter_body, g_pre=None,
+                                               h_pre=None, use_pre=False),
+                                       donate_argnums=(0,))),
         True: _cached(("iter", sig, F, K, n, True),
-                      lambda: jax.jit(partial(_iter_body, use_pre=True)))}
+                      lambda: jax.jit(partial(_iter_body, use_pre=True),
+                                      donate_argnums=(0,)))}
 
     import jax.random as jrandom
     jit_objective = jax.jit(objective) if objective is not None else None
@@ -1458,7 +1466,7 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
             (scores_c, t), stacked = jax.lax.scan(body, (scores_c, t0), keys)
             return scores_c, stacked
 
-        return jax.jit(multi)
+        return jax.jit(multi, donate_argnums=(0,))
 
     multi_iter = _cached(("multi", sig, F, K, n, CH), _build_multi) if chunk_ok else None
 
@@ -1578,11 +1586,14 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
 
         if not shard_rows:
             use_pre = g_pre is not None
-            gp = g_pre if use_pre else scores
-            hp = h_pre if use_pre else scores
-            scores, tree_out = _iter_jit[use_pre](
-                scores, y_dev, w_dev, binned, base_mask, feat_mask, edges,
-                grad_scale, new_w, key, gp, hp)
+            if use_pre:
+                scores, tree_out = _iter_jit[True](
+                    scores, y_dev, w_dev, binned, base_mask, feat_mask,
+                    edges, grad_scale, new_w, key, g_pre, h_pre)
+            else:
+                scores, tree_out = _iter_jit[False](
+                    scores, y_dev, w_dev, binned, base_mask, feat_mask,
+                    edges, grad_scale, new_w, key)
         else:
             # multi-chip path: explicit shard_map grower per class
             if g_pre is not None:

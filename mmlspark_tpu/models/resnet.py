@@ -75,6 +75,7 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     num_filters: int = 64
     dtype: Any = jnp.float32
+    cifar_stem: bool = False   # 3x3/1 stem, no maxpool (32x32-scale inputs)
 
     @nn.compact
     def __call__(self, x, train: bool = False, features: bool = False):
@@ -82,11 +83,17 @@ class ResNet(nn.Module):
         norm = partial(nn.BatchNorm, use_running_average=not train,
                        momentum=0.9, epsilon=1e-5, dtype=self.dtype)
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
-                 use_bias=False, name="conv_init")(x)
+        if self.cifar_stem:
+            x = conv(self.num_filters, (3, 3), (1, 1), padding=[(1, 1), (1, 1)],
+                     use_bias=False, name="conv_init")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2),
+                     padding=[(3, 3), (3, 3)], use_bias=False,
+                     name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        if not self.cifar_stem:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
@@ -113,3 +120,13 @@ def resnet50(num_classes: int = 1000, dtype=jnp.float32) -> ResNet:
 
 def resnet101(num_classes: int = 1000, dtype=jnp.float32) -> ResNet:
     return ResNet([3, 4, 23, 3], BottleneckBlock, num_classes, dtype=dtype)
+
+
+def cifar_resnet20(num_classes: int = 10, width: int = 32,
+                   dtype=jnp.float32) -> ResNet:
+    """CIFAR-scale ResNet-20 (He et al. §4.2 topology: 3 stages x 3 basic
+    blocks, 3x3 stem, no maxpool) — the trainable-in-this-container backbone
+    behind the committed model-repo checkpoint (ModelDownloader.scala:112
+    ships pretrained artifacts; zero egress means ours is trained in-tree)."""
+    return ResNet([3, 3, 3], BasicBlock, num_classes, num_filters=width,
+                  cifar_stem=True, dtype=dtype)

@@ -1,0 +1,63 @@
+"""The bench parent's streaming collector is load-bearing for the round
+artifact (BENCH_r0N.json), so its failure modes are CI-covered: partial
+lines must not disable the deadline checks, silence must kill, markers must
+parse from interleaved/merged output."""
+import subprocess
+import sys
+import time
+
+import bench
+
+
+def _child(code: str) -> subprocess.Popen:
+    return subprocess.Popen([sys.executable, "-u", "-c", code],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT)
+
+
+def test_markers_parse_from_merged_output():
+    proc = _child(
+        "import sys\n"
+        "print('noise line')\n"
+        "sys.stderr.write('stderr noise\\n')\n"
+        "print('MARK_A 1.5 2.5')\n"
+        "print('MARK_B {\"nproc\": 1}')\n")
+    got = bench._collect_multi(proc, ("MARK_A", "MARK_B"), idle=10, hard=20)
+    assert got["MARK_A"] == [1.5, 2.5]
+    assert got["MARK_B"] == '{"nproc": 1}'
+
+
+def test_partial_line_does_not_disable_deadlines():
+    # child writes a marker, then a PARTIAL line (no newline) and hangs:
+    # a buffered readline() would block forever; the raw-fd reader must
+    # still enforce the idle deadline and salvage the completed marker
+    proc = _child(
+        "import sys, time\n"
+        "print('MARK_A 7.0')\n"
+        "sys.stdout.write('partial-with-no-newline')\n"
+        "sys.stdout.flush()\n"
+        "time.sleep(600)\n")
+    t0 = time.perf_counter()
+    got = bench._collect_multi(proc, ("MARK_A",), idle=12, hard=60)
+    took = time.perf_counter() - t0
+    assert got.get("MARK_A") == [7.0]
+    assert took < 50, f"idle kill did not fire ({took:.0f}s)"
+    assert proc.poll() is not None
+
+
+def test_silent_child_killed_at_idle_window():
+    proc = _child("import time; time.sleep(600)")
+    t0 = time.perf_counter()
+    got = bench._collect_multi(proc, ("NOPE",), idle=12, hard=60)
+    assert got == {}
+    assert time.perf_counter() - t0 < 50
+    assert proc.poll() is not None
+
+
+def test_trailing_line_without_newline_is_still_parsed():
+    proc = _child(
+        "import sys\n"
+        "sys.stdout.write('MARK_A 3.25')\n"  # no trailing newline, then exit
+        "sys.stdout.flush()\n")
+    got = bench._collect_multi(proc, ("MARK_A",), idle=10, hard=20)
+    assert got.get("MARK_A") == [3.25]

@@ -10,7 +10,7 @@ while [ $n -lt 40 ]; do
   n=$((n + 1))
   log="bench_attempts/attempt_${n}.log"
   echo "[keeper] attempt $n $(date -u +%FT%TZ)" >>bench_attempts/keeper.log
-  timeout 2400 python bench.py >"$log" 2>"${log%.log}.err"
+  timeout 6600 python bench.py >"$log" 2>"${log%.log}.err"
   # last JSON line wins
   last=$(grep '^{' "$log" | tail -1)
   if [ -n "$last" ]; then

@@ -16,7 +16,11 @@ import time
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def main(pattern: str = "*.py", timeout_s: float = 600.0) -> int:
+def main(pattern: str = "*.py", timeout_s: float = 600.0,
+         report: str = "") -> int:
+    """Run examples; with ``report=<path>`` also write a JSON results file
+    (the committed per-round sweep artifact, VERDICT r4 #9 — the analogue of
+    the reference's notebook-CI results, DatabricksUtilities.scala:26-43)."""
     timeout_s = float(timeout_s)  # CLI args arrive as strings
     ex_dir = os.path.join(ROOT, "examples")
     scripts = sorted(f for f in os.listdir(ex_dir)
@@ -27,7 +31,7 @@ def main(pattern: str = "*.py", timeout_s: float = 600.0) -> int:
         return 1
     env = dict(os.environ)
     env["MMLSPARK_TPU_EXAMPLES_CPU"] = "1"
-    failures = []
+    failures, results = [], []
     for script in scripts:
         t0 = time.time()
         try:
@@ -40,12 +44,26 @@ def main(pattern: str = "*.py", timeout_s: float = 600.0) -> int:
             out = (e.stdout or b"").decode("utf-8", "replace")                 if isinstance(e.stdout, bytes) else (e.stdout or "")
             err = f"timed out after {timeout_s:.0f}s"
         status = "PASS" if rc == 0 else "FAIL"
-        print(f"{status} {script} ({time.time() - t0:.0f}s)")
+        secs = round(time.time() - t0, 1)
+        print(f"{status} {script} ({secs:.0f}s)", flush=True)
+        results.append({"example": script, "status": status, "seconds": secs})
         if rc != 0:
             failures.append(script)
             print(out[-1500:])
             print(err[-1500:])
     print(f"{len(scripts) - len(failures)}/{len(scripts)} examples passed")
+    if report:
+        import json
+        import platform
+        with open(report, "w") as f:
+            json.dump({"passed": len(scripts) - len(failures),
+                       "total": len(scripts),
+                       "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                  time.gmtime()),
+                       "host": {"nproc": os.cpu_count(),
+                                "machine": platform.machine()},
+                       "results": results}, f, indent=1)
+        print(f"report -> {report}")
     return 1 if failures else 0
 
 
