@@ -73,3 +73,48 @@ def test_committed_checkpoint_drives_jax_model_transformer():
     out = jm.transform(df).collect()["logits"]
     pred = np.asarray([np.argmax(v) for v in out])
     assert float((pred == yte).mean()) > 0.95
+
+
+def test_backbone_checkpoint_transfer_lift():
+    """The trained vision backbone (VERDICT r4 #6): the committed
+    ShapesResNet20 checkpoint loads through ModelDownloader, reproduces its
+    pinned shapes accuracy, and its frozen features beat a raw-pixel probe
+    on the jittered-digits transfer protocol by the stated margin."""
+    import jax.numpy as jnp
+    from sklearn.linear_model import LogisticRegression
+
+    from mmlspark_tpu.dl.model_downloader import ModelDownloader
+    from mmlspark_tpu.dl.procedural_shapes import digits_as_images, make_shapes
+
+    bdir = os.path.join(REPO_DIR, "ShapesResNet20")
+    assert os.path.isdir(bdir), "trained backbone artifact missing"
+    with open(os.path.join(bdir, "eval.json")) as f:
+        pinned = json.load(f)
+    payload = ModelDownloader(local_cache=REPO_DIR) \
+        .download_by_name("ShapesResNet20")
+
+    # pinned shapes-holdout accuracy reproduces (random init scores ~0.1)
+    Xs, ys = make_shapes(1500, seed=1)      # prefix of the trainer's holdout
+    logits = np.asarray(payload.module.apply(payload.variables,
+                                             jnp.asarray(Xs)))
+    acc = float((logits.argmax(1) == ys).mean())
+    assert acc > 0.8, acc
+    assert abs(acc - pinned["shapes_holdout_acc"]) < 0.05, (acc, pinned)
+
+    # transfer: frozen features vs raw pixels on jittered REAL digits
+    Xd, yd = digits_as_images(jitter=True)
+    feats = np.concatenate([
+        np.asarray(payload.module.apply(payload.variables,
+                                        jnp.asarray(Xd[a:a + 512]),
+                                        features=True))
+        for a in range(0, len(Xd), 512)])
+    rng = np.random.default_rng(7)
+    order = rng.permutation(len(yd))
+    cut = int(len(yd) * 0.7)
+    tr, te = order[:cut], order[cut:]
+    t_acc = LogisticRegression(max_iter=2000).fit(feats[tr], yd[tr]) \
+        .score(feats[te], yd[te])
+    raw = Xd.reshape(len(Xd), -1)
+    r_acc = LogisticRegression(max_iter=2000).fit(raw[tr], yd[tr]) \
+        .score(raw[te], yd[te])
+    assert t_acc >= r_acc + 0.03, (t_acc, r_acc)

@@ -140,13 +140,14 @@ def main():
                                            batch_stats)}
     payload = FlaxModelPayload(module=model_f32, variables=var_f32)
     repo = ModelRepo(REPO_DIR)
-    schema = ModelSchema(name="ShapesResNet20", dataset="procedural-shapes-50k",
+    schema = ModelSchema(name="ShapesResNet20",
+                         dataset=f"procedural-shapes-{args.n_train}",
                          model_type="classification", input_shape=[32, 32, 3],
                          num_outputs=10)
     path = repo.save_model(schema, payload)
     with open(os.path.join(path, "eval.json"), "w") as f:
-        json.dump({"train_corpus": "procedural shapes 50k (synthetic, "
-                                   "dl/procedural_shapes.py, seed 0)",
+        json.dump({"train_corpus": f"procedural shapes {args.n_train} "
+                                   "(synthetic, dl/procedural_shapes.py, seed 0)",
                    "epochs": args.epochs, "width": args.width,
                    "shapes_holdout_acc": round(te_acc, 4),
                    "transfer_protocol": "UCI digits placed at random "
