@@ -1213,8 +1213,10 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
             f"{p.tweedie_variance_power}; use objective='poisson' for the "
             f"rho=1 limit")
     # opt-in binning memo (bench/tuner: many train() calls over the SAME X
-    # with fresh labels — quantile fit + digitize depend on X only, and the
-    # caller owning the dict keeps X alive, making id(X) a safe key part)
+    # with fresh labels — quantile fit + digitize depend on X only).  The
+    # dict pins X itself so the id() key can never be recycled by a
+    # freed-and-reallocated array, and a signature miss drops EVERY derived
+    # entry (incl. the device buffer) before repopulating.
     _bin_sig = (id(X), X.shape, p.max_bin,
                 tuple(p.categorical_features or ()))
     if bin_cache is not None and bin_cache.get("sig") == _bin_sig:
@@ -1225,7 +1227,9 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
                            categorical_features=p.categorical_features).fit(X)
         binned_np = mapper.transform(X)
         if bin_cache is not None:
-            bin_cache.update(sig=_bin_sig, mapper=mapper, binned=binned_np)
+            bin_cache.clear()
+            bin_cache.update(sig=_bin_sig, X=X, mapper=mapper,
+                             binned=binned_np)
     edges = jnp.asarray(mapper.edges)
     B = mapper.num_bins
 
