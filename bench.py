@@ -140,7 +140,8 @@ def phase_resnet(batch=256, steps=8, hw=224, reps=3) -> None:
     """ResNet-50 featurize throughput (reference CNTKModel's flagship
     inference path).  Round-3/4 measured 2544 img/s at batch 32 with one
     relay dispatch per step — the ~10-100 ms per-dispatch relay latency
-    dominated the ~13 ms of compute, capping MFU at ~10% (VERDICT r4 #5).
+    dominated the compute, capping MFU at ~5% by this file's 4.09
+    GFLOP/img convention (VERDICT r4 #5 quotes ~10% via 2x FLOP counting).
     Fixes here: batch 256 (MXU-filling), and the step loop moved INSIDE the
     jitted program (lax.scan over per-step input perturbations — ONE relay
     dispatch per timed rep, steps*batch images).  Each scan step perturbs
