@@ -147,11 +147,14 @@ def _node_pure_layout(binned, grad, hess, node_ids, num_nodes, R,
 
     import os as _os
     node_s = jnp.where(node_ids < 0, P, node_ids).astype(jnp.int32)
-    # the one-hot cumsum materializes (n, P+1) transients — a win only while
-    # P is small (depth-5 level-wise peaks at P=16); wide-node builds (deep
-    # trees, leaf-wise num_leaves buffers) fall back to the stable sort
-    use_cumsum = (_os.environ.get("MMLSPARK_TPU_HIST_LAYOUT", "cumsum")
-                  != "sort") and P + 1 <= 33
+    # the one-hot cumsum materializes (n, P+1) transients — a candidate win
+    # only while P is small (depth-5 level-wise peaks at P=16); wide-node
+    # builds (deep trees, leaf-wise num_leaves buffers) always use the
+    # stable sort.  Default stays "sort" (the r4-measured baseline) until
+    # the on-chip A/B in bench_attempts/tune_r5.log proves cumsum faster —
+    # select it via MMLSPARK_TPU_HIST_LAYOUT=cumsum
+    use_cumsum = (_os.environ.get("MMLSPARK_TPU_HIST_LAYOUT", "sort")
+                  == "cumsum") and P + 1 <= 33
     if use_cumsum:
         # rank-by-cumulative-count: rows keep their original order within
         # each node, exactly like the stable argsort below, but the slot

@@ -89,7 +89,7 @@ def main():
             os.environ["MMLSPARK_TPU_HIST_LAYOUT"] = layout
         cfg = {"ch": ch, "block": block, "lo": lo, "resid": resid,
                "layout": layout or os.environ.get("MMLSPARK_TPU_HIST_LAYOUT",
-                                                  "cumsum")}
+                                                  "sort")}
         t0 = time.perf_counter()
         train(X, fresh_y(), GBDTParams(num_iterations=ITERS_A,
                                        objective="binary", max_depth=5),
