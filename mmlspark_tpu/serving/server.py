@@ -153,10 +153,21 @@ class PipelineServer:
                     with server.stats.lock:
                         server.stats.errors += 1
                     return
-                self._respond(entry.status, entry.reply)
+                # count BEFORE the socket write: a client that already holds
+                # the reply must never observe replied lagging it (stats
+                # aggregation raced the last in-flight write otherwise).  A
+                # failed write rolls the count back as an error; latency is
+                # sampled after the write so the metric's window is unchanged
                 with server.stats.lock:
                     server.stats.replied += 1
-                    server.stats.latency_sum += time.perf_counter() - t0
+                try:
+                    self._respond(entry.status, entry.reply)
+                    with server.stats.lock:
+                        server.stats.latency_sum += time.perf_counter() - t0
+                except OSError:
+                    with server.stats.lock:
+                        server.stats.replied -= 1
+                        server.stats.errors += 1
 
             _STATUS = {200: b"200 OK", 400: b"400 Bad Request",
                        404: b"404 Not Found", 500: b"500 Internal Server Error",

@@ -108,10 +108,19 @@ class HTTPStreamSource:
                     with src.stats.lock:
                         src.stats.errors += 1
                     return
-                self._json(entry.status, entry.reply)
+                # count before the socket write (see server.py: a client
+                # holding the reply must never observe replied lagging it);
+                # failed writes roll back as errors, latency sampled after
                 with src.stats.lock:
                     src.stats.replied += 1
-                    src.stats.latency_sum += time.perf_counter() - t0
+                try:
+                    self._json(entry.status, entry.reply)
+                    with src.stats.lock:
+                        src.stats.latency_sum += time.perf_counter() - t0
+                except OSError:
+                    with src.stats.lock:
+                        src.stats.replied -= 1
+                        src.stats.errors += 1
 
             def _json(self, status, obj):
                 body = json.dumps(obj, default=str).encode()
