@@ -43,3 +43,24 @@ Layout mirrors the reference's module map (SURVEY.md §1-2):
 """
 
 __version__ = "0.2.0"
+
+# jax < 0.5 compat: the codebase targets the top-level `jax.shard_map`
+# (with its `check_vma` kwarg); older jax only ships
+# `jax.experimental.shard_map.shard_map` (whose equivalent kwarg is
+# `check_rep`).  Install a translating alias so every call site works on
+# both — without it the whole parallel/ layer fails at call time.
+import jax as _jax
+
+if not hasattr(_jax, "shard_map"):
+    import functools as _functools
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    @_functools.wraps(_shard_map)
+    def _shard_map_compat(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(*args, **kwargs)
+
+    _jax.shard_map = _shard_map_compat
+
+del _jax

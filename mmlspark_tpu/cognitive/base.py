@@ -34,6 +34,10 @@ class CognitiveServicesBase(Transformer, HasOutputCol):
     error_col = Param("error_col", "error output column", "string", default="error")
     concurrency = Param("concurrency", "max in-flight requests", "int", default=4)
     timeout = Param("timeout", "per-request timeout seconds", "float", default=60.0)
+    breaker = Param("breaker", "shared CircuitBreaker guarding this service "
+                    "endpoint (utils/resilience.py); open circuit -> "
+                    "synthetic 503 rows in error_col, no network calls",
+                    "object", default=None)
 
     _url_path: str = ""          # subclass: path under the location endpoint
     _service: str = "api.cognitive.microsoft.com"
@@ -107,7 +111,8 @@ class CognitiveServicesBase(Transformer, HasOutputCol):
             rows = [Row({k: p[k][i] for k in p}) for i in range(n)]
             reqs = [self._build_request(r) for r in rows]
             client = AsyncHTTPClient(concurrency=self.get("concurrency"),
-                                     timeout_s=self.get("timeout"))
+                                     timeout_s=self.get("timeout"),
+                                     breaker=self.get("breaker"))
             resps = client.send_all(reqs)
             out = np.empty(n, dtype=object)
             errs = np.empty(n, dtype=object)

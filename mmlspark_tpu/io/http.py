@@ -11,6 +11,13 @@ Here requests ride as dataclass cells in object columns (``Binding`` codec);
 the async client is a bounded thread pool (Python's analogue of the
 reference's Future pool) with exponential-backoff retries honoring
 Retry-After.
+
+Resilience (utils/resilience.py): clients optionally share a
+``CircuitBreaker`` (open circuit -> synthetic 503 without touching the
+network), and every retry loop is clipped to the ambient ``Deadline`` so a
+caller's budget bounds the whole fan-out, not just a single attempt.  The
+raw exchange is an injectable ``transport`` so the chaos harness
+(testing/chaos.py) injects latency/errors/storms deterministically.
 """
 from __future__ import annotations
 
@@ -28,6 +35,7 @@ from ..core import (Binding, DataFrame, HasInputCol, HasOutputCol, Param,
                     Transformer)
 from ..core.schema import ColumnType
 from ..stages.minibatch import FixedMiniBatchTransformer, FlattenBatch
+from ..utils.resilience import CircuitBreaker, Deadline, current_deadline
 
 
 @dataclasses.dataclass
@@ -62,57 +70,137 @@ REQUEST_BINDING = Binding(HTTPRequestData)
 RESPONSE_BINDING = Binding(HTTPResponseData)
 
 
+def _urllib_transport(req: HTTPRequestData, timeout_s: float) -> HTTPResponseData:
+    """One raw exchange.  HTTP error statuses come back as responses (not
+    exceptions); transport-level failures (refused, reset, DNS) raise."""
+    try:
+        r = urllib.request.Request(req.url, data=req.entity, method=req.method,
+                                   headers=dict(req.headers or {}))
+        with urllib.request.urlopen(r, timeout=timeout_s) as resp:
+            return HTTPResponseData(
+                status_code=resp.status, reason=getattr(resp, "reason", ""),
+                headers=dict(resp.headers), entity=resp.read())
+    except urllib.error.HTTPError as e:
+        body = e.read() if hasattr(e, "read") else b""
+        return HTTPResponseData(status_code=e.code, reason=str(e.reason),
+                                headers=dict(e.headers or {}), entity=body)
+
+
+def circuit_open_response(retry_after_s: float) -> HTTPResponseData:
+    """Synthetic 503 emitted when a breaker rejects without a network call."""
+    return HTTPResponseData(
+        status_code=503, reason="circuit open",
+        headers={"Retry-After": str(max(0, int(retry_after_s)) or 1),
+                 "X-Circuit-Open": "1"})
+
+
 class HTTPClient:
     """Single-threaded client with retries (reference SingleThreadedHTTPClient
-    + HandlingUtils.sendWithRetries)."""
+    + HandlingUtils.sendWithRetries).
+
+    ``breaker`` (shared CircuitBreaker): 5xx/transport failures feed it; an
+    open circuit short-circuits to a synthetic 503.  The ambient
+    ``deadline_scope`` (or an explicit ``deadline=``) clips every attempt
+    timeout and backoff sleep to the caller's remaining budget — retries
+    never overshoot it.  ``transport``/``clock``/``sleep`` are injectable
+    for the deterministic chaos harness.
+    """
 
     def __init__(self, retries: int = 3, backoff_ms: Optional[List[int]] = None,
-                 timeout_s: float = 60.0):
+                 timeout_s: float = 60.0,
+                 breaker: Optional[CircuitBreaker] = None,
+                 transport: Optional[Callable[[HTTPRequestData, float],
+                                              HTTPResponseData]] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
         self.retries = retries
         self.backoffs = backoff_ms or [100, 500, 1000]
         self.timeout_s = timeout_s
+        self.breaker = breaker
+        self.transport = transport or _urllib_transport
+        self.clock = clock
+        self.sleep = sleep
 
-    def send(self, req: HTTPRequestData) -> HTTPResponseData:
+    def _sleep_budgeted(self, seconds: float, deadline: Optional[Deadline]) -> bool:
+        """Sleep, clipped to the remaining budget.  False if the budget is
+        already gone (caller should stop retrying)."""
+        if deadline is not None:
+            remaining = deadline.remaining()
+            if remaining <= 0:
+                return False
+            seconds = min(seconds, remaining)
+        self.sleep(seconds)
+        return True
+
+    def send(self, req: HTTPRequestData,
+             deadline: Optional[Deadline] = None) -> HTTPResponseData:
+        deadline = deadline or current_deadline()
         last_err: Optional[HTTPResponseData] = None
         for attempt in range(self.retries + 1):
+            # deadline check MUST precede breaker admission: allow() may
+            # consume a half-open probe slot, and an early return here would
+            # leak it (the breaker would stay half-open forever)
+            timeout_s = self.timeout_s
+            if deadline is not None:
+                if deadline.expired():
+                    return last_err or HTTPResponseData(
+                        status_code=0, reason="deadline exceeded before attempt")
+                timeout_s = deadline.clip(self.timeout_s)
+            if self.breaker is not None and not self.breaker.allow():
+                return last_err or circuit_open_response(
+                    self.breaker.retry_after_s())
             try:
-                r = urllib.request.Request(
-                    req.url, data=req.entity, method=req.method,
-                    headers=dict(req.headers or {}))
-                with urllib.request.urlopen(r, timeout=self.timeout_s) as resp:
-                    return HTTPResponseData(
-                        status_code=resp.status, reason=getattr(resp, "reason", ""),
-                        headers=dict(resp.headers), entity=resp.read())
-            except urllib.error.HTTPError as e:
-                body = e.read() if hasattr(e, "read") else b""
-                last_err = HTTPResponseData(status_code=e.code, reason=str(e.reason),
-                                            headers=dict(e.headers or {}), entity=body)
-                # throttling: honor Retry-After (reference advanced handler)
-                if e.code in (429, 503):
-                    retry_after = (e.headers or {}).get("Retry-After")
-                    if retry_after:
-                        time.sleep(min(float(retry_after), 30.0))
-                        continue
-                elif e.code < 500:
-                    return last_err  # 4xx: no retry
+                resp = self.transport(req, timeout_s)
             except Exception as e:  # noqa: BLE001 — network errors retried
                 last_err = HTTPResponseData(status_code=0, reason=str(e))
+                if self.breaker is not None:
+                    self.breaker.record_failure()
+            else:
+                last_err = resp
+                code = resp.status_code
+                # 429 is the dependency throttling us, not failing — it
+                # retries but never trips the breaker
+                if self.breaker is not None:
+                    if code == 0 or code >= 500:
+                        self.breaker.record_failure()
+                    else:
+                        self.breaker.record_success()
+                if 0 < code < 500 and code != 429:
+                    return resp  # 2xx/3xx/4xx: done
+                # throttling/outage: honor Retry-After (reference advanced
+                # handler), else fall through to exponential backoff
+                retry_after = (resp.headers or {}).get("Retry-After")
+                if retry_after and attempt < self.retries:
+                    try:  # RFC 7231 also allows an HTTP-date here
+                        wait_s = min(float(retry_after), 30.0)
+                    except ValueError:
+                        wait_s = None
+                    if wait_s is not None:
+                        if not self._sleep_budgeted(wait_s, deadline):
+                            return last_err
+                        continue
             if attempt < self.retries:
-                time.sleep(self.backoffs[min(attempt, len(self.backoffs) - 1)] / 1000.0)
+                if not self._sleep_budgeted(
+                        self.backoffs[min(attempt, len(self.backoffs) - 1)] / 1000.0,
+                        deadline):
+                    return last_err
         return last_err
 
 
 class AsyncHTTPClient(HTTPClient):
-    """Bounded-concurrency async client (reference AsyncClient, Clients.scala:48)."""
+    """Bounded-concurrency async client (reference AsyncClient, Clients.scala:48).
+    The ambient deadline is captured on the submitting thread and handed to
+    every pooled ``send`` (contextvars don't cross thread-pool boundaries)."""
 
     def __init__(self, concurrency: int = 8, **kw):
         super().__init__(**kw)
         self.concurrency = concurrency
 
     def send_all(self, reqs: List[Optional[HTTPRequestData]]) -> List[Optional[HTTPResponseData]]:
+        deadline = current_deadline()
         out: List[Optional[HTTPResponseData]] = [None] * len(reqs)
         with concurrent.futures.ThreadPoolExecutor(self.concurrency) as ex:
-            futs = {ex.submit(self.send, r): i
+            futs = {ex.submit(self.send, r, deadline): i
                     for i, r in enumerate(reqs) if r is not None}
             for f in concurrent.futures.as_completed(futs):
                 out[futs[f]] = f.result()
@@ -126,6 +214,8 @@ class HTTPTransformer(Transformer, HasInputCol, HasOutputCol):
     concurrency = Param("concurrency", "max in-flight requests per partition", "int", default=8)
     concurrent_timeout = Param("concurrent_timeout", "request timeout seconds", "float", default=60.0)
     handler = Param("handler", "custom (client, request)->response handler", "object")
+    breaker = Param("breaker", "shared CircuitBreaker guarding the endpoint",
+                    "object", default=None)
 
     def __init__(self, uid=None, **kwargs):
         super().__init__(uid)
@@ -134,7 +224,8 @@ class HTTPTransformer(Transformer, HasInputCol, HasOutputCol):
 
     def _client(self) -> AsyncHTTPClient:
         return AsyncHTTPClient(concurrency=self.get("concurrency"),
-                               timeout_s=self.get("concurrent_timeout"))
+                               timeout_s=self.get("concurrent_timeout"),
+                               breaker=self.get("breaker"))
 
     def _transform(self, df: DataFrame) -> DataFrame:
         in_col, out_col = self.get_or_fail("input_col"), self.get_or_fail("output_col")
@@ -178,6 +269,8 @@ class SimpleHTTPTransformer(Transformer, HasInputCol, HasOutputCol):
     max_batch_size = Param("max_batch_size", "minibatch rows per request (0=off)", "int", default=0)
     concurrency = Param("concurrency", "max in-flight requests", "int", default=8)
     headers = Param("headers", "extra headers dict", "object", default=None)
+    breaker = Param("breaker", "shared CircuitBreaker guarding the endpoint",
+                    "object", default=None)
 
     def __init__(self, uid=None, **kwargs):
         super().__init__(uid)
@@ -199,7 +292,8 @@ class SimpleHTTPTransformer(Transformer, HasInputCol, HasOutputCol):
             work = FixedMiniBatchTransformer().set("batch_size", batching).transform(work)
 
         def per_part(p):
-            client = AsyncHTTPClient(concurrency=self.get("concurrency"))
+            client = AsyncHTTPClient(concurrency=self.get("concurrency"),
+                                     breaker=self.get("breaker"))
             cells = p[in_col]
             if batching > 1:
                 reqs = [in_parser(list(c)) for c in cells]

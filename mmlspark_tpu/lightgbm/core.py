@@ -1170,6 +1170,22 @@ class TrainResult:
     bin_mapper: BinMapper
 
 
+def _content_fingerprint(arr: np.ndarray) -> int:
+    """Cheap strided content hash for cache keys: crc32 over ~4k strided
+    elements.  Catches in-place mutation of a cached array that id()/shape
+    keys alone cannot, at O(4k) cost regardless of array size.  Mutations
+    confined to the skipped strides are (by design) not detected — it is a
+    guard rail, not a cryptographic digest."""
+    import zlib
+    if arr.size == 0:
+        return 0
+    step = max(1, arr.size // 4096)
+    # arr.flat[::step] materializes ONLY the ~4k sampled elements; ravel()
+    # would copy the whole array whenever it is not C-contiguous
+    sample = arr.flat[::step]
+    return zlib.crc32(np.ascontiguousarray(sample).tobytes())
+
+
 def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
           sample_weight: Optional[np.ndarray] = None,
           valid: Optional[Tuple[np.ndarray, np.ndarray]] = None,
@@ -1183,7 +1199,14 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
     XLA program (reference: driver drives ``updateOneIteration`` per iter,
     ``TrainUtils.scala:67``).  ``shard_rows`` puts the binned matrix/gradients
     row-sharded over the active mesh's data axis (GSPMD psums histograms over
-    ICI — the allreduce-ring replacement)."""
+    ICI — the allreduce-ring replacement).
+
+    ``bin_cache`` contract: the memo is keyed on ``(id(X), shape, strided
+    content fingerprint, binning params)``.  Rebinding a NEW array reuses
+    nothing; mutating X IN PLACE between calls is detected by the ~4k-element
+    strided fingerprint and rebins — but a mutation that only touches
+    elements the stride skips can slip through, so callers that rewrite X
+    wholesale should pass a fresh cache dict rather than rely on detection."""
     import jax
     import jax.numpy as jnp
 
@@ -1217,7 +1240,7 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
     # dict pins X itself so the id() key can never be recycled by a
     # freed-and-reallocated array, and a signature miss drops EVERY derived
     # entry (incl. the device buffer) before repopulating.
-    _bin_sig = (id(X), X.shape, p.max_bin,
+    _bin_sig = (id(X), X.shape, _content_fingerprint(X), p.max_bin,
                 tuple(p.categorical_features or ()))
     if bin_cache is not None and bin_cache.get("sig") == _bin_sig:
         mapper = bin_cache["mapper"]

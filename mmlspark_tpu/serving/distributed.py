@@ -23,31 +23,72 @@ import threading
 import time
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from .server import PipelineServer
+from ..utils.resilience import Deadline, current_deadline
 
 
-def _http_json(url: str, payload: Optional[dict] = None, timeout: float = 10.0):
+def _http_json(url: str, payload: Optional[dict] = None, timeout: float = 10.0,
+               deadline: Optional[Deadline] = None):
+    deadline = deadline or current_deadline()
+    if deadline is not None:
+        if deadline.expired():
+            raise TimeoutError("deadline exceeded before request")
+        timeout = deadline.clip(timeout)
     data = json.dumps(payload).encode() if payload is not None else None
-    req = urllib.request.Request(url, data=data,
-                                 headers={"Content-Type": "application/json"})
+    headers = {"Content-Type": "application/json"}
+    if deadline is not None:
+        # forward the remaining budget so the server admits/sheds/scores
+        # under the caller's deadline, not its own default
+        headers[Deadline.HEADER] = deadline.to_header()
+    req = urllib.request.Request(url, data=data, headers=headers)
     with urllib.request.urlopen(req, timeout=timeout) as r:
         return json.loads(r.read().decode() or "null")
+
+
+def _default_prober(worker: Dict, timeout: float) -> bool:
+    """One /health probe against a worker's own socket (PipelineServer and
+    TopologyService both serve GET /health)."""
+    try:
+        url = f"http://{worker['host']}:{worker['port']}/health"
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status == 200
+    except Exception:  # noqa: BLE001 — any failure is "unhealthy"
+        return False
 
 
 class TopologyService:
     """Driver-side registry: workers announce ``server_id -> host:port``;
     clients fetch the routing table; ``/stats`` aggregates every worker's
     counters (reference: driver service ``HTTPSourceV2.scala:190`` +
-    state-holder registries ``:337-371``)."""
+    state-holder registries ``:337-371``).
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    Health-checked failover: the driver actively probes each worker's
+    ``/health`` every ``probe_interval_s``; ``evict_after`` consecutive
+    probe failures evict the worker from the routing table (it reappears
+    if it re-registers).  ``probe_once()`` runs a single sweep — tests
+    drive eviction deterministically through it instead of sleeping.
+    ``prober`` is injectable for the chaos harness.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 probe_interval_s: Optional[float] = 5.0,
+                 probe_timeout_s: float = 2.0, evict_after: int = 3,
+                 prober: Optional[Callable[[Dict, float], bool]] = None):
         self.host, self.port = host, port
+        self.probe_interval_s = probe_interval_s
+        self.probe_timeout_s = probe_timeout_s
+        self.evict_after = max(1, evict_after)
+        self.prober = prober or _default_prober
         self._lock = threading.Lock()
         self._workers: Dict[str, Dict] = {}
+        self._fail_counts: Dict[str, int] = {}
+        self._evicted: Dict[str, Dict] = {}
         self._flags: Dict[str, str] = {}
         self._httpd: Optional[ThreadingHTTPServer] = None
+        self._stop = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------------ http
     def _make_handler(self):
@@ -70,7 +111,11 @@ class TopologyService:
                 payload = json.loads(self.rfile.read(length).decode() or "{}")
                 if self.path == "/register":
                     with svc._lock:
-                        svc._workers[payload["server_id"]] = payload
+                        sid = payload["server_id"]
+                        svc._workers[sid] = payload
+                        # (re-)registration wipes any stale health verdict
+                        svc._fail_counts.pop(sid, None)
+                        svc._evicted.pop(sid, None)
                     self._json(200, {"ok": True,
                                      "num_workers": len(svc._workers)})
                 elif self.path == "/deregister":
@@ -101,6 +146,36 @@ class TopologyService:
 
         return Handler
 
+    # ---------------------------------------------------------------- health
+    def probe_once(self) -> List[str]:
+        """One health sweep over the registered workers; returns the ids
+        evicted by this sweep.  Also the unit the background prober loops."""
+        with self._lock:
+            snapshot = list(self._workers.items())
+        evicted: List[str] = []
+        for sid, w in snapshot:
+            healthy = self.prober(w, self.probe_timeout_s)
+            with self._lock:
+                if sid not in self._workers:
+                    continue  # deregistered mid-sweep
+                if healthy:
+                    self._fail_counts.pop(sid, None)
+                    continue
+                fails = self._fail_counts.get(sid, 0) + 1
+                self._fail_counts[sid] = fails
+                if fails >= self.evict_after:
+                    self._evicted[sid] = self._workers.pop(sid)
+                    self._fail_counts.pop(sid, None)
+                    evicted.append(sid)
+        return evicted
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.probe_interval_s):
+            try:
+                self.probe_once()
+            except Exception:  # noqa: BLE001 — prober must never die
+                pass
+
     # ------------------------------------------------------------------ api
     def start(self) -> "TopologyService":
         self._httpd = ThreadingHTTPServer((self.host, self.port),
@@ -108,9 +183,14 @@ class TopologyService:
         self.port = self._httpd.server_port
         t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         t.start()
+        if self.probe_interval_s is not None:
+            self._probe_thread = threading.Thread(target=self._probe_loop,
+                                                  daemon=True)
+            self._probe_thread.start()
         return self
 
     def stop(self) -> None:
+        self._stop.set()
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -127,7 +207,9 @@ class TopologyService:
         """Pull and sum every registered worker's counters."""
         with self._lock:
             workers = list(self._workers.values())
-        total = {"received": 0, "replied": 0, "errors": 0, "workers": {}}
+            evicted = sorted(self._evicted)
+        total = {"received": 0, "replied": 0, "errors": 0, "shed": 0,
+                 "workers": {}, "evicted": evicted}
         lat_sum = 0.0
         for w in workers:
             try:
@@ -139,6 +221,7 @@ class TopologyService:
             total["received"] += s.get("received", 0)
             total["replied"] += s.get("replied", 0)
             total["errors"] += s.get("errors", 0)
+            total["shed"] += s.get("shed", 0)
             lat_sum += s.get("mean_latency_ms", 0.0) * s.get("replied", 0)
         if total["replied"]:
             total["mean_latency_ms"] = lat_sum / total["replied"]
@@ -183,11 +266,19 @@ class WorkerServer:
 class RoutingClient:
     """Client-side router over the driver's table: round robin by default,
     or deterministic key-hash routing (``MultiChannelMap.nextList``'s
-    request sharding, client-side).  Refreshes the table on demand."""
+    request sharding, client-side).  Refreshes the table on demand.
 
-    def __init__(self, driver_address: str, refresh_s: float = 5.0):
+    Failover: a failed exchange refreshes the table and retries ONCE per
+    remaining healthy worker candidate (``failover_retries``, default 1 —
+    exactly one failover hop), always excluding workers that already failed
+    this request so a retry can never land back on the dead socket.
+    """
+
+    def __init__(self, driver_address: str, refresh_s: float = 5.0,
+                 failover_retries: int = 1):
         self.driver_address = driver_address.rstrip("/")
         self.refresh_s = refresh_s
+        self.failover_retries = max(0, failover_retries)
         self._table: List[Dict] = []
         self._fetched = 0.0
         self._rr = 0
@@ -202,33 +293,50 @@ class RoutingClient:
                                      key=lambda w: w["server_id"])
                 self._fetched = now
 
-    def _pick(self, key: Optional[str]) -> Dict:
+    def _pick(self, key: Optional[str], exclude=()) -> Dict:
         self._refresh()
         with self._lock:
-            if not self._table:
-                raise RuntimeError("no serving workers registered")
+            candidates = [w for w in self._table
+                          if w["server_id"] not in exclude]
+            if not candidates:
+                raise RuntimeError(
+                    "no serving workers registered" if not self._table
+                    else "no healthy serving workers left to fail over to")
             if key is not None:
                 # stable across processes/restarts (builtin hash is salted),
                 # so partition affinity survives like MultiChannelMap's
                 import zlib
-                return self._table[zlib.crc32(key.encode()) % len(self._table)]
-            w = self._table[self._rr % len(self._table)]
+                return candidates[zlib.crc32(key.encode()) % len(candidates)]
+            w = candidates[self._rr % len(candidates)]
             self._rr += 1
             return w
 
     def request(self, payload, key: Optional[str] = None,
-                timeout: float = 30.0, retries: int = 2):
-        """POST to the routed worker; on connection failure, refresh the
-        table and fail over to the next worker (the LB behavior the
-        reference delegates to Azure LB, ``docs/mmlspark-serving.md:87``)."""
+                timeout: float = 30.0, retries: Optional[int] = None,
+                deadline: Optional[Deadline] = None):
+        """POST to the routed worker; on failure, refresh the table and fail
+        over to the next healthy worker — exactly once per extra attempt
+        (the LB behavior the reference delegates to Azure LB,
+        ``docs/mmlspark-serving.md:87``).  The ambient/explicit deadline
+        clips every attempt's timeout."""
+        deadline = deadline or current_deadline()
+        failovers = self.failover_retries if retries is None else max(0, retries)
+        tried: set = set()
         last = None
-        for _ in range(retries + 1):
-            w = self._pick(key)
+        for _ in range(failovers + 1):
+            try:
+                w = self._pick(key, exclude=tried)
+            except RuntimeError:
+                if last is None:
+                    raise  # empty table and nothing attempted yet
+                break  # nobody left to fail over to
             url = f"http://{w['host']}:{w['port']}{w.get('api_path', '/score')}"
             try:
-                return _http_json(url, payload, timeout=timeout)
+                return _http_json(url, payload, timeout=timeout,
+                                  deadline=deadline)
             except Exception as e:  # noqa: BLE001 — fail over
                 last = e
+                tried.add(w["server_id"])
                 try:  # a briefly-unreachable driver must not abort the
                     self._refresh(force=True)  # retry; stale table still works
                 except Exception:  # noqa: BLE001

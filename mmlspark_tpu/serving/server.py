@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from ..core import DataFrame, Transformer
+from ..utils.resilience import Deadline, deadline_scope
 
 
 @dataclass
@@ -40,23 +41,36 @@ class _Entry:
     done: threading.Event = field(default_factory=threading.Event)
     reply: Any = None
     status: int = 200
+    # absolute expiry on the server clock; a plain float (not a Deadline
+    # object) keeps the per-request hot path allocation-free
+    t_deadline: float = float("inf")
+    t_enq: float = 0.0
+    retry_after_s: Optional[float] = None
 
 
 class ServingStats:
-    """Request counters (reference DistributedHTTPSource.scala:99-110)."""
+    """Request counters (reference DistributedHTTPSource.scala:99-110).
+
+    Each request is counted EXACTLY once by its handler thread:
+    ``replied`` (200 written), ``errors`` (500/504/failed write), or
+    ``shed`` (503 load shed).  At quiescence
+    ``received == replied + errors + shed``; mid-flight, admitted-but-
+    unresolved requests make up the difference.
+    """
 
     def __init__(self):
         self.lock = threading.Lock()
         self.received = 0
         self.replied = 0
         self.errors = 0
+        self.shed = 0
         self.latency_sum = 0.0
 
     def as_dict(self):
         with self.lock:
             n = max(1, self.replied)
             return {"received": self.received, "replied": self.replied,
-                    "errors": self.errors,
+                    "errors": self.errors, "shed": self.shed,
                     "mean_latency_ms": 1000.0 * self.latency_sum / n}
 
 
@@ -65,6 +79,15 @@ class PipelineServer:
 
     POST <api_path> with a JSON object (one row) -> JSON reply from
     ``reply_col``.  GET /stats -> counters; GET /health -> ok.
+
+    Graceful degradation: admission is bounded — once ``max_queue_depth``
+    requests are in flight, further POSTs are shed immediately with 503 +
+    ``Retry-After`` instead of queueing toward certain timeout (the
+    reference's LB would do this upstream; in-process we must).  Each
+    request carries a deadline (``X-MMLSpark-Deadline-Ms`` header if the
+    client sent one, else ``request_timeout_s``); the scorer drops entries
+    whose budget expired in the queue (504) or whose queue age exceeds
+    ``max_queue_age_s`` (503) without wasting device time on them.
     """
 
     def __init__(self, model: Transformer, input_col: str = "request",
@@ -74,7 +97,11 @@ class PipelineServer:
                  micro_batch_interval_ms: int = 10,
                  input_parser: Optional[Callable[[bytes], Any]] = None,
                  reply_encoder: Optional[Callable[[Any], Any]] = None,
-                 request_timeout_s: float = 30.0):
+                 request_timeout_s: float = 30.0,
+                 max_queue_depth: int = 256,
+                 max_queue_age_s: Optional[float] = None,
+                 shed_retry_after_s: float = 1.0,
+                 clock: Callable[[], float] = time.monotonic):
         if mode not in ("continuous", "micro_batch"):
             raise ValueError("mode must be continuous|micro_batch")
         self.model = model
@@ -86,7 +113,12 @@ class PipelineServer:
         self.input_parser = input_parser or (lambda b: json.loads(b.decode() or "null"))
         self.reply_encoder = reply_encoder or _default_encode
         self.request_timeout_s = request_timeout_s
+        self.max_queue_depth = max_queue_depth
+        self.max_queue_age_s = max_queue_age_s
+        self.shed_retry_after_s = shed_retry_after_s
+        self.clock = clock
         self.stats = ServingStats()
+        self._pending = 0  # admitted, not yet resolved (guarded by stats.lock)
         self._q: "queue.Queue[_Entry]" = queue.Queue()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._threads: List[threading.Thread] = []
@@ -116,8 +148,10 @@ class PipelineServer:
                 if self.path == "/health":
                     self._write_raw(200, b"ok", b"text/plain")
                 elif self.path == "/stats":
-                    self._write_raw(200,
-                                    json.dumps(server.stats.as_dict()).encode())
+                    d = server.stats.as_dict()
+                    with server.stats.lock:
+                        d["pending"] = server._pending
+                    self._write_raw(200, json.dumps(d).encode())
                 else:
                     self._respond(404, {"error": "not found"})
 
@@ -136,10 +170,35 @@ class PipelineServer:
                 except Exception as e:  # noqa: BLE001
                     self._respond(400, {"error": f"bad request: {e}"})
                     return
+                # the caller's remaining budget rides the deadline header;
+                # without one the server default bounds the request
+                t_enq = server.clock()
+                budget_s = server.request_timeout_s
+                hdr = self.headers.get(Deadline.HEADER)
+                if hdr:
+                    try:
+                        budget_s = min(budget_s, max(0.0, float(hdr)) / 1000.0)
+                    except ValueError:
+                        pass
                 entry = _Entry(uid=str(uuid_mod.uuid4()), payload=payload,
-                               headers=dict(self.headers))
+                               headers=dict(self.headers), t_enq=t_enq,
+                               t_deadline=t_enq + budget_s)
+                # bounded admission: shedding beats queueing toward a
+                # certain timeout (503 tells the client to back off; 504
+                # would have cost it request_timeout_s of waiting first)
                 with server.stats.lock:
                     server.stats.received += 1
+                    admitted = server._pending < server.max_queue_depth
+                    if admitted:
+                        server._pending += 1
+                    else:
+                        server.stats.shed += 1
+                if not admitted:
+                    self._respond(503, {"error": "overloaded: queue full"},
+                                  extra_headers={
+                                      "Retry-After":
+                                      _retry_after(server.shed_retry_after_s)})
+                    return
                 if server.mode == "continuous" and \
                         server._inline_lock.acquire(blocking=False):
                     try:  # idle scorer: skip the queue hand-off entirely
@@ -148,44 +207,75 @@ class PipelineServer:
                         server._inline_lock.release()
                 else:
                     server._q.put(entry)
-                if not entry.done.wait(server.request_timeout_s):
+                # wait no longer than the caller still cares about
+                if not entry.done.wait(budget_s):
                     self._respond(504, {"error": "timeout"})
                     with server.stats.lock:
                         server.stats.errors += 1
                     return
                 # count BEFORE the socket write: a client that already holds
-                # the reply must never observe replied lagging it (stats
+                # the reply must never observe its counter lagging (stats
                 # aggregation raced the last in-flight write otherwise).  A
                 # failed write rolls the count back as an error; latency is
                 # sampled after the write so the metric's window is unchanged
-                with server.stats.lock:
-                    server.stats.replied += 1
+                status = entry.status
+                stats = server.stats
+                extra = None
+                if status == 503:
+                    extra = {"Retry-After": _retry_after(
+                        entry.retry_after_s or server.shed_retry_after_s)}
                 try:
-                    self._respond(entry.status, entry.reply)
-                    with server.stats.lock:
-                        server.stats.latency_sum += time.perf_counter() - t0
-                except OSError:
-                    with server.stats.lock:
-                        server.stats.replied -= 1
-                        server.stats.errors += 1
+                    if status == 200:
+                        with stats.lock:
+                            stats.replied += 1
+                        self._respond(200, entry.reply)
+                        # latency is a SUCCESS metric: mean_latency_ms
+                        # divides by replied, so only 200s may feed the sum
+                        with stats.lock:
+                            stats.latency_sum += time.perf_counter() - t0
+                    elif status == 503:
+                        with stats.lock:
+                            stats.shed += 1
+                        self._respond(503, entry.reply, extra_headers=extra)
+                    else:
+                        with stats.lock:
+                            stats.errors += 1
+                        self._respond(status, entry.reply)
+                except Exception:  # any failed write: invariant must hold
+                    with stats.lock:
+                        if status == 200:
+                            stats.replied -= 1
+                        elif status == 503:
+                            stats.shed -= 1
+                        else:
+                            stats.errors -= 1
+                        stats.errors += 1
+                    raise
 
             _STATUS = {200: b"200 OK", 400: b"400 Bad Request",
                        404: b"404 Not Found", 500: b"500 Internal Server Error",
+                       503: b"503 Service Unavailable",
                        504: b"504 Gateway Timeout"}
 
-            def _write_raw(self, status, body, ctype=b"application/json"):
+            def _write_raw(self, status, body, ctype=b"application/json",
+                           extra_headers=None):
                 # one buffered write per reply: status line + headers + body
                 # in a single syscall/TCP segment (the default handler path
                 # issues one write per header, which interacts badly with
                 # delayed ACKs on loopback)
+                hdrs = b""
+                for k, v in (extra_headers or {}).items():
+                    hdrs += k.encode() + b": " + str(v).encode() + b"\r\n"
                 self.wfile.write(
                     b"HTTP/1.1 " + self._STATUS.get(status, b"500 ISE")
                     + b"\r\nContent-Type: " + ctype
-                    + b"\r\nContent-Length: " + str(len(body)).encode()
+                    + b"\r\n" + hdrs
+                    + b"Content-Length: " + str(len(body)).encode()
                     + b"\r\n\r\n" + body)
 
-            def _respond(self, status, obj):
-                self._write_raw(status, json.dumps(obj, default=str).encode())
+            def _respond(self, status, obj, extra_headers=None):
+                self._write_raw(status, json.dumps(obj, default=str).encode(),
+                                extra_headers=extra_headers)
 
         return Handler
 
@@ -214,24 +304,50 @@ class PipelineServer:
     def _score_batch(self, batch: List[_Entry]) -> None:
         """Run the pipeline over a batch of entries and resolve each one.
         Called from the worker thread and, in continuous mode, inline from
-        an idle handler thread (guarded by ``_inline_lock``)."""
-        col = np.empty(len(batch), dtype=object)
-        for i, e in enumerate(batch):
-            col[i] = e.payload
-        ids = np.asarray([e.uid for e in batch], dtype=object)
-        df = DataFrame([{self.input_col: col, "id": ids}])
-        try:
-            out = self.model.transform(df).collect()
-            replies = out[self.reply_col]
-            for e, r in zip(batch, replies):
-                e.reply = self.reply_encoder(r)
-                e.done.set()
-        except Exception as ex:  # noqa: BLE001 — reply errors per-request
-            for e in batch:
-                e.status, e.reply = 500, {"error": str(ex)}
-                e.done.set()
-            with self.stats.lock:
-                self.stats.errors += len(batch)
+        an idle handler thread (guarded by ``_inline_lock``).
+
+        Entries that expired in the queue are resolved without scoring:
+        504 when the caller's deadline is gone (it stopped listening), 503
+        shed when queue age exceeds ``max_queue_age_s`` (overload — tell
+        the caller to back off rather than burn device time on stale work).
+        Counting happens in the handler threads (exactly once per request),
+        never here; this thread only frees admission slots and wakes them.
+        """
+        now = self.clock()
+        live: List[_Entry] = []
+        for e in batch:
+            if now > e.t_deadline:
+                e.status, e.reply = 504, {"error": "deadline expired in queue"}
+            elif self.max_queue_age_s is not None and \
+                    now - e.t_enq > self.max_queue_age_s:
+                e.status, e.reply = 503, {"error": "shed: queue age exceeded"}
+                e.retry_after_s = self.shed_retry_after_s
+            else:
+                live.append(e)
+        if live:
+            col = np.empty(len(live), dtype=object)
+            for i, e in enumerate(live):
+                col[i] = e.payload
+            ids = np.asarray([e.uid for e in live], dtype=object)
+            df = DataFrame([{self.input_col: col, "id": ids}])
+            # scoring runs under the TIGHTEST deadline in the batch so any
+            # HTTP fan-out inside the pipeline (io/http, cognitive) clips
+            # its own timeouts/retries to what the most impatient caller
+            # still allows
+            try:
+                with deadline_scope(Deadline(
+                        min(e.t_deadline for e in live), self.clock)):
+                    out = self.model.transform(df).collect()
+                replies = out[self.reply_col]
+                for e, r in zip(live, replies):
+                    e.reply = self.reply_encoder(r)
+            except Exception as ex:  # noqa: BLE001 — reply errors per-request
+                for e in live:
+                    e.status, e.reply = 500, {"error": str(ex)}
+        with self.stats.lock:
+            self._pending -= len(batch)
+        for e in batch:
+            e.done.set()
 
     def _worker(self):
         while not self._stop.is_set():
@@ -264,6 +380,12 @@ class PipelineServer:
     @property
     def address(self) -> str:
         return f"http://{self.host}:{self.port}{self.api_path}"
+
+
+def _retry_after(seconds: float) -> str:
+    """HTTP Retry-After is integer seconds; never advertise 0 (thundering
+    herd of immediate retries)."""
+    return str(max(1, int(round(seconds))))
 
 
 def _default_encode(cell):

@@ -1,0 +1,149 @@
+"""Deterministic fault injection — seeded chaos for the resilience layer.
+
+Every injector owns a ``random.Random(seed)``: the fault sequence is a pure
+function of the seed and the call sequence, so chaos tests replay exactly
+(no real network flakes, no wall-clock races).  Injectors wrap the
+``transport`` callable that ``io/http.HTTPClient`` exposes (monkeypatch an
+instance's ``.transport`` or pass ``transport=`` at construction) and
+compose by nesting::
+
+    t = LatencyInjector(seed=1, rate=0.3, latency_s=0.2, sleep=clk.sleep).wrap(
+        ConnectionErrorInjector(seed=2, rate=0.5).wrap(base_transport))
+    client = HTTPClient(transport=t, clock=clk, sleep=clk.sleep)
+
+Server-side chaos: ``WorkerKiller`` kills a ``WorkerServer``'s socket
+without deregistering (a crash, as the topology service sees it) and can
+restart it on a fresh port, re-registering with the driver — driving the
+health-probe eviction and failover paths end to end.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+from ..io.http import HTTPRequestData, HTTPResponseData
+from ..utils.resilience import FakeClock  # re-export for chaos suites
+
+__all__ = ["ChaosInjector", "LatencyInjector", "ConnectionErrorInjector",
+           "StatusStormInjector", "WorkerKiller", "FakeClock"]
+
+Transport = Callable[[HTTPRequestData, float], HTTPResponseData]
+
+
+class ChaosInjector:
+    """Base: a seeded coin decides per call whether to inject.  ``injected``
+    and ``calls`` counters make assertions about the schedule cheap."""
+
+    def __init__(self, seed: int = 0, rate: float = 1.0):
+        self.rng = random.Random(seed)
+        self.rate = float(rate)
+        self.calls = 0
+        self.injected = 0
+        self._lock = threading.Lock()
+
+    def _fire(self) -> bool:
+        with self._lock:
+            self.calls += 1
+            fire = self.rng.random() < self.rate
+            if fire:
+                self.injected += 1
+            return fire
+
+    def _inject(self, req: HTTPRequestData, timeout_s: float,
+                inner: Transport) -> HTTPResponseData:
+        raise NotImplementedError
+
+    def wrap(self, inner: Transport) -> Transport:
+        def transport(req: HTTPRequestData, timeout_s: float) -> HTTPResponseData:
+            if self._fire():
+                return self._inject(req, timeout_s, inner)
+            return inner(req, timeout_s)
+        return transport
+
+
+class LatencyInjector(ChaosInjector):
+    """Latency spike before the real exchange.  ``sleep`` is injectable —
+    pass a FakeClock's ``sleep`` so spikes advance virtual time only."""
+
+    def __init__(self, seed: int = 0, rate: float = 1.0,
+                 latency_s: float = 0.2,
+                 sleep: Optional[Callable[[float], None]] = None):
+        super().__init__(seed, rate)
+        self.latency_s = latency_s
+        self.sleep = sleep or time.sleep
+
+    def _inject(self, req, timeout_s, inner):
+        self.sleep(self.latency_s)
+        if self.latency_s > timeout_s:
+            raise TimeoutError(
+                f"injected latency {self.latency_s}s > timeout {timeout_s}s")
+        return inner(req, timeout_s)
+
+
+class ConnectionErrorInjector(ChaosInjector):
+    """Transport-level failure (refused/reset), as urllib would raise it."""
+
+    def _inject(self, req, timeout_s, inner):
+        raise ConnectionError(f"injected connection failure -> {req.url}")
+
+
+class StatusStormInjector(ChaosInjector):
+    """HTTP error storm: 429/503 replies with an optional Retry-After, the
+    shape a throttling or overloaded service produces."""
+
+    def __init__(self, seed: int = 0, rate: float = 1.0, status: int = 503,
+                 retry_after_s: Optional[float] = None):
+        super().__init__(seed, rate)
+        self.status = status
+        self.retry_after_s = retry_after_s
+
+    def _inject(self, req, timeout_s, inner):
+        headers = {}
+        if self.retry_after_s is not None:
+            headers["Retry-After"] = str(self.retry_after_s)
+        return HTTPResponseData(status_code=self.status,
+                                reason="injected storm", headers=headers,
+                                entity=b'{"error": "injected"}')
+
+
+class WorkerKiller:
+    """Kill/restart chaos for distributed serving.
+
+    ``kill`` stops the worker's HTTP socket WITHOUT deregistering — exactly
+    what a crashed executor looks like to the driver: still in the routing
+    table until the health prober evicts it.  ``restart`` brings the worker
+    back on a fresh ``PipelineServer`` (same model/config, port 0) and
+    re-registers it.
+    """
+
+    def __init__(self, seed: int = 0):
+        self.rng = random.Random(seed)
+        self.killed: list = []
+
+    def kill(self, worker) -> None:
+        """worker: serving.distributed.WorkerServer"""
+        worker.server.stop()
+        self.killed.append(worker.server_id)
+
+    def kill_one(self, workers) -> object:
+        """Seeded pick — deterministic victim selection."""
+        victim = workers[self.rng.randrange(len(workers))]
+        self.kill(victim)
+        return victim
+
+    def restart(self, worker) -> None:
+        from ..serving.server import PipelineServer
+        old = worker.server
+        worker.server = PipelineServer(
+            old.model, input_col=old.input_col, reply_col=old.reply_col,
+            host=old.host, port=0, api_path=old.api_path, mode=old.mode,
+            max_batch=old.max_batch,
+            micro_batch_interval_ms=old.interval_ms,
+            input_parser=old.input_parser, reply_encoder=old.reply_encoder,
+            request_timeout_s=old.request_timeout_s,
+            max_queue_depth=old.max_queue_depth,
+            max_queue_age_s=old.max_queue_age_s,
+            shed_retry_after_s=old.shed_retry_after_s, clock=old.clock)
+        worker.start()

@@ -1,6 +1,11 @@
 from .cluster import ClusterUtil
 from .stopwatch import StopWatch
-from .fault import retry_with_timeout, with_retries
+from .resilience import (CircuitBreaker, CircuitOpenError, Deadline,
+                         DeadlineExceeded, FakeClock, current_deadline,
+                         deadline_scope, retry_with_timeout, with_retries)
 from .streams import using
 
-__all__ = ["ClusterUtil", "StopWatch", "retry_with_timeout", "with_retries", "using"]
+__all__ = ["ClusterUtil", "StopWatch", "retry_with_timeout", "with_retries",
+           "using", "CircuitBreaker", "CircuitOpenError", "Deadline",
+           "DeadlineExceeded", "FakeClock", "current_deadline",
+           "deadline_scope"]

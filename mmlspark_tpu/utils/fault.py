@@ -1,49 +1,18 @@
-"""Fault tolerance — retry/timeout wrappers around flaky init paths.
+"""Fault tolerance — compatibility facade over ``utils/resilience.py``.
 
-Reference: ``core/utils/FaultToleranceUtils.scala`` (``retryWithTimeout``
-guarding native/network init at ``TrainUtils.scala:339``,
-``VowpalWabbitBase.scala:347``) and the exponential-backoff retry loop in
-``TrainUtils.networkInit`` (``TrainUtils.scala:279-295``).
+The original 49-line retry/timeout wrappers (reference:
+``core/utils/FaultToleranceUtils.scala`` ``retryWithTimeout`` at
+``TrainUtils.scala:339`` / ``VowpalWabbitBase.scala:347``, and the
+exponential-backoff loop in ``TrainUtils.networkInit``,
+``TrainUtils.scala:279-295``) grew into the full resilience subsystem —
+circuit breakers, deadline propagation, budget-aware retries.  Existing
+imports of ``utils.fault`` keep working; new code should import from
+``mmlspark_tpu.utils.resilience`` directly.
 """
-from __future__ import annotations
+from .resilience import (CircuitBreaker, CircuitOpenError, Deadline,
+                         DeadlineExceeded, FakeClock, current_deadline,
+                         deadline_scope, retry_with_timeout, with_retries)
 
-import concurrent.futures
-import time
-from typing import Callable, Tuple, Type, TypeVar
-
-T = TypeVar("T")
-
-
-def retry_with_timeout(fn: Callable[[], T], timeout_s: float, retries: int = 3) -> T:
-    """Run fn with a wall-clock timeout, retrying on timeout or error."""
-    last: Exception = RuntimeError("no attempts made")
-    for _ in range(max(1, retries)):
-        ex = concurrent.futures.ThreadPoolExecutor(max_workers=1)
-        fut = ex.submit(fn)
-        try:
-            return fut.result(timeout=timeout_s)
-        except concurrent.futures.TimeoutError:
-            last = TimeoutError(f"operation exceeded {timeout_s}s")
-        except Exception as e:  # noqa: BLE001 — retried, re-raised at end
-            last = e
-        finally:
-            # wait=False so a hung fn doesn't block the caller past timeout_s;
-            # the worker thread is daemonic-ish leaked but control returns.
-            ex.shutdown(wait=False)
-    raise last
-
-
-def with_retries(fn: Callable[[], T], retries: int = 3, initial_delay_s: float = 0.1,
-                 backoff: float = 2.0,
-                 exceptions: Tuple[Type[BaseException], ...] = (Exception,)) -> T:
-    """Exponential-backoff retry (reference networkInit retry pattern)."""
-    retries = max(1, retries)
-    delay = initial_delay_s
-    for attempt in range(retries):
-        try:
-            return fn()
-        except exceptions:
-            if attempt == retries - 1:
-                raise
-            time.sleep(delay)
-            delay *= backoff
+__all__ = ["retry_with_timeout", "with_retries", "CircuitBreaker",
+           "CircuitOpenError", "Deadline", "DeadlineExceeded", "FakeClock",
+           "current_deadline", "deadline_scope"]

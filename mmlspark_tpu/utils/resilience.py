@@ -1,0 +1,347 @@
+"""Resilience primitives — circuit breakers, deadlines, budget-aware retries.
+
+Grown out of ``utils/fault.py`` (reference:
+``core/utils/FaultToleranceUtils.scala`` ``retryWithTimeout`` guarding
+native/network init, and the exponential-backoff loop in
+``TrainUtils.networkInit``).  The MMLSpark papers frame serving and the
+cognitive layer as production web services; this module supplies the failure
+machinery those boundaries need:
+
+- ``CircuitBreaker`` — closed/open/half-open with a rolling failure window,
+  so a dead dependency is rejected fast instead of timing out per call;
+- ``Deadline`` — a request budget carried via contextvar from admission
+  through batch scoring, HTTP fan-out, and retries, so no retry loop ever
+  overshoots what the caller is still willing to wait for;
+- budget-aware ``with_retries`` / ``retry_with_timeout`` (the fault.py
+  originals, now deadline-clipped).
+
+Every primitive takes an injectable ``clock`` (and ``sleep`` where it
+waits), so the chaos suite (``testing/chaos.py`` + ``tests/
+test_resilience.py``) drives all state transitions deterministically —
+no wall-clock sleeps, no flakes.
+"""
+from __future__ import annotations
+
+import collections
+import concurrent.futures
+import threading
+import time
+from contextlib import contextmanager
+from contextvars import ContextVar
+from typing import Callable, Deque, Optional, Tuple, Type, TypeVar
+
+T = TypeVar("T")
+
+
+# ---------------------------------------------------------------------------
+# clocks
+# ---------------------------------------------------------------------------
+
+class FakeClock:
+    """Deterministic manual clock for tests: ``now()``/``__call__`` read the
+    time, ``sleep``/``advance`` move it.  Thread-safe so server threads and
+    the test driver can share one instance."""
+
+    def __init__(self, start: float = 0.0):
+        self._t = float(start)
+        self._lock = threading.Lock()
+
+    def __call__(self) -> float:
+        with self._lock:
+            return self._t
+
+    now = __call__
+
+    def sleep(self, seconds: float) -> None:
+        with self._lock:
+            self._t += max(0.0, float(seconds))
+
+    advance = sleep
+
+
+# ---------------------------------------------------------------------------
+# deadlines
+# ---------------------------------------------------------------------------
+
+class DeadlineExceeded(TimeoutError):
+    """The caller's remaining budget reached zero."""
+
+
+class Deadline:
+    """An absolute point (on an injectable monotonic clock) after which work
+    on behalf of this request is pointless.  Carried through call stacks via
+    ``deadline_scope`` so retries/timeouts anywhere below clip themselves to
+    ``remaining()`` instead of their own configured maxima."""
+
+    __slots__ = ("expires_at", "clock")
+
+    def __init__(self, expires_at: float, clock: Callable[[], float] = time.monotonic):
+        self.expires_at = float(expires_at)
+        self.clock = clock
+
+    @classmethod
+    def after(cls, seconds: float,
+              clock: Callable[[], float] = time.monotonic) -> "Deadline":
+        return cls(clock() + float(seconds), clock)
+
+    def remaining(self) -> float:
+        return self.expires_at - self.clock()
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def clip(self, timeout_s: float) -> float:
+        """A timeout that never overshoots the remaining budget (>= 0)."""
+        return max(0.0, min(float(timeout_s), self.remaining()))
+
+    def check(self) -> None:
+        if self.expired():
+            raise DeadlineExceeded(f"deadline overdue by {-self.remaining():.3f}s")
+
+    # wire format: remaining budget in milliseconds (relative, so it survives
+    # hosts with unsynchronized clocks — the receiver re-anchors on arrival)
+    HEADER = "X-MMLSpark-Deadline-Ms"
+
+    def to_header(self) -> str:
+        return str(max(0, int(self.remaining() * 1000)))
+
+    @classmethod
+    def from_header(cls, value: str,
+                    clock: Callable[[], float] = time.monotonic) -> "Deadline":
+        return cls.after(max(0.0, float(value)) / 1000.0, clock)
+
+    def __repr__(self):
+        return f"Deadline(remaining={self.remaining():.3f}s)"
+
+
+_current_deadline: ContextVar[Optional[Deadline]] = \
+    ContextVar("mmlspark_tpu_deadline", default=None)
+
+
+def current_deadline() -> Optional[Deadline]:
+    """The innermost active deadline in this context, or None."""
+    return _current_deadline.get()
+
+
+@contextmanager
+def deadline_scope(deadline_or_seconds,
+                   clock: Callable[[], float] = time.monotonic):
+    """Install a deadline for the duration of the block.  Nested scopes keep
+    the TIGHTER bound — a caller's budget can only shrink downstream."""
+    if isinstance(deadline_or_seconds, Deadline):
+        d = deadline_or_seconds
+    else:
+        d = Deadline.after(float(deadline_or_seconds), clock)
+    outer = _current_deadline.get()
+    if outer is not None and outer.expires_at < d.expires_at \
+            and outer.clock is d.clock:
+        d = outer
+    token = _current_deadline.set(d)
+    try:
+        yield d
+    finally:
+        _current_deadline.reset(token)
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+class CircuitOpenError(ConnectionError):
+    """Raised (or mapped to a synthetic 503) when the breaker rejects a call
+    without attempting it."""
+
+    def __init__(self, name: str, retry_after_s: float):
+        self.retry_after_s = max(0.0, retry_after_s)
+        super().__init__(
+            f"circuit breaker {name or '<anon>'} is open; "
+            f"retry after {self.retry_after_s:.1f}s")
+
+
+class CircuitBreaker:
+    """Classic three-state breaker over a rolling failure window.
+
+    - ``closed``: calls flow; failures older than ``window_s`` are forgotten;
+      ``failure_threshold`` failures inside the window trip it open.
+    - ``open``: every call is rejected until ``cooldown_s`` has elapsed.
+    - ``half_open``: up to ``half_open_max_calls`` probe calls are admitted;
+      one success closes the breaker (window cleared), one failure reopens it
+      (cooldown restarts).
+
+    All transitions run on the injectable ``clock``, so tests step them
+    deterministically.  Thread-safe; shared freely across client instances
+    guarding the same dependency.
+    """
+
+    def __init__(self, failure_threshold: int = 5, window_s: float = 30.0,
+                 cooldown_s: float = 10.0, half_open_max_calls: int = 1,
+                 clock: Callable[[], float] = time.monotonic, name: str = ""):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = failure_threshold
+        self.window_s = float(window_s)
+        self.cooldown_s = float(cooldown_s)
+        self.half_open_max_calls = max(1, half_open_max_calls)
+        self.clock = clock
+        self.name = name
+        self._lock = threading.Lock()
+        self._failures: Deque[float] = collections.deque()
+        self._state = "closed"
+        self._opened_at = 0.0
+        self._half_open_inflight = 0
+        # observability counters (aggregated into serving /stats)
+        self.rejected = 0
+        self.opened_count = 0
+
+    # ------------------------------------------------------------- queries
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open()
+            return self._state
+
+    def retry_after_s(self) -> float:
+        """Seconds until an open breaker will admit a probe (0 if not open)."""
+        with self._lock:
+            if self._state != "open":
+                return 0.0
+            return max(0.0, self._opened_at + self.cooldown_s - self.clock())
+
+    def _maybe_half_open(self) -> None:
+        # caller holds the lock
+        if self._state == "open" and \
+                self.clock() - self._opened_at >= self.cooldown_s:
+            self._state = "half_open"
+            self._half_open_inflight = 0
+
+    # ------------------------------------------------------------- protocol
+    def allow(self) -> bool:
+        """Admission check; half-open admits a bounded number of probes.
+        Callers that take an admission MUST report the outcome via
+        ``record_success``/``record_failure`` (or use ``call``)."""
+        with self._lock:
+            self._maybe_half_open()
+            if self._state == "closed":
+                return True
+            if self._state == "half_open":
+                if self._half_open_inflight < self.half_open_max_calls:
+                    self._half_open_inflight += 1
+                    return True
+            self.rejected += 1
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self._state != "closed":
+                # half-open probe succeeded: close and start fresh
+                self._state = "closed"
+                self._failures.clear()
+                self._half_open_inflight = 0
+            # closed: successes do NOT clear the window — a dependency
+            # failing half its calls must still trip; old failures age
+            # out of the rolling window on their own
+
+    def record_failure(self) -> None:
+        with self._lock:
+            now = self.clock()
+            if self._state == "half_open":
+                self._trip(now)
+                return
+            self._failures.append(now)
+            while self._failures and now - self._failures[0] > self.window_s:
+                self._failures.popleft()
+            if self._state == "closed" and \
+                    len(self._failures) >= self.failure_threshold:
+                self._trip(now)
+
+    def _trip(self, now: float) -> None:
+        # caller holds the lock
+        self._state = "open"
+        self._opened_at = now
+        self._failures.clear()
+        self._half_open_inflight = 0
+        self.opened_count += 1
+
+    def call(self, fn: Callable[[], T]) -> T:
+        """Run fn under the breaker: rejected-fast when open, outcome
+        recorded otherwise.  Exceptions from fn count as failures and
+        propagate."""
+        if not self.allow():
+            raise CircuitOpenError(self.name, self.retry_after_s())
+        try:
+            result = fn()
+        except Exception:
+            self.record_failure()
+            raise
+        self.record_success()
+        return result
+
+    def as_dict(self) -> dict:
+        with self._lock:
+            return {"state": self._state, "failures_in_window": len(self._failures),
+                    "rejected": self.rejected, "opened_count": self.opened_count}
+
+
+# ---------------------------------------------------------------------------
+# budget-aware retries (the fault.py originals, deadline-clipped)
+# ---------------------------------------------------------------------------
+
+def retry_with_timeout(fn: Callable[[], T], timeout_s: float,
+                       retries: int = 3,
+                       deadline: Optional[Deadline] = None) -> T:
+    """Run fn with a wall-clock timeout, retrying on timeout or error.
+    Honors the ambient ``deadline_scope`` (or an explicit ``deadline``):
+    each attempt's timeout is clipped to the remaining budget and no attempt
+    starts once the budget is gone."""
+    deadline = deadline or current_deadline()
+    last: Exception = RuntimeError("no attempts made")
+    for _ in range(max(1, retries)):
+        attempt_timeout = timeout_s
+        if deadline is not None:
+            if deadline.expired():
+                raise DeadlineExceeded(
+                    f"budget exhausted before attempt; last: {last}")
+            attempt_timeout = deadline.clip(timeout_s)
+        ex = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+        fut = ex.submit(fn)
+        try:
+            return fut.result(timeout=attempt_timeout)
+        except concurrent.futures.TimeoutError:
+            last = TimeoutError(f"operation exceeded {attempt_timeout}s")
+        except Exception as e:  # noqa: BLE001 — retried, re-raised at end
+            last = e
+        finally:
+            # wait=False so a hung fn doesn't block the caller past timeout_s;
+            # the worker thread is daemonic-ish leaked but control returns.
+            ex.shutdown(wait=False)
+    raise last
+
+
+def with_retries(fn: Callable[[], T], retries: int = 3,
+                 initial_delay_s: float = 0.1, backoff: float = 2.0,
+                 exceptions: Tuple[Type[BaseException], ...] = (Exception,),
+                 deadline: Optional[Deadline] = None,
+                 sleep: Callable[[float], None] = time.sleep) -> T:
+    """Exponential-backoff retry (reference networkInit retry pattern),
+    clipped to the ambient/explicit deadline: backoff sleeps never overshoot
+    the remaining budget, and once the budget is spent the last error is
+    raised instead of burning further attempts."""
+    retries = max(1, retries)
+    deadline = deadline or current_deadline()
+    delay = initial_delay_s
+    for attempt in range(retries):
+        if deadline is not None and deadline.expired():
+            raise DeadlineExceeded("budget exhausted before attempt")
+        try:
+            return fn()
+        except exceptions:
+            if attempt == retries - 1:
+                raise
+            if deadline is not None:
+                remaining = deadline.remaining()
+                if remaining <= 0:
+                    raise
+                sleep(min(delay, remaining))
+            else:
+                sleep(delay)
+            delay *= backoff

@@ -85,6 +85,8 @@ def _run_or_verify(bench: Benchmarks):
     bench.verify()
 
 
+@pytest.mark.slow  # ~160 s on the 2-core CI box: 24% of the whole tier-1
+#                    budget for one test — runs in the slow lane instead
 def test_lightgbm_classifier_benchmarks():
     from mmlspark_tpu.lightgbm import LightGBMClassifier
     bench = Benchmarks(os.path.join(RES, "benchmarks_VerifyLightGBMClassifier.csv"))
@@ -100,6 +102,7 @@ def test_lightgbm_classifier_benchmarks():
     _run_or_verify(bench)
 
 
+@pytest.mark.slow  # ~70 s on the 2-core CI box (see classifier note)
 def test_lightgbm_regressor_benchmarks():
     from mmlspark_tpu.lightgbm import LightGBMRegressor
     bench = Benchmarks(os.path.join(RES, "benchmarks_VerifyLightGBMRegressor.csv"))

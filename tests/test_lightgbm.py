@@ -165,9 +165,14 @@ def test_sharded_training_matches(mesh8):
     assert np.allclose(a, b, atol=1e-4), np.abs(a - b).max()
 
 
-def test_histogram_backends_agree():
+@pytest.mark.parametrize("layout", ["sort", "cumsum"])
+def test_histogram_backends_agree(layout, monkeypatch):
     import jax.numpy as jnp
     from mmlspark_tpu.ops.histogram import build_histograms, build_histograms_matmul
+    # row-layout knob is read at trace time inside the matmul backend;
+    # both layouts must produce identical histograms (cumsum only engages
+    # when P+1 <= 33 — true here, P=4)
+    monkeypatch.setenv("MMLSPARK_TPU_HIST_LAYOUT", layout)
     rng = np.random.default_rng(7)
     n, f, b, p = 3000, 9, 255, 4
     binned = jnp.asarray(rng.integers(0, b, (n, f)).astype(np.uint8))

@@ -75,6 +75,9 @@ def test_committed_checkpoint_drives_jax_model_transformer():
     assert float((pred == yte).mean()) > 0.95
 
 
+@pytest.mark.slow  # ~46 s on the 2-core CI box: transfer-protocol probe
+#                    training dominates; the checkpoint-load/accuracy test
+#                    above stays tier-1
 def test_backbone_checkpoint_transfer_lift():
     """The trained vision backbone (VERDICT r4 #6): the committed
     ShapesResNet20 checkpoint loads through ModelDownloader, reproduces its

@@ -1,0 +1,548 @@
+"""Resilience layer: circuit breakers, deadline propagation, load shedding,
+health-checked failover — driven by the deterministic chaos harness
+(``mmlspark_tpu/testing/chaos.py``).  Everything tier-1 here runs on fake
+clocks / seeded injectors: no flaky sleeps, no real waits above ~100 ms.
+Real kill/restart scenarios live under the ``chaos`` marker (outside tier-1).
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+import zlib
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import DataFrame, Transformer
+from mmlspark_tpu.io.http import (HTTPClient, HTTPRequestData,
+                                  HTTPResponseData)
+from mmlspark_tpu.serving import (PipelineServer, RoutingClient,
+                                  TopologyService, WorkerServer)
+from mmlspark_tpu.testing.chaos import (ConnectionErrorInjector, FakeClock,
+                                        LatencyInjector, StatusStormInjector,
+                                        WorkerKiller)
+from mmlspark_tpu.utils.resilience import (CircuitBreaker, CircuitOpenError,
+                                           Deadline, DeadlineExceeded,
+                                           current_deadline, deadline_scope,
+                                           retry_with_timeout, with_retries)
+from tests.serving_helpers import Doubler
+
+
+def _ok_transport(req, timeout_s):
+    return HTTPResponseData(status_code=200, reason="OK", entity=b"{}")
+
+
+# ---------------------------------------------------------------- breaker
+
+def test_breaker_opens_after_n_failures_and_half_opens_after_cooldown():
+    clk = FakeClock()
+    b = CircuitBreaker(failure_threshold=3, window_s=30.0, cooldown_s=10.0,
+                       clock=clk, name="svc")
+    assert b.state == "closed"
+    for _ in range(2):
+        b.record_failure()
+    assert b.state == "closed"          # below threshold
+    b.record_failure()
+    assert b.state == "open"            # N failures in window -> open
+    assert not b.allow()
+    assert 0 < b.retry_after_s() <= 10.0
+
+    clk.advance(9.9)
+    assert not b.allow()                # cooldown not elapsed
+    clk.advance(0.2)
+    assert b.state == "half_open"       # cooldown elapsed -> half-open
+    assert b.allow()                    # one probe admitted
+    assert not b.allow()                # ...and only one
+    b.record_success()
+    assert b.state == "closed"          # probe success closes
+
+
+def test_breaker_half_open_failure_reopens():
+    clk = FakeClock()
+    b = CircuitBreaker(failure_threshold=1, cooldown_s=5.0, clock=clk)
+    b.record_failure()
+    assert b.state == "open"
+    clk.advance(5.0)
+    assert b.allow()                    # half-open probe
+    b.record_failure()
+    assert b.state == "open"            # probe failure reopens
+    assert not b.allow()
+    clk.advance(5.0)
+    assert b.state == "half_open"       # cooldown restarted from the refailure
+
+
+def test_breaker_rolling_window_forgets_old_failures():
+    clk = FakeClock()
+    b = CircuitBreaker(failure_threshold=3, window_s=10.0, clock=clk)
+    for _ in range(5):                  # failures spaced wider than the window
+        b.record_failure()
+        clk.advance(11.0)
+    assert b.state == "closed"
+
+
+def test_breaker_trips_on_failure_rate_despite_interleaved_successes():
+    # a dependency failing half its calls must still trip: successes do not
+    # wipe the rolling failure window while closed
+    clk = FakeClock()
+    b = CircuitBreaker(failure_threshold=3, window_s=30.0, clock=clk)
+    for _ in range(2):
+        b.record_failure()
+        b.record_success()
+        clk.advance(1.0)
+        assert b.state == "closed"
+    b.record_failure()
+    assert b.state == "open"
+
+
+def test_breaker_call_raises_circuit_open():
+    clk = FakeClock()
+    b = CircuitBreaker(failure_threshold=1, cooldown_s=7.0, clock=clk, name="x")
+    with pytest.raises(ValueError):
+        b.call(lambda: (_ for _ in ()).throw(ValueError("boom")))
+    with pytest.raises(CircuitOpenError) as ei:
+        b.call(lambda: 1)
+    assert ei.value.retry_after_s <= 7.0
+    assert b.as_dict()["rejected"] == 1
+
+
+# --------------------------------------------------------------- deadlines
+
+def test_deadline_scope_nests_to_tighter_bound():
+    clk = FakeClock()
+    with deadline_scope(10.0, clock=clk) as outer:
+        with deadline_scope(2.0, clock=clk) as inner:
+            assert inner.remaining() == pytest.approx(2.0)
+            # a LOOSER inner scope keeps the outer (tighter) bound
+            with deadline_scope(99.0, clock=clk) as d3:
+                assert d3.expires_at == inner.expires_at
+        assert current_deadline() is outer
+    assert current_deadline() is None
+    # header round trip re-anchors the remaining budget
+    clk.advance(1.0)
+    d = Deadline.after(0.25, clk)
+    assert Deadline.from_header(d.to_header(), clk).remaining() == \
+        pytest.approx(0.25, abs=2e-3)
+
+
+def test_with_retries_never_sleeps_past_budget():
+    clk = FakeClock()
+    sleeps = []
+
+    def sleep(s):
+        sleeps.append(s)
+        clk.advance(s)
+
+    calls = [0]
+
+    def fn():
+        calls[0] += 1
+        raise ConnectionError("down")
+
+    with pytest.raises((DeadlineExceeded, ConnectionError)):
+        with_retries(fn, retries=10, initial_delay_s=0.15, backoff=2.0,
+                     deadline=Deadline.after(0.2, clk), sleep=sleep)
+    assert clk() <= 0.2 + 1e-9          # total sleep clipped to the budget
+    assert sleeps == [pytest.approx(0.15), pytest.approx(0.05)]
+    assert calls[0] == 2                # no attempt burned after exhaustion
+
+
+def test_retry_with_timeout_respects_deadline():
+    clk = FakeClock()
+    # budget already spent: no attempt is even started
+    expired = Deadline.after(0.0, clk)
+    clk.advance(0.1)
+    calls = [0]
+
+    def fn():
+        calls[0] += 1
+        return 42
+
+    with pytest.raises(DeadlineExceeded):
+        retry_with_timeout(fn, timeout_s=5.0, deadline=expired)
+    assert calls[0] == 0
+    # live budget: runs fine (real thread, instant fn)
+    assert retry_with_timeout(fn, timeout_s=5.0,
+                              deadline=Deadline.after(30.0)) == 42
+
+
+def test_http_client_200ms_deadline_never_retries_past_budget():
+    clk = FakeClock()
+    inj = ConnectionErrorInjector(seed=3, rate=1.0)
+    client = HTTPClient(retries=10, backoff_ms=[100],
+                        transport=inj.wrap(_ok_transport),
+                        clock=clk, sleep=clk.sleep)
+    req = HTTPRequestData(url="http://svc/x")
+    with deadline_scope(Deadline.after(0.2, clk)):
+        resp = client.send(req)
+    assert resp.status_code == 0            # last transport error, no raise
+    assert clk() <= 0.2 + 1e-9              # clock never ran past the budget
+    # attempts at t=0 and t=0.1; the second backoff is clipped to land ON
+    # the budget boundary, where no further attempt is admitted
+    assert inj.calls == 2
+
+
+def test_http_client_breaker_short_circuits_and_recovers():
+    clk = FakeClock()
+    b = CircuitBreaker(failure_threshold=3, window_s=60.0, cooldown_s=5.0,
+                       clock=clk, name="edge")
+    inj = ConnectionErrorInjector(seed=1, rate=1.0)
+    client = HTTPClient(retries=0, breaker=b,
+                        transport=inj.wrap(_ok_transport),
+                        clock=clk, sleep=clk.sleep)
+    req = HTTPRequestData(url="http://svc/x")
+    for _ in range(3):
+        assert client.send(req).status_code == 0
+    assert b.state == "open"
+    resp = client.send(req)                 # rejected without a network call
+    assert resp.status_code == 503 and resp.reason == "circuit open"
+    assert resp.headers.get("X-Circuit-Open") == "1"
+    assert inj.calls == 3                   # transport untouched while open
+
+    clk.advance(5.0)                        # cooldown -> half-open probe
+    client.transport = _ok_transport        # dependency recovered
+    assert client.send(req).status_code == 200
+    assert b.state == "closed"
+
+
+def test_expired_deadline_never_leaks_half_open_probe_slot():
+    # regression: a deadline-expired send must bail BEFORE taking a breaker
+    # admission — an allow() with no recorded outcome would pin the breaker
+    # in half_open (its only probe slot consumed) forever
+    clk = FakeClock()
+    b = CircuitBreaker(failure_threshold=1, cooldown_s=5.0, clock=clk)
+    client = HTTPClient(retries=0, breaker=b, transport=_ok_transport,
+                        clock=clk, sleep=clk.sleep)
+    req = HTTPRequestData(url="http://svc/x")
+    b.record_failure()                      # open
+    clk.advance(5.0)                        # half-open, 1 probe slot
+    dead = Deadline.after(0.0, clk)
+    clk.advance(0.1)
+    resp = client.send(req, deadline=dead)  # expired: no probe consumed
+    assert resp.status_code == 0 and "deadline" in resp.reason
+    assert client.send(req).status_code == 200  # the probe slot is still free
+    assert b.state == "closed"
+
+
+def test_http_client_503_storm_trips_breaker():
+    clk = FakeClock()
+    b = CircuitBreaker(failure_threshold=2, clock=clk)
+    storm = StatusStormInjector(seed=5, rate=1.0, status=503, retry_after_s=0.2)
+    client = HTTPClient(retries=0, breaker=b, transport=storm.wrap(_ok_transport),
+                        clock=clk, sleep=clk.sleep)
+    req = HTTPRequestData(url="http://svc/x")
+    assert client.send(req).status_code == 503
+    assert client.send(req).status_code == 503
+    assert b.state == "open"
+
+
+def test_chaos_injectors_are_seed_deterministic():
+    def schedule(seed):
+        inj = ConnectionErrorInjector(seed=seed, rate=0.5)
+        t = inj.wrap(_ok_transport)
+        out = []
+        for _ in range(64):
+            try:
+                t(HTTPRequestData(url="http://x"), 1.0)
+                out.append(0)
+            except ConnectionError:
+                out.append(1)
+        return out
+
+    assert schedule(7) == schedule(7)       # replayable
+    assert schedule(7) != schedule(8)       # and actually seeded
+    assert 10 < sum(schedule(7)) < 54       # rate ~0.5 materializes
+
+
+def test_latency_injector_advances_fake_clock_only():
+    clk = FakeClock()
+    inj = LatencyInjector(seed=2, rate=1.0, latency_s=3.0, sleep=clk.sleep)
+    t0 = time.perf_counter()
+    resp = inj.wrap(_ok_transport)(HTTPRequestData(url="http://x"), 10.0)
+    assert resp.status_code == 200
+    assert clk() == pytest.approx(3.0)
+    assert time.perf_counter() - t0 < 1.0   # virtual spike, real time untouched
+
+
+# --------------------------------------------------------- server shedding
+
+class GatedDoubler(Transformer):
+    """Doubler that blocks scoring until the gate opens."""
+
+    def __init__(self, gate):
+        super().__init__()
+        self.gate = gate
+
+    def _transform(self, df):
+        self.gate.wait(10.0)
+
+        def per_part(p):
+            vals = np.asarray([2 * float(v) for v in p["request"]], float)
+            return {**p, "reply": vals}
+        return df.map_partitions(per_part)
+
+    def transform_schema(self, schema):
+        return schema
+
+
+def _wait_for(cond, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+def _post_in_thread(url, payload, results, key, timeout=10):
+    def run():
+        try:
+            req = urllib.request.Request(
+                url, data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                results[key] = ("ok", json.loads(r.read().decode()))
+        except urllib.error.HTTPError as e:
+            results[key] = (e.code, dict(e.headers))
+        except Exception as e:  # noqa: BLE001
+            results[key] = ("err", str(e))
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def test_server_sheds_overload_with_503_retry_after_and_consistent_stats():
+    gate = threading.Event()
+    srv = PipelineServer(GatedDoubler(gate), port=0, mode="continuous",
+                         max_queue_depth=2, request_timeout_s=8.0).start()
+    results, threads = {}, []
+    try:
+        # rq0 occupies the scorer (inline path, gated); rq1 queues behind it
+        threads.append(_post_in_thread(srv.address, 1, results, "rq0"))
+        assert _wait_for(lambda: srv._pending == 1)
+        threads.append(_post_in_thread(srv.address, 2, results, "rq1"))
+        assert _wait_for(lambda: srv._pending == 2)
+        # admission full: the third request is shed immediately, not queued
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            req = urllib.request.Request(
+                srv.address, data=b"3",
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 503
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        gate.set()                      # drain the admitted requests
+        for t in threads:
+            t.join(timeout=10)
+        assert results["rq0"] == ("ok", 2.0)
+        assert results["rq1"] == ("ok", 4.0)
+        assert _wait_for(lambda: srv._pending == 0)
+        s = srv.stats.as_dict()
+        assert s["received"] == 3 and s["replied"] == 2
+        assert s["shed"] == 1 and s["errors"] == 0
+        assert s["received"] == s["replied"] + s["errors"] + s["shed"]
+    finally:
+        gate.set()
+        srv.stop()
+
+
+def test_server_expires_queued_deadline_and_age_sheds():
+    gate = threading.Event()
+    srv = PipelineServer(GatedDoubler(gate), port=0, mode="continuous",
+                         max_queue_age_s=30.0, request_timeout_s=8.0).start()
+    results = {}
+    try:
+        t0 = _post_in_thread(srv.address, 1, results, "blocker")
+        assert _wait_for(lambda: srv._pending == 1)
+        # 20 ms budget, scorer gated: the handler returns 504 at the deadline
+        # and the scorer later drops the entry without scoring it
+        def post_deadline():
+            req = urllib.request.Request(
+                srv.address, data=b"2",
+                headers={"Content-Type": "application/json",
+                         Deadline.HEADER: "20"})
+            try:
+                with urllib.request.urlopen(req, timeout=5) as r:
+                    results["dl"] = ("ok", json.loads(r.read().decode()))
+            except urllib.error.HTTPError as e:
+                results["dl"] = (e.code, e.read().decode())
+        t1 = threading.Thread(target=post_deadline, daemon=True)
+        t1.start()
+        t1.join(timeout=5)
+        assert results["dl"][0] == 504
+        gate.set()
+        t0.join(timeout=10)
+        assert results["blocker"] == ("ok", 2.0)
+        assert _wait_for(lambda: srv._pending == 0)
+        s = srv.stats.as_dict()
+        assert s["received"] == 2 and s["replied"] == 1 and s["errors"] == 1
+        assert s["received"] == s["replied"] + s["errors"] + s["shed"]
+    finally:
+        gate.set()
+        srv.stop()
+
+
+def test_server_age_sheds_stale_queue_entries():
+    gate = threading.Event()
+    srv = PipelineServer(GatedDoubler(gate), port=0, mode="continuous",
+                         max_queue_age_s=0.05, request_timeout_s=8.0).start()
+    results = {}
+    try:
+        t0 = _post_in_thread(srv.address, 1, results, "blocker")
+        assert _wait_for(lambda: srv._pending == 1)
+        # queued behind the gate long enough to exceed max_queue_age_s, so
+        # on release the scorer sheds it with 503 + Retry-After
+        t1 = _post_in_thread(srv.address, 2, results, "stale")
+        assert _wait_for(lambda: srv._pending == 2)
+        time.sleep(0.06)
+        gate.set()
+        t0.join(timeout=10)
+        t1.join(timeout=10)
+        assert results["blocker"] == ("ok", 2.0)
+        code, headers = results["stale"]
+        assert code == 503 and int(headers["Retry-After"]) >= 1
+        assert _wait_for(lambda: srv._pending == 0)
+        s = srv.stats.as_dict()
+        assert s["received"] == 2 and s["replied"] == 1 and s["shed"] == 1
+        assert s["received"] == s["replied"] + s["errors"] + s["shed"]
+    finally:
+        gate.set()
+        srv.stop()
+
+
+# ------------------------------------------------------ failover / probing
+
+def test_probe_evicts_dead_worker_and_failover_keeps_success_at_100pct():
+    svc = TopologyService(probe_interval_s=None, evict_after=2).start()
+    workers = [WorkerServer(Doubler(), server_id=f"w{i}",
+                            driver_address=svc.address, port=0).start()
+               for i in range(2)]
+    killer = WorkerKiller(seed=11)
+    try:
+        client = RoutingClient(svc.address)
+        assert client.request(3) == 6
+
+        killer.kill(workers[0])             # crash: socket dead, still registered
+        assert set(svc.routing_table()) == {"w0", "w1"}
+        assert svc.probe_once() == []       # strike one
+        assert svc.probe_once() == ["w0"]   # strike two -> evicted
+        assert set(svc.routing_table()) == {"w1"}
+        assert svc.aggregate_stats()["evicted"] == ["w0"]
+
+        # stale client table + fresh client: every request must succeed
+        fresh = RoutingClient(svc.address)
+        for i in range(10):
+            assert client.request(i) == 2 * i
+            assert fresh.request(i, key=f"k{i}") == 2 * i
+    finally:
+        for w in workers:
+            w.stop()
+        svc.stop()
+
+
+def test_routing_client_fails_over_exactly_once(monkeypatch):
+    from mmlspark_tpu.serving import distributed as dist
+    svc = TopologyService(probe_interval_s=None).start()
+    workers = [WorkerServer(Doubler(), server_id=f"w{i}",
+                            driver_address=svc.address, port=0).start()
+               for i in range(2)]
+    try:
+        calls = []
+        real = dist._http_json
+
+        def counting(url, payload=None, **kw):
+            if "/score" in url:
+                calls.append(url)
+            return real(url, payload, **kw)
+
+        monkeypatch.setattr(dist, "_http_json", counting)
+        workers[0].server.stop()            # dead but registered
+        client = RoutingClient(svc.address, failover_retries=1)
+        # a key that hash-routes onto the dead worker w0 (sorted table)
+        key = next(f"k{i}" for i in range(64)
+                   if zlib.crc32(f"k{i}".encode()) % 2 == 0)
+        calls.clear()
+        assert client.request(5, key=key) == 10
+        assert len(calls) == 2              # primary + exactly one failover
+        assert str(workers[1].server.port) in calls[-1]
+
+        # zero failovers allowed: the dead route must surface the failure
+        strict = RoutingClient(svc.address, failover_retries=0)
+        with pytest.raises(RuntimeError):
+            strict.request(5, key=key)
+    finally:
+        for w in workers:
+            w.stop()
+        svc.stop()
+
+
+class DeadlineProbeModel(Transformer):
+    """Records the ambient deadline the scorer installed."""
+
+    seen: dict = {}
+
+    def _transform(self, df):
+        dl = current_deadline()
+        DeadlineProbeModel.seen["remaining"] = \
+            dl.remaining() if dl is not None else None
+
+        def per_part(p):
+            return {**p, "reply": p["request"]}
+        return df.map_partitions(per_part)
+
+    def transform_schema(self, schema):
+        return schema
+
+
+def test_deadline_propagates_client_header_to_scoring_scope():
+    svc = TopologyService(probe_interval_s=None).start()
+    w = WorkerServer(DeadlineProbeModel(), server_id="w0",
+                     driver_address=svc.address, port=0).start()
+    try:
+        DeadlineProbeModel.seen.clear()
+        client = RoutingClient(svc.address)
+        with deadline_scope(0.5):
+            assert client.request(7) == 7
+        remaining = DeadlineProbeModel.seen["remaining"]
+        # the scorer ran under the CLIENT's ~500 ms budget, not the server's
+        # 30 s default: header -> admission -> deadline_scope around transform
+        assert remaining is not None and 0.0 < remaining <= 0.5
+    finally:
+        w.stop()
+        svc.stop()
+
+
+# ------------------------------------------------------------- chaos tier
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_kill_restart_cycle_with_live_probing():
+    """Full cycle on real sockets + the background prober: kill one of two
+    workers, wait for eviction, verify 100% success, restart it, verify it
+    rejoins the rotation."""
+    svc = TopologyService(probe_interval_s=0.05, probe_timeout_s=0.5,
+                          evict_after=2).start()
+    workers = [WorkerServer(Doubler(), server_id=f"w{i}",
+                            driver_address=svc.address, port=0).start()
+               for i in range(2)]
+    killer = WorkerKiller(seed=4)
+    try:
+        client = RoutingClient(svc.address, refresh_s=0.05)
+        victim = killer.kill_one(workers)
+        assert _wait_for(
+            lambda: victim.server_id not in svc.routing_table(), 10.0), \
+            "prober failed to evict the killed worker"
+        for i in range(20):                 # 100% success post-eviction
+            assert client.request(i) == 2 * i
+
+        killer.restart(victim)
+        assert _wait_for(
+            lambda: set(svc.routing_table()) == {"w0", "w1"}, 10.0)
+        for i in range(20):
+            assert client.request(i) == 2 * i
+        agg = svc.aggregate_stats()
+        assert all(w.get("replied", 0) > 0 for w in agg["workers"].values()), \
+            "restarted worker never rejoined the rotation"
+    finally:
+        for w in workers:
+            w.stop()
+        svc.stop()

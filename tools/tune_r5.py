@@ -80,10 +80,18 @@ def main():
     from mmlspark_tpu.lightgbm import GBDTParams, train
     bc = {}   # binning + device-put memo shared across every config
 
+    def set_or_pop(name, value):
+        # falsy knobs must UNSET the env var: "" would crash int('') in
+        # histogram.build, and "0" is a real override for some knobs
+        if value:
+            os.environ[name] = str(value)
+        else:
+            os.environ.pop(name, None)
+
     def measure(ch, block, lo, resid, layout=""):
         os.environ["MMLSPARK_TPU_GBDT_CHUNK"] = str(ch)
-        os.environ["MMLSPARK_TPU_HIST_BLOCK_ROWS"] = str(block or "")
-        os.environ["MMLSPARK_TPU_HIST_LO"] = str(lo or "")
+        set_or_pop("MMLSPARK_TPU_HIST_BLOCK_ROWS", block)
+        set_or_pop("MMLSPARK_TPU_HIST_LO", lo)
         os.environ["MMLSPARK_TPU_HIST_RESID"] = "0" if resid == 0 else "1"
         if layout:
             os.environ["MMLSPARK_TPU_HIST_LAYOUT"] = layout
