@@ -40,6 +40,8 @@ Layout mirrors the reference's module map (SURVEY.md §1-2):
 - ``isolationforest`` — IsolationForest (ref ``isolationforest/``)
 - ``cyber``     — access-anomaly detection (ref ``core/src/main/python/mmlspark/cyber``)
 - ``codegen``   — stage reflection, stub/doc generation (ref ``codegen/``)
+- ``observability`` — metrics registry (+/metrics exposition), tracing spans,
+  breaker instrumentation (ref BasicLogging telemetry, unified)
 """
 
 __version__ = "0.2.0"

@@ -27,7 +27,11 @@ def build_version() -> str:
 
 def log_event(payload: Dict[str, Any]) -> None:
     _RECENT.append(payload)
-    logger.debug(json.dumps(payload, default=str))
+    # serialize only when a debug handler will actually see it: with span
+    # events riding every request, an unconditional json.dumps would tax
+    # the serving hot path for output nobody receives
+    if logger.isEnabledFor(logging.DEBUG):
+        logger.debug(json.dumps(payload, default=str))
 
 
 def recent_events():
@@ -36,16 +40,29 @@ def recent_events():
 
 @contextlib.contextmanager
 def log_verb(stage, method: str):
-    """Wrap a verb (fit/transform/...) with telemetry incl. errors + wall time."""
+    """Wrap a verb (fit/transform/...) with telemetry incl. errors + wall time.
+
+    Every verb is also a span on the observability layer: nested stage calls
+    build a trace (a Pipeline.fit's transforms hang off it), and a verb
+    running inside a served request inherits that request's wire trace id —
+    so the event ring and ``/metrics`` agree on where a request's time went.
+    The span exports before the verb event is appended, keeping the verb
+    payload the LAST ring entry for its stage (tests rely on that order).
+    """
     payload = {
         "uid": getattr(stage, "uid", "?"),
         "className": type(stage).__name__,
         "method": method,
         "buildVersion": build_version(),
     }
+    # lazy: observability imports this module for ring export
+    from ..observability.tracing import trace_span
     t0 = time.perf_counter()
     try:
-        yield
+        with trace_span(f"{type(stage).__name__}.{method}",
+                        attributes={"uid": payload["uid"]}) as span:
+            payload["traceId"] = span.trace_id
+            yield
         payload["seconds"] = round(time.perf_counter() - t0, 6)
         log_event(payload)
     except Exception as e:

@@ -34,6 +34,7 @@ import numpy as np
 from ..core import (Binding, DataFrame, HasInputCol, HasOutputCol, Param,
                     Transformer)
 from ..core.schema import ColumnType
+from ..observability.tracing import TRACE_HEADER, current_trace_id
 from ..stages.minibatch import FixedMiniBatchTransformer, FlattenBatch
 from ..utils.resilience import CircuitBreaker, Deadline, current_deadline
 
@@ -86,6 +87,21 @@ def _urllib_transport(req: HTTPRequestData, timeout_s: float) -> HTTPResponseDat
                                 headers=dict(e.headers or {}), entity=body)
 
 
+def _with_trace_header(req: HTTPRequestData,
+                       trace_id: Optional[str] = None) -> HTTPRequestData:
+    """Copy-on-write trace-id injection: the ambient span's trace id (or an
+    explicit one — thread pools don't inherit the contextvar) rides
+    ``X-MMLSpark-Trace-Id`` so worker-side spans join the caller's trace.
+    An explicit header already on the request wins; the caller's request
+    object is never mutated."""
+    tid = trace_id or current_trace_id()
+    if tid is None or (req.headers and TRACE_HEADER in req.headers):
+        return req
+    headers = dict(req.headers or {})
+    headers[TRACE_HEADER] = tid
+    return dataclasses.replace(req, headers=headers)
+
+
 def circuit_open_response(retry_after_s: float) -> HTTPResponseData:
     """Synthetic 503 emitted when a breaker rejects without a network call."""
     return HTTPResponseData(
@@ -133,8 +149,10 @@ class HTTPClient:
         return True
 
     def send(self, req: HTTPRequestData,
-             deadline: Optional[Deadline] = None) -> HTTPResponseData:
+             deadline: Optional[Deadline] = None,
+             trace_id: Optional[str] = None) -> HTTPResponseData:
         deadline = deadline or current_deadline()
+        req = _with_trace_header(req, trace_id)
         last_err: Optional[HTTPResponseData] = None
         for attempt in range(self.retries + 1):
             # deadline check MUST precede breaker admission: allow() may
@@ -198,9 +216,10 @@ class AsyncHTTPClient(HTTPClient):
 
     def send_all(self, reqs: List[Optional[HTTPRequestData]]) -> List[Optional[HTTPResponseData]]:
         deadline = current_deadline()
+        trace_id = current_trace_id()  # contextvars don't cross the pool
         out: List[Optional[HTTPResponseData]] = [None] * len(reqs)
         with concurrent.futures.ThreadPoolExecutor(self.concurrency) as ex:
-            futs = {ex.submit(self.send, r, deadline): i
+            futs = {ex.submit(self.send, r, deadline, trace_id): i
                     for i, r in enumerate(reqs) if r is not None}
             for f in concurrent.futures.as_completed(futs):
                 out[futs[f]] = f.result()

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import time
 from functools import partial
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -1209,6 +1210,29 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
     wholesale should pass a fresh cache dict rather than rely on detection."""
     import jax
     import jax.numpy as jnp
+    from ..observability import get_registry
+    from ..observability.tracing import Span, current_span, export_span
+
+    # training-phase telemetry: per-iteration observations into the global
+    # registry + ONE lightgbm.train span (child of the ambient fit span)
+    # carrying phase totals.  Timings are host-side dispatch+wait — no
+    # block_until_ready() syncs are inserted, the hot loop stays async.
+    _phase_h = get_registry().histogram(
+        "mmlspark_lightgbm_phase_seconds",
+        "per-iteration training phase timings (host-side)",
+        labels=("phase",))
+    _phase_totals: Dict[str, float] = {}
+
+    def _observe_phase(phase: str, seconds: float, times: int = 1) -> None:
+        for _ in range(times):
+            _phase_h.observe(seconds, phase=phase)
+        _phase_totals[phase] = _phase_totals.get(phase, 0.0) + seconds * times
+
+    _parent_span = current_span()
+    _train_span = Span(
+        "lightgbm.train",
+        trace_id=_parent_span.trace_id if _parent_span else None,
+        parent_id=_parent_span.span_id if _parent_span else None)
 
     p = params.resolve()
     rng = np.random.default_rng(p.seed)
@@ -1246,9 +1270,11 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
         mapper = bin_cache["mapper"]
         binned_np = bin_cache["binned"]
     else:
+        _t_bin = time.perf_counter()
         mapper = BinMapper(p.max_bin,
                            categorical_features=p.categorical_features).fit(X)
         binned_np = mapper.transform(X)
+        _observe_phase("binning", time.perf_counter() - _t_bin)
         if bin_cache is not None:
             bin_cache.clear()
             bin_cache.update(sig=_bin_sig, X=X, mapper=mapper,
@@ -1560,19 +1586,26 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
         if multi_iter is not None and end_iter - it >= CH:
             keys = jnp.stack([jrandom.PRNGKey(p.seed * 1000003 + it + j)
                               for j in range(CH)])
+            _t_grow = time.perf_counter()
             scores, stacked = multi_iter(scores, jnp.float32(len(tree_weights)),
                                          keys)
+            # CH fused iterations per dispatch: book the per-iteration share
+            # CH times so histogram counts stay 1:1 with boosting iterations
+            _observe_phase("histogram_split_update",
+                           (time.perf_counter() - _t_grow) / CH, times=CH)
             for ci in range(CH):
                 for c in range(K):
                     for k_name, arr in zip(_TREE_KEYS, stacked):
                         trees[k_name].append(arr[ci, c])
                     tree_weights.append(1.0)
             if has_valid:
+                _t_eval = time.perf_counter()
                 scores_v = valid_chunk_update(scores_v, binned_v, stacked[2],
                                               stacked[4], stacked[8],
                                               stacked[0], stacked[1])
                 raw_v = np.asarray(scores_v, np.float64)
                 m = metric_fn(yv, raw_v)
+                _observe_phase("eval", time.perf_counter() - _t_eval)
                 evals.append({metric_name: m, "iteration": it + CH - 1})
                 improved = m > best_metric if larger_better else m < best_metric
                 if improved:
@@ -1604,6 +1637,7 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
             base_mask = hist_mask_full & bag_mask
 
         # ---- gradients precomputed for lambdarank / dart
+        _t_grad = time.perf_counter()
         g_pre = h_pre = None
         dropped: List[int] = []
         if p.objective == "lambdarank":
@@ -1631,7 +1665,10 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
         grad_scale = float(max(1, len(tree_weights) // K)) \
             if p.boosting_type == "rf" and tree_weights else 1.0
         key = jrandom.PRNGKey(p.seed * 1000003 + it)
+        if g_pre is not None:  # lambdarank/dart gradients were built above
+            _observe_phase("gradients", time.perf_counter() - _t_grad)
 
+        _t_grow = time.perf_counter()
         if not shard_rows:
             use_pre = g_pre is not None
             if use_pre:
@@ -1642,22 +1679,31 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
                 scores, tree_out = _iter_jit[False](
                     scores, y_dev, w_dev, binned, base_mask, feat_mask,
                     edges, grad_scale, new_w, key)
+            # one fused program: histogram build + split find + score update
+            _observe_phase("histogram_split_update",
+                           time.perf_counter() - _t_grow)
         else:
-            # multi-chip path: explicit shard_map grower per class
+            # multi-chip path: explicit shard_map grower per class — the
+            # only path where gradients / grow / update dispatch separately
             if g_pre is not None:
                 g_eff, h_eff = g_pre, h_pre
             else:
                 g_eff, h_eff = jit_objective(scores / grad_scale, y_dev, w_dev)
+                _observe_phase("gradients", time.perf_counter() - _t_grow)
             shrink = 1.0 if p.boosting_type == "rf" else p.learning_rate
             tree_out = []
             for c in range(K):
+                _t_c = time.perf_counter()
                 (lch, rch, sf, th, tb, sg, iv, ic, lv, lc, cbs,
                  leaf_of_row) = grower(
                     binned, g_eff[:, c], h_eff[:, c], base_mask, feat_mask, edges)
+                _observe_phase("histogram_split", time.perf_counter() - _t_c)
+                _t_u = time.perf_counter()
                 lv_s = lv * shrink
                 scores = scores.at[:, c].add(lv_s[leaf_of_row] * new_w)
                 tree_out.append((lch, rch, sf, th, tb, sg, iv, ic, lv_s, lc,
                                  cbs))
+                _observe_phase("update", time.perf_counter() - _t_u)
 
         for c, (lch, rch, sf, th, tb, sg, iv, ic, lv_s, lc, cbs) \
                 in enumerate(tree_out):
@@ -1696,8 +1742,10 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
 
         # ---- eval / early stopping
         if has_valid:
+            _t_eval = time.perf_counter()
             raw_v = np.asarray(scores_v, np.float64)
             m = metric_fn(yv, raw_v)
+            _observe_phase("eval", time.perf_counter() - _t_eval)
             evals.append({metric_name: m, "iteration": it})
             improved = m > best_metric if larger_better else m < best_metric
             if improved:
@@ -1740,4 +1788,11 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
         feature_names=feature_names, best_iteration=best_iter, sigmoid=p.sigmoid,
         categorical_features=list(p.categorical_features or []),
         cat_bitset=cat_bitset)
+    for k, v in sorted(_phase_totals.items()):
+        _train_span.set_attribute(f"phase.{k}_s", round(v, 6))
+    _train_span.set_attribute("rows", n)
+    _train_span.set_attribute("features", F)
+    _train_span.set_attribute("iterations", len(tree_weights) // K)
+    _train_span.set_attribute("growth", p.growth)
+    export_span(_train_span)
     return TrainResult(booster=booster, evals=evals, bin_mapper=mapper)

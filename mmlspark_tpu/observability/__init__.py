@@ -1,0 +1,31 @@
+"""Unified observability layer — metrics registry + tracing + adapters.
+
+One subsystem replaces three telemetry fragments (the ``core/logging.py``
+event ring, ``utils/stopwatch.py``, the hand-rolled serving counters):
+
+- ``metrics``     — MetricsRegistry with Counter/Gauge/Histogram families,
+  labels, fixed log-spaced latency buckets, Prometheus-text and JSON
+  exposition, injectable clocks (tests run on FakeClock);
+- ``tracing``     — contextvar-propagated Spans; the trace id rides
+  ``X-MMLSpark-Trace-Id`` through io/http clients -> RoutingClient ->
+  PipelineServer; finished spans feed the registry and the logging ring;
+- ``instruments`` — adapters (CircuitBreaker -> state gauge / failure-rate
+  gauge / transition counter + ``/stats`` exposure).
+
+Hot paths instrumented: ``serving/server.py`` (GET /metrics, queue gauges,
+queue-vs-score phase histograms, EWMA shed signal), ``serving/
+distributed.py`` (per-worker request/failover/probe counters, per-worker
+breakers), ``lightgbm/core.train`` (per-iteration phase timings),
+``parallel/trainer.py`` (step timings).  See docs/OBSERVABILITY.md.
+"""
+from .metrics import (Counter, DEFAULT_LATENCY_BUCKETS, Gauge, Histogram,
+                      MetricsRegistry, get_registry, set_registry)
+from .tracing import (Span, TRACE_HEADER, current_span, current_trace_id,
+                      new_trace_id, trace_span)
+from .instruments import BREAKER_STATE_CODES, instrument_breaker
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "DEFAULT_LATENCY_BUCKETS", "get_registry", "set_registry",
+           "Span", "TRACE_HEADER", "current_span", "current_trace_id",
+           "new_trace_id", "trace_span", "BREAKER_STATE_CODES",
+           "instrument_breaker"]

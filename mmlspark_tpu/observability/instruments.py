@@ -1,0 +1,53 @@
+"""Instrumentation adapters — wire existing subsystems into the registry.
+
+Kept separate from ``utils/resilience.py`` so the resilience primitives stay
+dependency-free: a ``CircuitBreaker`` only exposes a generic listener hook,
+and this module turns it into gauges/counters.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .metrics import MetricsRegistry, get_registry
+
+__all__ = ["BREAKER_STATE_CODES", "instrument_breaker"]
+
+#: numeric encoding for the breaker-state gauge (alerting rules compare
+#: against these: anything > 0 means degraded)
+BREAKER_STATE_CODES = {"closed": 0, "half_open": 1, "open": 2}
+
+
+def instrument_breaker(breaker, registry: Optional[MetricsRegistry] = None,
+                       name: Optional[str] = None):
+    """Register a ``CircuitBreaker`` with a registry:
+
+    - ``mmlspark_breaker_state{breaker}`` — callback gauge (0 closed /
+      1 half-open / 2 open), sampled at scrape time;
+    - ``mmlspark_breaker_failure_rate{breaker}`` — callback gauge over the
+      breaker's rolling outcome window;
+    - ``mmlspark_breaker_transitions_total{breaker,to}`` — counter fed by
+      the breaker's transition listener;
+    - the breaker lands in ``registry.breakers`` so ``/stats`` endpoints can
+      dump ``as_dict()`` per breaker.
+
+    Returns the breaker (chainable at construction sites).
+    """
+    reg = registry or get_registry()
+    bname = name or breaker.name or f"breaker-{id(breaker):x}"
+    reg.breakers[bname] = breaker
+    reg.gauge("mmlspark_breaker_state",
+              "circuit state: 0 closed, 1 half-open, 2 open",
+              labels=("breaker",)).set_function(
+        lambda b=breaker: BREAKER_STATE_CODES.get(b.state, -1), breaker=bname)
+    reg.gauge("mmlspark_breaker_failure_rate",
+              "failures / outcomes inside the rolling window",
+              labels=("breaker",)).set_function(
+        lambda b=breaker: b.failure_rate(), breaker=bname)
+    transitions = reg.counter("mmlspark_breaker_transitions_total",
+                              "breaker state transitions", labels=("breaker", "to"))
+
+    def on_transition(_breaker, old: str, new: str) -> None:
+        transitions.inc(breaker=bname, to=new)
+
+    breaker.add_listener(on_transition)
+    return breaker

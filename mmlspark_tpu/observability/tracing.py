@@ -1,0 +1,181 @@
+"""Tracing — contextvar-propagated spans riding the serving/training paths.
+
+A ``Span`` is one timed operation; spans opened inside another span's scope
+become its children and share its ``trace_id``.  The trace id crosses
+process/socket boundaries on the ``X-MMLSpark-Trace-Id`` header:
+``io/http.py`` clients and ``serving/distributed.RoutingClient`` inject the
+ambient span's id into outgoing requests, and ``PipelineServer`` adopts an
+incoming header so the worker-side spans of a request join the caller's
+trace.
+
+Finished spans are exported twice:
+
+- to a ``MetricsRegistry`` as ``mmlspark_spans_total{name}`` /
+  ``mmlspark_span_seconds{name}`` (so latency percentiles per span name come
+  for free), and
+- to the ``core/logging.py`` event ring as an ``event: "span"`` record, so
+  ``recent_events()`` shows per-request/per-fit wall-time decomposition next
+  to the BasicLogging verb events.
+
+Spans compose with ``utils.resilience.deadline_scope``: a span opened under
+an ambient deadline records ``deadline_remaining_ms`` at start, and
+``trace_span(..., deadline_s=...)`` installs a deadline for its block, so
+"where did the budget go" is answerable from the trace alone.
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+import math
+import os
+import time
+from contextvars import ContextVar
+from typing import Any, Dict, Optional
+
+from ..utils.resilience import current_deadline, deadline_scope
+from .metrics import MetricsRegistry, get_registry
+
+__all__ = ["Span", "TRACE_HEADER", "current_span", "current_trace_id",
+           "new_trace_id", "trace_span", "export_span"]
+
+#: wire header carrying the trace id across HTTP hops
+TRACE_HEADER = "X-MMLSpark-Trace-Id"
+
+
+# id generation sits on the serving hot path INSIDE the serialized scoring
+# section, where uuid4's per-call os.urandom syscall (~40 us on this
+# container's kernel) measurably cut sustained RPS.  Trace/span ids need
+# uniqueness, not entropy: one random per-process prefix + a counter.
+# itertools.count.__next__ is a single C call — atomic under the GIL.
+_ID_PREFIX = os.urandom(8).hex()
+_ID_COUNTER = itertools.count(int.from_bytes(os.urandom(4), "big"))
+
+
+def new_trace_id() -> str:
+    return f"{_ID_PREFIX}{next(_ID_COUNTER) & 0xFFFFFFFFFFFFFFFF:016x}"
+
+
+def _new_span_id() -> str:
+    return f"{next(_ID_COUNTER) & 0xFFFFFFFFFFFFFFFF:016x}"
+
+
+class Span:
+    """One timed operation.  Construct directly (explicit ``start``/
+    ``finish`` on an injectable clock — used by the serving scorer, which
+    back-dates a request span to its enqueue time) or via ``trace_span``."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "start_s",
+                 "end_s", "attributes", "status", "clock")
+
+    def __init__(self, name: str, trace_id: Optional[str] = None,
+                 parent_id: Optional[str] = None,
+                 attributes: Optional[Dict[str, Any]] = None,
+                 clock=time.monotonic, start_s: Optional[float] = None):
+        self.name = name
+        self.trace_id = trace_id or new_trace_id()
+        self.span_id = _new_span_id()
+        self.parent_id = parent_id
+        self.attributes: Dict[str, Any] = dict(attributes or {})
+        self.status = "ok"
+        self.clock = clock
+        self.start_s = clock() if start_s is None else float(start_s)
+        self.end_s: Optional[float] = None
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def finish(self, end_s: Optional[float] = None) -> "Span":
+        if self.end_s is None:
+            self.end_s = self.clock() if end_s is None else float(end_s)
+        return self
+
+    @property
+    def duration_s(self) -> float:
+        end = self.end_s if self.end_s is not None else self.clock()
+        return max(0.0, end - self.start_s)
+
+    def to_event(self) -> Dict[str, Any]:
+        """Ring-buffer record.  Carries a ``className`` key so ring
+        consumers that filter on it (the BasicLogging tests) never KeyError
+        on span records."""
+        return {"event": "span", "className": "Span", "name": self.name,
+                "traceId": self.trace_id, "spanId": self.span_id,
+                "parentId": self.parent_id, "seconds": round(self.duration_s, 6),
+                "status": self.status, **{f"attr.{k}": v for k, v
+                                          in self.attributes.items()}}
+
+    def __repr__(self):
+        return (f"Span({self.name!r}, trace={self.trace_id[:8]}, "
+                f"{self.duration_s:.6f}s)")
+
+
+_current_span: ContextVar[Optional[Span]] = \
+    ContextVar("mmlspark_tpu_span", default=None)
+
+
+def current_span() -> Optional[Span]:
+    """The innermost active span in this context, or None."""
+    return _current_span.get()
+
+
+def current_trace_id() -> Optional[str]:
+    span = _current_span.get()
+    return span.trace_id if span is not None else None
+
+
+def export_span(span: Span, registry: Optional[MetricsRegistry] = None) -> None:
+    """Record a finished span into the registry + the logging event ring."""
+    span.finish()
+    reg = registry or get_registry()
+    # per-registry child cache keyed by span name (low-cardinality: stage
+    # class names + a handful of subsystem spans) — exports ride every
+    # served request, so label resolution must not repeat per call
+    cache = getattr(reg, "_span_children", None)
+    if cache is None:
+        cache = reg._span_children = {}
+    pair = cache.get(span.name)
+    if pair is None:
+        pair = cache[span.name] = (
+            reg.counter("mmlspark_spans_total", "finished spans by name",
+                        labels=("name",)).labels(name=span.name),
+            reg.histogram("mmlspark_span_seconds", "span durations by name",
+                          labels=("name",)).labels(name=span.name))
+    pair[0].inc()
+    pair[1].observe(span.duration_s)
+    from ..core.logging import log_event  # lazy: logging lazily imports us
+    log_event(span.to_event())
+
+
+@contextlib.contextmanager
+def trace_span(name: str, trace_id: Optional[str] = None,
+               attributes: Optional[Dict[str, Any]] = None,
+               registry: Optional[MetricsRegistry] = None,
+               clock=time.monotonic, deadline_s: Optional[float] = None):
+    """Open a span for the block; child of the ambient span (same trace)
+    unless an explicit ``trace_id`` adopts one from the wire.  Exceptions
+    mark the span ``error:<Type>`` and propagate.  ``deadline_s`` installs a
+    ``deadline_scope`` for the block so trace and budget travel together."""
+    parent = _current_span.get()
+    span = Span(name,
+                trace_id=trace_id or (parent.trace_id if parent else None),
+                parent_id=parent.span_id if parent else None,
+                attributes=attributes, clock=clock)
+    ambient = current_deadline()
+    if ambient is not None:
+        remaining = ambient.remaining()
+        if math.isfinite(remaining):  # inf = "no effective bound": omit
+            span.set_attribute("deadline_remaining_ms",
+                               int(remaining * 1000))
+    token = _current_span.set(span)
+    try:
+        if deadline_s is not None:
+            with deadline_scope(deadline_s, clock):
+                yield span
+        else:
+            yield span
+    except BaseException as e:
+        span.status = f"error:{type(e).__name__}"
+        raise
+    finally:
+        _current_span.reset(token)
+        export_span(span, registry)

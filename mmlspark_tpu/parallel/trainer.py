@@ -14,6 +14,7 @@ in-place in HBM.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
@@ -79,6 +80,11 @@ class Trainer:
         self.min_shard_size = min_shard_size
         self._train_step = None
         self._state_shardings = None
+        from ..observability import get_registry
+        self._m_step = get_registry().histogram(
+            "mmlspark_parallel_train_step_seconds",
+            "train_step dispatch+wait time on the host (async under jit: "
+            "the device may still be running when the call returns)")
 
     # ------------------------------------------------------------------ init
     def init_state(self, rng, example_batch) -> TrainState:
@@ -156,7 +162,10 @@ class Trainer:
             if self._state_shardings is None:
                 raise RuntimeError("call init_state/shard_state before train_step")
             self._train_step = self._build_train_step()
-        return self._train_step(state, batch)
+        t0 = time.perf_counter()
+        out = self._train_step(state, batch)
+        self._m_step.observe(time.perf_counter() - t0)
+        return out
 
 
 def _accepts_train(module) -> bool:

@@ -26,7 +26,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional
 
 from .server import PipelineServer
-from ..utils.resilience import Deadline, current_deadline
+from ..observability import get_registry, instrument_breaker
+from ..observability.tracing import TRACE_HEADER, current_trace_id
+from ..utils.resilience import CircuitBreaker, Deadline, current_deadline
 
 
 def _http_json(url: str, payload: Optional[dict] = None, timeout: float = 10.0,
@@ -42,6 +44,11 @@ def _http_json(url: str, payload: Optional[dict] = None, timeout: float = 10.0,
         # forward the remaining budget so the server admits/sheds/scores
         # under the caller's deadline, not its own default
         headers[Deadline.HEADER] = deadline.to_header()
+    trace_id = current_trace_id()
+    if trace_id is not None:
+        # the ambient span's trace id rides the wire so worker-side spans
+        # join the caller's trace
+        headers[TRACE_HEADER] = trace_id
     req = urllib.request.Request(url, data=data, headers=headers)
     with urllib.request.urlopen(req, timeout=timeout) as r:
         return json.loads(r.read().decode() or "null")
@@ -75,12 +82,22 @@ class TopologyService:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  probe_interval_s: Optional[float] = 5.0,
                  probe_timeout_s: float = 2.0, evict_after: int = 3,
-                 prober: Optional[Callable[[Dict, float], bool]] = None):
+                 prober: Optional[Callable[[Dict, float], bool]] = None,
+                 registry=None):
         self.host, self.port = host, port
         self.probe_interval_s = probe_interval_s
         self.probe_timeout_s = probe_timeout_s
         self.evict_after = max(1, evict_after)
         self.prober = prober or _default_prober
+        self.registry = registry if registry is not None else get_registry()
+        self._m_probes = self.registry.counter(
+            "mmlspark_topology_probes_total",
+            "health probes by worker and outcome",
+            labels=("worker", "result"))
+        self._m_evictions = self.registry.counter(
+            "mmlspark_topology_evictions_total",
+            "workers evicted after consecutive probe failures",
+            labels=("worker",))
         self._lock = threading.Lock()
         self._workers: Dict[str, Dict] = {}
         self._fail_counts: Dict[str, int] = {}
@@ -155,6 +172,8 @@ class TopologyService:
         evicted: List[str] = []
         for sid, w in snapshot:
             healthy = self.prober(w, self.probe_timeout_s)
+            self._m_probes.inc(worker=sid,
+                               result="ok" if healthy else "fail")
             with self._lock:
                 if sid not in self._workers:
                     continue  # deregistered mid-sweep
@@ -167,6 +186,8 @@ class TopologyService:
                     self._evicted[sid] = self._workers.pop(sid)
                     self._fail_counts.pop(sid, None)
                     evicted.append(sid)
+        for sid in evicted:
+            self._m_evictions.inc(worker=sid)
         return evicted
 
     def _probe_loop(self) -> None:
@@ -210,7 +231,7 @@ class TopologyService:
             evicted = sorted(self._evicted)
         total = {"received": 0, "replied": 0, "errors": 0, "shed": 0,
                  "workers": {}, "evicted": evicted}
-        lat_sum = 0.0
+        lat_sum_ms, lat_count = 0.0, 0
         for w in workers:
             try:
                 s = _http_json(f"http://{w['host']}:{w['port']}/stats")
@@ -222,9 +243,15 @@ class TopologyService:
             total["replied"] += s.get("replied", 0)
             total["errors"] += s.get("errors", 0)
             total["shed"] += s.get("shed", 0)
-            lat_sum += s.get("mean_latency_ms", 0.0) * s.get("replied", 0)
-        if total["replied"]:
-            total["mean_latency_ms"] = lat_sum / total["replied"]
+            # (sum, count)-paired latency when the worker reports it; the
+            # pre-pairing fallback weights by replied
+            n = s.get("latency_count", s.get("replied", 0))
+            lat_count += n
+            lat_sum_ms += s.get("mean_latency_ms", 0.0) * n
+        if lat_count:
+            total["latency_count"] = lat_count
+            total["latency_avg_ms"] = lat_sum_ms / lat_count
+            total["mean_latency_ms"] = total["latency_avg_ms"]
         return total
 
 
@@ -272,17 +299,55 @@ class RoutingClient:
     remaining healthy worker candidate (``failover_retries``, default 1 —
     exactly one failover hop), always excluding workers that already failed
     this request so a retry can never land back on the dead socket.
+
+    Per-worker circuit breakers (ROADMAP follow-up): every routed exchange
+    feeds that worker's breaker; a worker whose breaker is OPEN is skipped
+    at pick time — repeated failures stop costing a failed primary attempt
+    per request during the eviction window.  If every candidate's breaker
+    is open the pick falls back to ignoring breaker state (shedding 100% of
+    traffic client-side is worse than probing).  ``breaker_factory=None``
+    keeps the default breaker; pass a factory for custom thresholds, or
+    ``per_worker_breakers=False`` to disable.  Request/failover counters
+    land per worker in the registry.
     """
 
     def __init__(self, driver_address: str, refresh_s: float = 5.0,
-                 failover_retries: int = 1):
+                 failover_retries: int = 1, registry=None,
+                 per_worker_breakers: bool = True,
+                 breaker_factory: Optional[Callable[[str], CircuitBreaker]] = None,
+                 clock: Callable[[], float] = time.monotonic):
         self.driver_address = driver_address.rstrip("/")
         self.refresh_s = refresh_s
         self.failover_retries = max(0, failover_retries)
+        self.clock = clock
+        self.registry = registry if registry is not None else get_registry()
+        self.per_worker_breakers = per_worker_breakers
+        self.breaker_factory = breaker_factory or (
+            lambda sid: CircuitBreaker(failure_threshold=5, window_s=30.0,
+                                       cooldown_s=5.0, clock=self.clock,
+                                       name=f"worker:{sid}"))
+        self.breakers: Dict[str, CircuitBreaker] = {}
+        self._m_requests = self.registry.counter(
+            "mmlspark_routing_requests_total",
+            "routed exchanges by worker and outcome",
+            labels=("worker", "result"))
+        self._m_failovers = self.registry.counter(
+            "mmlspark_routing_failovers_total",
+            "failover hops away from a failed worker", labels=("worker",))
         self._table: List[Dict] = []
         self._fetched = 0.0
         self._rr = 0
         self._lock = threading.Lock()
+
+    def _breaker_for(self, sid: str) -> Optional[CircuitBreaker]:
+        if not self.per_worker_breakers:
+            return None
+        with self._lock:
+            b = self.breakers.get(sid)
+            if b is None:
+                b = self.breakers[sid] = instrument_breaker(
+                    self.breaker_factory(sid), self.registry)
+            return b
 
     def _refresh(self, force: bool = False):
         now = time.monotonic()
@@ -302,6 +367,14 @@ class RoutingClient:
                 raise RuntimeError(
                     "no serving workers registered" if not self._table
                     else "no healthy serving workers left to fail over to")
+            if self.per_worker_breakers:
+                # skip workers whose breaker is open; keep them as a last
+                # resort when every candidate is open
+                closed = [w for w in candidates
+                          if (b := self.breakers.get(w["server_id"])) is None
+                          or b.state != "open"]
+                if closed:
+                    candidates = closed
             if key is not None:
                 # stable across processes/restarts (builtin hash is salted),
                 # so partition affinity survives like MultiChannelMap's
@@ -323,7 +396,14 @@ class RoutingClient:
         failovers = self.failover_retries if retries is None else max(0, retries)
         tried: set = set()
         last = None
+        failed_over_from: Optional[str] = None
         for _ in range(failovers + 1):
+            if deadline is not None and deadline.expired():
+                # the CALLER's budget is gone — a client-side condition, not
+                # a worker failure: raise without feeding any breaker or
+                # failover counter (five tight-deadline requests must never
+                # trip a healthy worker's breaker)
+                raise last or TimeoutError("deadline exceeded before request")
             try:
                 w = self._pick(key, exclude=tried)
             except RuntimeError:
@@ -331,17 +411,51 @@ class RoutingClient:
                     raise  # empty table and nothing attempted yet
                 break  # nobody left to fail over to
             url = f"http://{w['host']}:{w['port']}{w.get('api_path', '/score')}"
+            sid = w["server_id"]
+            if failed_over_from is not None:
+                # a HOP is real only once a next candidate is attempted —
+                # a terminal failure with nobody left must not count one
+                self._m_failovers.inc(worker=failed_over_from)
+                failed_over_from = None
+            breaker = self._breaker_for(sid)
             try:
-                return _http_json(url, payload, timeout=timeout,
-                                  deadline=deadline)
+                out = _http_json(url, payload, timeout=timeout,
+                                 deadline=deadline)
             except Exception as e:  # noqa: BLE001 — fail over
+                if isinstance(e, urllib.error.HTTPError) and e.code < 500:
+                    # 4xx is a verdict on the REQUEST, not the worker: the
+                    # same payload would 4xx anywhere, so retrying elsewhere
+                    # wastes a hop and five bad client payloads must never
+                    # trip a healthy worker's breaker
+                    raise
+                if deadline is not None and deadline.expired():
+                    # budget ran out mid-exchange: ambiguous evidence, so
+                    # don't blame the worker (no breaker/failover feed)
+                    raise last or e
                 last = e
-                tried.add(w["server_id"])
+                tried.add(sid)
+                if breaker is not None:
+                    breaker.record_failure()
+                self._m_requests.inc(worker=sid, result="fail")
+                failed_over_from = sid
                 try:  # a briefly-unreachable driver must not abort the
                     self._refresh(force=True)  # retry; stale table still works
                 except Exception:  # noqa: BLE001
                     pass
                 key = None  # reroute away from the dead worker
+            else:
+                if breaker is not None:
+                    if breaker.state == "half_open":
+                        # the routing path filters on state at pick time
+                        # rather than calling allow() (probe-slot leaks on
+                        # the bail-out paths would pin the breaker), so a
+                        # successful exchange against a half-open worker is
+                        # accounted as the probe it de-facto was: take a
+                        # slot, then record — the success closes it
+                        breaker.allow()
+                    breaker.record_success()
+                self._m_requests.inc(worker=sid, result="ok")
+                return out
         raise RuntimeError(f"all serving workers failed: {last}")
 
     def stats(self) -> Dict:

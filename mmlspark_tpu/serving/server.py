@@ -30,6 +30,9 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from ..core import DataFrame, Transformer
+from ..observability import get_registry
+from ..observability.tracing import (Span, TRACE_HEADER, export_span,
+                                     new_trace_id, trace_span)
 from ..utils.resilience import Deadline, deadline_scope
 
 
@@ -46,6 +49,7 @@ class _Entry:
     t_deadline: float = float("inf")
     t_enq: float = 0.0
     retry_after_s: Optional[float] = None
+    trace_id: str = ""
 
 
 class ServingStats:
@@ -56,6 +60,11 @@ class ServingStats:
     ``shed`` (503 load shed).  At quiescence
     ``received == replied + errors + shed``; mid-flight, admitted-but-
     unresolved requests make up the difference.
+
+    ``latency_sum`` is paired with ``latency_count`` (both fed only by 200s,
+    under one lock) so consumers always compute a correct average — dividing
+    by ``replied`` raced the reply-before-latency window and broke down once
+    shed/error replies existed.
     """
 
     def __init__(self):
@@ -65,13 +74,18 @@ class ServingStats:
         self.errors = 0
         self.shed = 0
         self.latency_sum = 0.0
+        self.latency_count = 0
 
     def as_dict(self):
         with self.lock:
-            n = max(1, self.replied)
+            avg_ms = 1000.0 * self.latency_sum / max(1, self.latency_count)
             return {"received": self.received, "replied": self.replied,
                     "errors": self.errors, "shed": self.shed,
-                    "mean_latency_ms": 1000.0 * self.latency_sum / n}
+                    "latency_sum_s": self.latency_sum,
+                    "latency_count": self.latency_count,
+                    "latency_avg_ms": avg_ms,
+                    # legacy name kept for aggregators; same correct value
+                    "mean_latency_ms": avg_ms}
 
 
 class PipelineServer:
@@ -101,7 +115,10 @@ class PipelineServer:
                  max_queue_depth: int = 256,
                  max_queue_age_s: Optional[float] = None,
                  shed_retry_after_s: float = 1.0,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 registry=None,
+                 shed_queue_delay_ewma_s: Optional[float] = None,
+                 ewma_alpha: float = 0.2):
         if mode not in ("continuous", "micro_batch"):
             raise ValueError("mode must be continuous|micro_batch")
         self.model = model
@@ -119,6 +136,50 @@ class PipelineServer:
         self.clock = clock
         self.stats = ServingStats()
         self._pending = 0  # admitted, not yet resolved (guarded by stats.lock)
+        # adaptive shedding signal: EWMA of per-entry queue delay, updated by
+        # the scorer, read at admission (guarded by stats.lock).  Shedding on
+        # it only engages while a backlog exists (_pending > 0), so a drained
+        # server always admits again — no lockout after a latency spike.
+        self.shed_queue_delay_ewma_s = shed_queue_delay_ewma_s
+        self.ewma_alpha = float(ewma_alpha)
+        self._queue_ewma = 0.0
+        # metrics: families on the (shared, injectable) registry; children
+        # are labelled per server instance once the port is resolved so many
+        # servers coexist in one registry/process
+        self.registry = registry if registry is not None else get_registry()
+        self._server_label = f"{host}:{port}"
+        reg = self.registry
+        self._m_requests = reg.counter(
+            "mmlspark_serving_requests_total",
+            "requests by terminal status (received counts admissions+sheds)",
+            labels=("server", "status"))
+        self._m_latency = reg.histogram(
+            "mmlspark_serving_request_latency_seconds",
+            "end-to-end latency of 200 replies", labels=("server",))
+        self._m_phase = reg.histogram(
+            "mmlspark_serving_phase_seconds",
+            "per-request time split: queue wait vs batch score",
+            labels=("server", "phase"))
+        self._m_queue_depth = reg.gauge(
+            "mmlspark_serving_queue_depth",
+            "admitted-but-unresolved requests", labels=("server",))
+        self._m_queue_age = reg.gauge(
+            "mmlspark_serving_queue_oldest_age_seconds",
+            "age of the oldest queued entry (0 when empty)",
+            labels=("server",))
+        self._m_ewma = reg.gauge(
+            "mmlspark_serving_queue_delay_ewma_seconds",
+            "EWMA of per-entry queue delay (adaptive shed signal)",
+            labels=("server",))
+        # pre-start sinks: port=0 is unresolved, and registering children
+        # under "host:0" would leave a ghost zero series in the (usually
+        # shared) registry for every constructed-but-restarted server.
+        # start() re-binds to real labelled children.
+        self._c_status = {s: self._m_requests.detached_child()
+                          for s in self._STATUSES}
+        self._h_latency = self._m_latency.detached_child()
+        self._h_phase_queue = self._m_phase.detached_child()
+        self._h_phase_score = self._m_phase.detached_child()
         self._q: "queue.Queue[_Entry]" = queue.Queue()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._threads: List[threading.Thread] = []
@@ -128,6 +189,23 @@ class PipelineServer:
         # queue (reference continuous mode reaches ~1 ms,
         # docs/mmlspark-serving.md:10-11; the hand-off alone costs ~0.5 ms)
         self._inline_lock = threading.Lock()
+
+    _STATUSES = ("received", "replied", "shed", "error", "write_error")
+
+    def _bind_metric_children(self) -> None:
+        """Resolve this server's labelled children ONCE (per-call label
+        resolution costs a dict+tuple build inside the serialized scoring
+        section); called by start() with the resolved port.  Also pre-creates
+        the known status series at 0 so scrapers always see shed/error
+        counters (a rate() over a series born mid-incident would miss its
+        first increment)."""
+        label = self._server_label
+        self._c_status = {
+            s: self._m_requests.labels(server=label, status=s)
+            for s in self._STATUSES}
+        self._h_latency = self._m_latency.labels(server=label)
+        self._h_phase_queue = self._m_phase.labels(server=label, phase="queue")
+        self._h_phase_score = self._m_phase.labels(server=label, phase="score")
 
     # ------------------------------------------------------------------ http
     def _make_handler(self):
@@ -151,7 +229,15 @@ class PipelineServer:
                     d = server.stats.as_dict()
                     with server.stats.lock:
                         d["pending"] = server._pending
+                        d["queue_delay_ewma_ms"] = 1000.0 * server._queue_ewma
+                    # every breaker instrumented into this registry, with
+                    # state / consecutive failures / rolling failure rate
+                    d["breakers"] = server.registry.breaker_stats()
                     self._write_raw(200, json.dumps(d).encode())
+                elif self.path == "/metrics":
+                    body = server.registry.to_prometheus().encode()
+                    self._write_raw(
+                        200, body, b"text/plain; version=0.0.4; charset=utf-8")
                 else:
                     self._respond(404, {"error": "not found"})
 
@@ -176,28 +262,27 @@ class PipelineServer:
                 budget_s = server.request_timeout_s
                 hdr = self.headers.get(Deadline.HEADER)
                 if hdr:
-                    try:
-                        budget_s = min(budget_s, max(0.0, float(hdr)) / 1000.0)
-                    except ValueError:
-                        pass
+                    parsed = Deadline.parse_budget_s(hdr)
+                    if parsed is not None:
+                        budget_s = min(budget_s, parsed)
+                # adopt the caller's trace id (X-MMLSpark-Trace-Id) so the
+                # worker-side spans of this request join the caller's trace
+                trace_id = self.headers.get(TRACE_HEADER) or new_trace_id()
                 entry = _Entry(uid=str(uuid_mod.uuid4()), payload=payload,
                                headers=dict(self.headers), t_enq=t_enq,
-                               t_deadline=t_enq + budget_s)
+                               t_deadline=t_enq + budget_s,
+                               trace_id=trace_id)
                 # bounded admission: shedding beats queueing toward a
                 # certain timeout (503 tells the client to back off; 504
                 # would have cost it request_timeout_s of waiting first)
-                with server.stats.lock:
-                    server.stats.received += 1
-                    admitted = server._pending < server.max_queue_depth
-                    if admitted:
-                        server._pending += 1
-                    else:
-                        server.stats.shed += 1
-                if not admitted:
-                    self._respond(503, {"error": "overloaded: queue full"},
+                shed_reason = server._try_admit()
+                trace_hdr = {TRACE_HEADER: trace_id}
+                if shed_reason is not None:
+                    self._respond(503, {"error": f"overloaded: {shed_reason}"},
                                   extra_headers={
                                       "Retry-After":
-                                      _retry_after(server.shed_retry_after_s)})
+                                      _retry_after(server.shed_retry_after_s),
+                                      **trace_hdr})
                     return
                 if server.mode == "continuous" and \
                         server._inline_lock.acquire(blocking=False):
@@ -209,9 +294,11 @@ class PipelineServer:
                     server._q.put(entry)
                 # wait no longer than the caller still cares about
                 if not entry.done.wait(budget_s):
-                    self._respond(504, {"error": "timeout"})
+                    self._respond(504, {"error": "timeout"},
+                                  extra_headers=trace_hdr)
                     with server.stats.lock:
                         server.stats.errors += 1
+                    server._c_status["error"].inc()
                     return
                 # count BEFORE the socket write: a client that already holds
                 # the reply must never observe its counter lagging (stats
@@ -220,28 +307,37 @@ class PipelineServer:
                 # sampled after the write so the metric's window is unchanged
                 status = entry.status
                 stats = server.stats
-                extra = None
+                extra = dict(trace_hdr)
                 if status == 503:
-                    extra = {"Retry-After": _retry_after(
-                        entry.retry_after_s or server.shed_retry_after_s)}
+                    extra["Retry-After"] = _retry_after(
+                        entry.retry_after_s or server.shed_retry_after_s)
                 try:
                     if status == 200:
                         with stats.lock:
                             stats.replied += 1
-                        self._respond(200, entry.reply)
-                        # latency is a SUCCESS metric: mean_latency_ms
-                        # divides by replied, so only 200s may feed the sum
+                        self._respond(200, entry.reply, extra_headers=extra)
+                        # latency is a SUCCESS metric: only 200s may feed
+                        # the (sum, count) pair — latency_avg divides by it
+                        latency_s = time.perf_counter() - t0
                         with stats.lock:
-                            stats.latency_sum += time.perf_counter() - t0
+                            stats.latency_sum += latency_s
+                            stats.latency_count += 1
+                        server._c_status["replied"].inc()
+                        server._h_latency.observe(latency_s)
                     elif status == 503:
                         with stats.lock:
                             stats.shed += 1
                         self._respond(503, entry.reply, extra_headers=extra)
+                        server._c_status["shed"].inc()
                     else:
                         with stats.lock:
                             stats.errors += 1
-                        self._respond(status, entry.reply)
+                        self._respond(status, entry.reply, extra_headers=extra)
+                        server._c_status["error"].inc()
                 except Exception:  # any failed write: invariant must hold
+                    # (the stats invariant rolls back exactly; monotonic
+                    # registry counters book the write failure as an error
+                    # instead — documented divergence in docs/OBSERVABILITY.md)
                     with stats.lock:
                         if status == 200:
                             stats.replied -= 1
@@ -250,6 +346,7 @@ class PipelineServer:
                         else:
                             stats.errors -= 1
                         stats.errors += 1
+                    server._c_status["write_error"].inc()
                     raise
 
             _STATUS = {200: b"200 OK", 400: b"400 Bad Request",
@@ -280,6 +377,40 @@ class PipelineServer:
         return Handler
 
     # ------------------------------------------------------------------ work
+    def _try_admit(self) -> Optional[str]:
+        """Count the request and decide admission; returns None when
+        admitted (pending slot taken) or the shed reason.  Two signals shed:
+
+        - ``queue_full`` — fixed bound: ``_pending >= max_queue_depth``;
+        - ``queue_delay_ewma`` — adaptive bound: the scorer-maintained EWMA
+          of queue delay exceeds ``shed_queue_delay_ewma_s`` AND a backlog
+          exists.  The backlog condition makes recovery automatic: once the
+          queue drains, admission resumes regardless of the stale EWMA.
+        """
+        with self.stats.lock:
+            self.stats.received += 1
+            shed = None
+            if self._pending >= self.max_queue_depth:
+                shed = "queue_full"
+            elif self.shed_queue_delay_ewma_s is not None \
+                    and self._pending > 0 \
+                    and self._queue_ewma > self.shed_queue_delay_ewma_s:
+                shed = "queue_delay_ewma"
+            if shed is None:
+                self._pending += 1
+            else:
+                self.stats.shed += 1
+        self._c_status["received"].inc()
+        if shed is not None:
+            self._c_status["shed"].inc()
+        return shed
+
+    def _oldest_queue_age_s(self) -> float:
+        """Age of the oldest queued (not yet drained) entry; gauge callback."""
+        with self._q.mutex:
+            head = self._q.queue[0] if self._q.queue else None
+        return 0.0 if head is None else max(0.0, self.clock() - head.t_enq)
+
     def _drain(self) -> List[_Entry]:
         try:
             first = self._q.get(timeout=0.1)
@@ -315,7 +446,15 @@ class PipelineServer:
         """
         now = self.clock()
         live: List[_Entry] = []
+        # per-entry queue delay feeds the phase histogram and the adaptive
+        # shed EWMA (in arrival order, so tests on FakeClock are exact)
+        alpha = self.ewma_alpha
+        with self.stats.lock:
+            for e in batch:
+                self._queue_ewma = (alpha * max(0.0, now - e.t_enq)
+                                    + (1.0 - alpha) * self._queue_ewma)
         for e in batch:
+            self._h_phase_queue.observe(max(0.0, now - e.t_enq))
             if now > e.t_deadline:
                 e.status, e.reply = 504, {"error": "deadline expired in queue"}
             elif self.max_queue_age_s is not None and \
@@ -324,6 +463,7 @@ class PipelineServer:
                 e.retry_after_s = self.shed_retry_after_s
             else:
                 live.append(e)
+        score_s = 0.0
         if live:
             col = np.empty(len(live), dtype=object)
             for i, e in enumerate(live):
@@ -333,20 +473,44 @@ class PipelineServer:
             # scoring runs under the TIGHTEST deadline in the batch so any
             # HTTP fan-out inside the pipeline (io/http, cognitive) clips
             # its own timeouts/retries to what the most impatient caller
-            # still allows
+            # still allows.  The batch span adopts the FIRST live entry's
+            # trace id (one device pass serves many traces; per-entry
+            # serving.request spans below carry each request's own id), and
+            # installs it in this thread's context so io/http fan-out inside
+            # the pipeline propagates it downstream.
+            t_score0 = self.clock()
             try:
                 with deadline_scope(Deadline(
                         min(e.t_deadline for e in live), self.clock)):
-                    out = self.model.transform(df).collect()
+                    with trace_span("serving.score",
+                                    trace_id=live[0].trace_id,
+                                    attributes={"batch": len(live)},
+                                    registry=self.registry, clock=self.clock):
+                        out = self.model.transform(df).collect()
                 replies = out[self.reply_col]
                 for e, r in zip(live, replies):
                     e.reply = self.reply_encoder(r)
             except Exception as ex:  # noqa: BLE001 — reply errors per-request
                 for e in live:
                     e.status, e.reply = 500, {"error": str(ex)}
+            score_s = max(0.0, self.clock() - t_score0)
+            for e in live:
+                self._h_phase_score.observe(score_s)
         with self.stats.lock:
             self._pending -= len(batch)
         for e in batch:
+            # one serving.request span per entry, back-dated to its enqueue
+            # time on the server clock: queue wait + score in one record,
+            # joined to the caller's trace
+            span = Span("serving.request", trace_id=e.trace_id,
+                        clock=self.clock, start_s=e.t_enq,
+                        attributes={"status": e.status,
+                                    "queue_s": round(max(0.0, now - e.t_enq), 6),
+                                    "score_s": round(score_s, 6)})
+            if e.status != 200:
+                span.status = f"http:{e.status}"
+            span.finish()
+            export_span(span, self.registry)
             e.done.set()
 
     def _worker(self):
@@ -363,6 +527,16 @@ class PipelineServer:
     def start(self) -> "PipelineServer":
         self._httpd = ThreadingHTTPServer((self.host, self.port), self._make_handler())
         self.port = self._httpd.server_port  # resolve port=0
+        # label children per resolved address; callback gauges sample live
+        # state at scrape time (no push on the hot path)
+        self._server_label = f"{self.host}:{self.port}"
+        self._bind_metric_children()
+        self._m_queue_depth.set_function(lambda: self._pending,
+                                         server=self._server_label)
+        self._m_queue_age.set_function(self._oldest_queue_age_s,
+                                       server=self._server_label)
+        self._m_ewma.set_function(lambda: self._queue_ewma,
+                                  server=self._server_label)
         t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         t.start()
         self._threads.append(t)
@@ -376,6 +550,12 @@ class PipelineServer:
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
+        # unhook the callback gauges: their closures capture this server,
+        # so leaving them registered would pin a stopped server (and emit
+        # frozen queue/EWMA series) for process lifetime.  Counter and
+        # histogram series stay — they are history, and hold no objects.
+        for g in (self._m_queue_depth, self._m_queue_age, self._m_ewma):
+            g.remove(server=self._server_label)
 
     @property
     def address(self) -> str:

@@ -115,8 +115,12 @@ class HTTPStreamSource:
                     src.stats.replied += 1
                 try:
                     self._json(entry.status, entry.reply)
-                    with src.stats.lock:
-                        src.stats.latency_sum += time.perf_counter() - t0
+                    if entry.status == 200:
+                        # latency is a SUCCESS metric (ServingStats
+                        # contract): scorer-set 500s must not feed the pair
+                        with src.stats.lock:
+                            src.stats.latency_sum += time.perf_counter() - t0
+                            src.stats.latency_count += 1
                 except OSError:
                     with src.stats.lock:
                         src.stats.replied -= 1

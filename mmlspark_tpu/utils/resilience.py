@@ -105,10 +105,23 @@ class Deadline:
     def to_header(self) -> str:
         return str(max(0, int(self.remaining() * 1000)))
 
+    @staticmethod
+    def parse_budget_s(value) -> Optional[float]:
+        """Header value -> remaining budget in seconds (None if malformed).
+        The single parser for the wire format — servers clipping a raw float
+        budget and ``from_header`` both go through it."""
+        try:
+            return max(0.0, float(value)) / 1000.0
+        except (TypeError, ValueError):
+            return None
+
     @classmethod
     def from_header(cls, value: str,
                     clock: Callable[[], float] = time.monotonic) -> "Deadline":
-        return cls.after(max(0.0, float(value)) / 1000.0, clock)
+        budget = cls.parse_budget_s(value)
+        if budget is None:
+            raise ValueError(f"malformed {cls.HEADER} value: {value!r}")
+        return cls.after(budget, clock)
 
     def __repr__(self):
         return f"Deadline(remaining={self.remaining():.3f}s)"
@@ -171,7 +184,16 @@ class CircuitBreaker:
     All transitions run on the injectable ``clock``, so tests step them
     deterministically.  Thread-safe; shared freely across client instances
     guarding the same dependency.
+
+    Observability: ``add_listener(fn)`` registers a transition callback
+    ``fn(breaker, old_state, new_state)`` (fired outside the lock —
+    ``observability.instruments.instrument_breaker`` turns it into
+    counters/gauges), and ``failure_rate()`` reports failures/outcomes over
+    the rolling window (successes are sampled into a bounded deque so the
+    hot path stays O(1); under extreme QPS the rate is approximate).
     """
+
+    _OUTCOME_CAP = 4096  # per-deque bound on the rolling-rate samples
 
     def __init__(self, failure_threshold: int = 5, window_s: float = 30.0,
                  cooldown_s: float = 10.0, half_open_max_calls: int = 1,
@@ -192,13 +214,24 @@ class CircuitBreaker:
         # observability counters (aggregated into serving /stats)
         self.rejected = 0
         self.opened_count = 0
+        self.consecutive_failures = 0
+        # rolling failure-rate window: tripping clears _failures (state
+        # machine bookkeeping), so the rate keeps its own timestamp deques
+        self._rate_failures: Deque[float] = \
+            collections.deque(maxlen=self._OUTCOME_CAP)
+        self._rate_successes: Deque[float] = \
+            collections.deque(maxlen=self._OUTCOME_CAP)
+        self._listeners: list = []
+        self._pending_notifications: list = []
 
     # ------------------------------------------------------------- queries
     @property
     def state(self) -> str:
         with self._lock:
             self._maybe_half_open()
-            return self._state
+            state = self._state
+        self._notify()
+        return state
 
     def retry_after_s(self) -> float:
         """Seconds until an open breaker will admit a probe (0 if not open)."""
@@ -207,11 +240,49 @@ class CircuitBreaker:
                 return 0.0
             return max(0.0, self._opened_at + self.cooldown_s - self.clock())
 
+    def failure_rate(self) -> float:
+        """failures / (failures + successes) recorded inside ``window_s``
+        (0.0 with no outcomes in the window)."""
+        now = self.clock()
+        with self._lock:
+            for dq in (self._rate_failures, self._rate_successes):
+                while dq and now - dq[0] > self.window_s:
+                    dq.popleft()
+            f, s = len(self._rate_failures), len(self._rate_successes)
+        return f / (f + s) if f + s else 0.0
+
+    def add_listener(self, fn: Callable[["CircuitBreaker", str, str], None]
+                     ) -> None:
+        """Register fn(breaker, old_state, new_state); fired outside the
+        lock after every state transition."""
+        self._listeners.append(fn)
+
+    def _transition(self, new_state: str) -> None:
+        # caller holds the lock; notification drains after release
+        if self._state != new_state:
+            self._pending_notifications.append((self._state, new_state))
+            self._state = new_state
+
+    def _notify(self) -> None:
+        # drain transitions recorded under the lock; listeners run unlocked
+        # so they may freely query the breaker.  Each item is popped under
+        # the lock — concurrent drainers must not race check-then-pop.
+        while True:
+            with self._lock:
+                if not self._pending_notifications:
+                    return
+                old, new = self._pending_notifications.pop(0)
+            for fn in self._listeners:
+                try:
+                    fn(self, old, new)
+                except Exception:  # noqa: BLE001 — telemetry must not break
+                    pass
+
     def _maybe_half_open(self) -> None:
         # caller holds the lock
         if self._state == "open" and \
                 self.clock() - self._opened_at >= self.cooldown_s:
-            self._state = "half_open"
+            self._transition("half_open")
             self._half_open_inflight = 0
 
     # ------------------------------------------------------------- protocol
@@ -219,44 +290,60 @@ class CircuitBreaker:
         """Admission check; half-open admits a bounded number of probes.
         Callers that take an admission MUST report the outcome via
         ``record_success``/``record_failure`` (or use ``call``)."""
-        with self._lock:
-            self._maybe_half_open()
-            if self._state == "closed":
-                return True
-            if self._state == "half_open":
-                if self._half_open_inflight < self.half_open_max_calls:
-                    self._half_open_inflight += 1
+        try:
+            with self._lock:
+                self._maybe_half_open()
+                if self._state == "closed":
                     return True
-            self.rejected += 1
-            return False
+                if self._state == "half_open":
+                    if self._half_open_inflight < self.half_open_max_calls:
+                        self._half_open_inflight += 1
+                        return True
+                self.rejected += 1
+                return False
+        finally:
+            self._notify()
 
     def record_success(self) -> None:
         with self._lock:
-            if self._state != "closed":
-                # half-open probe succeeded: close and start fresh
-                self._state = "closed"
+            self._rate_successes.append(self.clock())
+            self.consecutive_failures = 0
+            if self._state == "half_open" and self._half_open_inflight > 0:
+                # an allow()-admitted probe succeeded: close, start fresh.
+                # The inflight check matters: a state read may have flipped
+                # open->half_open lazily, and a straggler success from a
+                # pre-trip call must not close the breaker then — only a
+                # call that actually took a probe slot is evidence.
+                self._transition("closed")
                 self._failures.clear()
                 self._half_open_inflight = 0
             # closed: successes do NOT clear the window — a dependency
-            # failing half its calls must still trip; old failures age
-            # out of the rolling window on their own
+            # failing half its calls must still trip; old failures age out
+            # of the rolling window on their own.  OPEN stays open (even
+            # past cooldown): a straggler success from a call admitted
+            # before the trip must neither cancel the cooldown nor close
+            # the breaker without an allow()-admitted half-open probe.
+        self._notify()
 
     def record_failure(self) -> None:
         with self._lock:
             now = self.clock()
+            self._rate_failures.append(now)
+            self.consecutive_failures += 1
             if self._state == "half_open":
                 self._trip(now)
-                return
-            self._failures.append(now)
-            while self._failures and now - self._failures[0] > self.window_s:
-                self._failures.popleft()
-            if self._state == "closed" and \
-                    len(self._failures) >= self.failure_threshold:
-                self._trip(now)
+            else:
+                self._failures.append(now)
+                while self._failures and now - self._failures[0] > self.window_s:
+                    self._failures.popleft()
+                if self._state == "closed" and \
+                        len(self._failures) >= self.failure_threshold:
+                    self._trip(now)
+        self._notify()
 
     def _trip(self, now: float) -> None:
         # caller holds the lock
-        self._state = "open"
+        self._transition("open")
         self._opened_at = now
         self._failures.clear()
         self._half_open_inflight = 0
@@ -277,8 +364,12 @@ class CircuitBreaker:
         return result
 
     def as_dict(self) -> dict:
+        rate = self.failure_rate()  # prunes + computes outside the state lock
         with self._lock:
-            return {"state": self._state, "failures_in_window": len(self._failures),
+            return {"state": self._state,
+                    "failures_in_window": len(self._failures),
+                    "consecutive_failures": self.consecutive_failures,
+                    "failure_rate": round(rate, 4),
                     "rejected": self.rejected, "opened_count": self.opened_count}
 
 

@@ -1,0 +1,587 @@
+"""Unified observability layer: metrics registry + Prometheus exposition,
+tracing spans riding X-MMLSpark-Trace-Id across serving hops, breaker
+instrumentation, and the adaptive (queue-delay EWMA) shed signal.
+
+Everything here is tier-1 deterministic: fake clocks for time-dependent
+state, loopback sockets for the propagation paths, a numpy reference for
+the histogram percentile math.
+"""
+import json
+import math
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mmlspark_tpu.core.logging as core_logging
+from mmlspark_tpu.observability import (DEFAULT_LATENCY_BUCKETS,
+                                        MetricsRegistry, TRACE_HEADER,
+                                        current_span, current_trace_id,
+                                        instrument_breaker, trace_span)
+from mmlspark_tpu.observability.tracing import Span, export_span
+from mmlspark_tpu.serving import (PipelineServer, RoutingClient,
+                                  TopologyService, WorkerServer)
+from mmlspark_tpu.serving.server import _Entry
+from mmlspark_tpu.utils import StopWatch
+from mmlspark_tpu.utils.resilience import CircuitBreaker, FakeClock
+from tests.serving_helpers import Doubler
+
+
+# --------------------------------------------------------------- exposition
+
+def parse_prometheus(text):
+    """Tiny exposition-format parser: returns ({(name, frozenset(labels)):
+    value}, {name: type}).  Raises on malformed lines, so the round-trip
+    test also validates the format itself."""
+    values, types = {}, {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            assert kind in ("counter", "gauge", "histogram"), line
+            types[name] = kind
+            continue
+        if line.startswith("#"):
+            assert line.startswith("# HELP "), line
+            continue
+        body, sval = line.rsplit(" ", 1)
+        if "{" in body:
+            name, rest = body.split("{", 1)
+            assert rest.endswith("}"), line
+            labels = []
+            for pair in rest[:-1].split(","):
+                k, v = pair.split("=", 1)
+                assert v.startswith('"') and v.endswith('"'), line
+                labels.append((k, v[1:-1]))
+            key = (name, frozenset(labels))
+        else:
+            key = (body, frozenset())
+        values[key] = float(sval)
+    return values, types
+
+
+def test_prometheus_exposition_round_trip():
+    reg = MetricsRegistry()
+    c = reg.counter("mmlspark_test_ops_total", "ops", labels=("kind",))
+    c.inc(kind="read")
+    c.inc(3, kind="write")
+    g = reg.gauge("mmlspark_test_depth", "queue depth")
+    g.set(7, )
+    reg.gauge("mmlspark_test_live", "callback", labels=("src",)) \
+        .set_function(lambda: 2.5, src="cb")
+    h = reg.histogram("mmlspark_test_latency_seconds", "lat")
+    for v in (0.001, 0.01, 0.01, 5.0):
+        h.observe(v)
+
+    values, types = parse_prometheus(reg.to_prometheus())
+    assert types == {"mmlspark_test_ops_total": "counter",
+                     "mmlspark_test_depth": "gauge",
+                     "mmlspark_test_live": "gauge",
+                     "mmlspark_test_latency_seconds": "histogram"}
+    assert values[("mmlspark_test_ops_total", frozenset([("kind", "read")]))] == 1
+    assert values[("mmlspark_test_ops_total", frozenset([("kind", "write")]))] == 3
+    assert values[("mmlspark_test_depth", frozenset())] == 7
+    assert values[("mmlspark_test_live", frozenset([("src", "cb")]))] == 2.5
+    assert values[("mmlspark_test_latency_seconds_count", frozenset())] == 4
+    assert values[("mmlspark_test_latency_seconds_sum", frozenset())] == \
+        pytest.approx(5.021)
+    # histogram buckets are cumulative and end at +Inf == count
+    buckets = {k: v for k, v in values.items()
+               if k[0] == "mmlspark_test_latency_seconds_bucket"}
+    assert len(buckets) == len(DEFAULT_LATENCY_BUCKETS) + 1
+    inf_key = ("mmlspark_test_latency_seconds_bucket",
+               frozenset([("le", "+Inf")]))
+    assert values[inf_key] == 4
+    cums = [v for k, v in sorted(
+        buckets.items(),
+        key=lambda kv: float(dict(kv[0][1])["le"].replace("+Inf", "inf")))]
+    assert cums == sorted(cums), "bucket counts must be cumulative"
+    # JSON twin agrees
+    d = reg.to_dict()
+    assert d["mmlspark_test_latency_seconds"]["samples"][0]["count"] == 4
+    assert d["mmlspark_test_ops_total"]["type"] == "counter"
+
+
+def test_histogram_percentiles_match_numpy_reference():
+    rng = np.random.default_rng(7)
+    # log-uniform over the bucket range: every decade exercised
+    samples = 10.0 ** rng.uniform(-3.5, 1.5, size=4000)
+    reg = MetricsRegistry()
+    h = reg.histogram("mmlspark_test_p_seconds", "p")
+    for v in samples:
+        h.observe(float(v))
+    # bucketized estimate is within one bucket ratio (10^(1/4) ~ 1.78x)
+    # of the exact numpy percentile
+    ratio = 10.0 ** 0.25
+    for q in (50.0, 95.0, 99.0):
+        exact = float(np.percentile(samples, q))
+        est = h.percentile(q)
+        assert exact / ratio <= est <= exact * ratio, (q, exact, est)
+    # degenerate cases
+    assert math.isnan(reg.histogram("mmlspark_test_empty_seconds", "e")
+                      .percentile(50.0))
+    h2 = reg.histogram("mmlspark_test_clamp_seconds", "c")
+    h2.observe(9999.0)  # beyond the last finite bound -> clamps to it
+    assert h2.percentile(99.0) == pytest.approx(DEFAULT_LATENCY_BUCKETS[-1])
+
+
+def test_counter_hammered_from_8_threads_loses_nothing():
+    reg = MetricsRegistry()
+    c = reg.counter("mmlspark_test_hammer_total", "hammer", labels=("t",))
+    h = reg.histogram("mmlspark_test_hammer_seconds", "hammer")
+    N, T = 5000, 8
+
+    def worker():
+        for _ in range(N):
+            c.inc(t="x")
+            h.observe(0.001)
+
+    threads = [threading.Thread(target=worker) for _ in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value(t="x") == N * T
+    assert h.count() == N * T
+    assert h.sum() == pytest.approx(0.001 * N * T)
+
+
+def test_registry_rejects_type_conflicts_and_bad_names():
+    reg = MetricsRegistry()
+    reg.counter("mmlspark_test_a_total", "a")
+    with pytest.raises(ValueError):
+        reg.gauge("mmlspark_test_a_total", "a redeclared as gauge")
+    with pytest.raises(ValueError):
+        reg.counter("bad name!", "nope")
+    with pytest.raises(ValueError):
+        reg.counter("mmlspark_test_b_total", "b").inc(-1)
+
+
+# ------------------------------------------------------------------ tracing
+
+def test_trace_span_nests_parents_and_exports_to_registry_and_ring():
+    reg = MetricsRegistry()
+    with trace_span("outer", registry=reg) as outer:
+        tid = outer.trace_id
+        assert current_trace_id() == tid
+        with trace_span("inner", registry=reg) as inner:
+            assert inner.trace_id == tid            # same trace
+            assert inner.parent_id == outer.span_id  # parented
+    assert current_span() is None
+    assert reg.counter("mmlspark_spans_total", labels=("name",)) \
+        .value(name="inner") == 1
+    assert reg.histogram("mmlspark_span_seconds", labels=("name",)) \
+        .count(name="outer") == 1
+    ring = [e for e in core_logging.recent_events()
+            if e.get("event") == "span" and e.get("traceId") == tid]
+    assert [e["name"] for e in ring] == ["inner", "outer"]  # finish order
+
+
+def test_trace_span_marks_errors_and_records_deadline_budget():
+    reg = MetricsRegistry()
+    clk = FakeClock()
+    from mmlspark_tpu.utils.resilience import deadline_scope
+    with pytest.raises(ValueError):
+        with deadline_scope(2.0, clock=clk):
+            with trace_span("boom", registry=reg, clock=clk) as sp:
+                raise ValueError("x")
+    assert sp.status == "error:ValueError"
+    assert sp.attributes["deadline_remaining_ms"] == 2000
+
+
+def test_log_verb_rides_the_ambient_trace():
+    from mmlspark_tpu.core import DataFrame
+    df = DataFrame([{"request": np.asarray([1.0, 2.0])}])
+    with trace_span("caller", registry=MetricsRegistry()) as sp:
+        Doubler().transform(df)
+        tid = sp.trace_id
+    verb = [e for e in core_logging.recent_events()
+            if e.get("className") == "Doubler" and e.get("method") == "transform"]
+    assert verb and verb[-1]["traceId"] == tid
+    span = [e for e in core_logging.recent_events()
+            if e.get("event") == "span" and e.get("name") == "Doubler.transform"]
+    assert span and span[-1]["traceId"] == tid
+
+
+def test_stopwatch_is_a_span_facade_with_unchanged_api():
+    sw = StopWatch()
+    with trace_span("fit", registry=MetricsRegistry()) as sp:
+        with sw.measure("ingest"):
+            pass
+        with sw.measure("ingest"):
+            pass
+    assert sw.elapsed("ingest") > 0.0
+    assert set(sw.as_dict()) == {"ingest"}
+    assert sw.total_elapsed() >= sw.elapsed("ingest")
+    spans = [e for e in core_logging.recent_events()
+             if e.get("name") == "stopwatch.ingest"
+             and e.get("traceId") == sp.trace_id]
+    assert len(spans) == 2, "each measure() block must emit a span"
+
+
+# ------------------------------------------- trace propagation on the wire
+
+class Forwarder(Doubler):
+    """Worker-side stage that fans out over io/http to a backend server —
+    the trace id must survive client -> worker -> backend."""
+
+    def __init__(self, backend_url):
+        super().__init__()
+        self.backend_url = backend_url
+
+    def _transform(self, df):
+        from mmlspark_tpu.io.http import HTTPClient, HTTPRequestData
+
+        def per_part(p):
+            client = HTTPClient(retries=0)
+            out = np.empty(len(p["request"]), dtype=object)
+            for i, v in enumerate(p["request"]):
+                resp = client.send(
+                    HTTPRequestData.post_json(self.backend_url, float(v)))
+                out[i] = resp.json()
+            return {**p, "reply": out}
+        return df.map_partitions(per_part)
+
+
+def test_trace_id_propagates_client_to_server_to_worker_fanout():
+    reg = MetricsRegistry()
+    backend = PipelineServer(Doubler(), port=0, registry=reg).start()
+    svc = TopologyService(probe_interval_s=None, registry=reg).start()
+    worker = WorkerServer(Forwarder(backend.address), server_id="w0",
+                          driver_address=svc.address, port=0,
+                          registry=reg).start()
+    try:
+        client = RoutingClient(svc.address, registry=reg)
+        with trace_span("client.call", registry=reg) as sp:
+            assert client.request(5) == 10.0
+            tid = sp.trace_id
+        spans = [e for e in core_logging.recent_events()
+                 if e.get("event") == "span" and e.get("traceId") == tid]
+        names = {e["name"] for e in spans}
+        # the worker-side request span AND the backend's (one fan-out hop
+        # deeper) both joined the caller's trace
+        assert "serving.request" in names and "client.call" in names
+        worker_spans = [e for e in spans if e["name"] == "serving.request"]
+        assert len(worker_spans) >= 2, \
+            "expected worker + backend request spans on the same trace"
+        assert all(e["attr.status"] == 200 for e in worker_spans)
+        # per-worker routing metrics recorded the exchange
+        assert reg.counter("mmlspark_routing_requests_total",
+                           labels=("worker", "result")) \
+            .value(worker="w0", result="ok") == 1
+    finally:
+        worker.stop()
+        svc.stop()
+        backend.stop()
+
+
+# -------------------------------------------------- serving /metrics + stats
+
+def test_metrics_endpoint_serves_prometheus_with_breakers():
+    reg = MetricsRegistry()
+    breaker = instrument_breaker(
+        CircuitBreaker(failure_threshold=1, clock=FakeClock(), name="dep"),
+        reg)
+    breaker.record_failure()                     # open -> state gauge = 2
+    srv = PipelineServer(Doubler(), port=0, registry=reg).start()
+    try:
+        for i in range(3):
+            req = urllib.request.Request(
+                srv.address, data=str(i).encode(),
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=5).read()
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics").read().decode()
+        values, types = parse_prometheus(text)
+        label = f"127.0.0.1:{srv.port}"
+        sv = frozenset([("server", label)])
+        # acceptance: latency histogram, queue gauge, counters, breaker state
+        assert types["mmlspark_serving_request_latency_seconds"] == "histogram"
+        assert values[("mmlspark_serving_request_latency_seconds_count", sv)] == 3
+        assert values[("mmlspark_serving_queue_depth", sv)] == 0
+        assert values[("mmlspark_serving_requests_total",
+                       frozenset([("server", label), ("status", "replied")]))] == 3
+        # shed/error series pre-exist at 0 so scrapers never miss a first
+        # increment mid-incident
+        for status in ("shed", "error"):
+            assert values[("mmlspark_serving_requests_total",
+                           frozenset([("server", label),
+                                      ("status", status)]))] == 0
+        assert values[("mmlspark_breaker_state",
+                       frozenset([("breaker", "dep")]))] == 2
+        assert values[("mmlspark_serving_phase_seconds_count",
+                       frozenset([("server", label), ("phase", "queue")]))] == 3
+        assert values[("mmlspark_serving_phase_seconds_count",
+                       frozenset([("server", label), ("phase", "score")]))] == 3
+
+        stats = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/stats").read())
+        # satellite: breakers on /stats with state/consecutive/rate
+        assert stats["breakers"]["dep"]["state"] == "open"
+        assert stats["breakers"]["dep"]["consecutive_failures"] == 1
+        assert stats["breakers"]["dep"]["failure_rate"] == 1.0
+        # satellite: paired (sum, count) latency -> computable average
+        assert stats["latency_count"] == 3
+        assert stats["latency_avg_ms"] == pytest.approx(
+            1000.0 * stats["latency_sum_s"] / 3)
+        assert stats["received"] == stats["replied"] == 3
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------- adaptive (EWMA) shedding
+
+def test_queue_delay_ewma_sheds_and_recovers_on_fakeclock():
+    clk = FakeClock()
+    reg = MetricsRegistry()
+    srv = PipelineServer(Doubler(), port=0, clock=clk, registry=reg,
+                         shed_queue_delay_ewma_s=0.1, ewma_alpha=0.5).start()
+    try:
+        # drive admission + scoring directly, all time on the FakeClock
+        # (the socket threads stay idle: nothing rides the real queue)
+        assert srv._try_admit() is None             # healthy: admitted
+        e1 = _Entry(uid="a", payload=1.0, headers={}, t_enq=clk())
+        clk.advance(1.0)                            # e1 waited 1 s in queue
+        srv._score_batch([e1])
+        assert e1.reply == 2.0
+        assert srv._queue_ewma == pytest.approx(0.5)  # 0.5*1.0 + 0.5*0
+        # backlog present + EWMA over threshold -> adaptive shed
+        assert srv._try_admit() is None             # slot taken (backlog)
+        assert srv._try_admit() == "queue_delay_ewma"
+        s = srv.stats.as_dict()
+        assert s["shed"] == 1 and s["received"] == 3
+        # gauge mirrors the signal
+        assert reg.gauge("mmlspark_serving_queue_delay_ewma_seconds",
+                         labels=("server",)) \
+            .value(server=srv._server_label) == pytest.approx(0.5)
+        # drain the backlog: EWMA is stale-high but pending == 0 -> admit
+        e2 = _Entry(uid="b", payload=2.0, headers={}, t_enq=clk())
+        srv._score_batch([e2])
+        assert srv._pending == 0
+        assert srv._try_admit() is None, "drained server must recover"
+        srv._score_batch([_Entry(uid="c", payload=1.0, headers={},
+                                 t_enq=clk())])     # burn the taken slot
+        # fast scoring decays the EWMA below threshold
+        for uid in ("d", "e", "f"):
+            srv._try_admit()
+            srv._score_batch([_Entry(uid=uid, payload=1.0, headers={},
+                                     t_enq=clk())])  # zero queue delay
+        assert srv._queue_ewma < 0.1
+    finally:
+        srv.stop()
+
+
+def test_fixed_depth_shed_reason_still_applies():
+    clk = FakeClock()
+    srv = PipelineServer(Doubler(), port=0, clock=clk,
+                         registry=MetricsRegistry(), max_queue_depth=1)
+    assert srv._try_admit() is None
+    assert srv._try_admit() == "queue_full"
+
+
+# ----------------------------------------------------- breaker observability
+
+def test_breaker_transitions_feed_counters_and_failure_rate_window():
+    clk = FakeClock()
+    reg = MetricsRegistry()
+    b = instrument_breaker(
+        CircuitBreaker(failure_threshold=2, window_s=10.0, cooldown_s=5.0,
+                       clock=clk, name="svc"), reg)
+    b.record_success()
+    b.record_failure()
+    assert b.failure_rate() == pytest.approx(0.5)
+    b.record_failure()                              # trips open
+    assert b.state == "open"
+    clk.advance(5.0)
+    assert b.state == "half_open"
+    assert b.allow()                                # admitted probe...
+    b.record_success()                              # ...success closes
+    assert b.state == "closed"
+    t = reg.counter("mmlspark_breaker_transitions_total",
+                    labels=("breaker", "to"))
+    assert t.value(breaker="svc", to="open") == 1
+    assert t.value(breaker="svc", to="half_open") == 1
+    assert t.value(breaker="svc", to="closed") == 1
+    # outcomes age out of the rolling window
+    clk.advance(11.0)
+    assert b.failure_rate() == 0.0
+    assert reg.breaker_stats()["svc"]["state"] == "closed"
+
+
+def test_routing_client_breaker_skips_open_worker(monkeypatch):
+    reg = MetricsRegistry()
+    svc = TopologyService(probe_interval_s=None, registry=reg).start()
+    workers = [WorkerServer(Doubler(), server_id=f"w{i}",
+                            driver_address=svc.address, port=0,
+                            registry=reg).start()
+               for i in range(2)]
+    try:
+        clk = FakeClock()
+        client = RoutingClient(
+            svc.address, registry=reg,
+            breaker_factory=lambda sid: CircuitBreaker(
+                failure_threshold=2, window_s=60.0, cooldown_s=30.0,
+                clock=clk, name=f"worker:{sid}"))
+        workers[0].server.stop()                    # dead but registered
+        from mmlspark_tpu.serving import distributed as dist
+        score_calls = []
+        real = dist._http_json
+
+        def counting(url, payload=None, **kw):
+            if "/score" in url:
+                score_calls.append(url)
+            return real(url, payload, **kw)
+
+        monkeypatch.setattr(dist, "_http_json", counting)
+        # round-robin lands on dead w0 every other request; each hit books a
+        # breaker failure then fails over to w1 — two hits trip it open
+        for _ in range(8):
+            if client.breakers.get("w0") is not None \
+                    and client.breakers["w0"].state == "open":
+                break
+            assert client.request(1) == 2.0
+        assert client.breakers["w0"].state == "open"
+        score_calls.clear()
+        for i in range(4):
+            assert client.request(i) == 2 * i
+        # breaker open: every exchange went straight to w1, no dead-socket
+        # attempt, no failover hop
+        assert all(str(workers[1].server.port) in u for u in score_calls)
+        assert len(score_calls) == 4
+        assert reg.counter("mmlspark_routing_failovers_total",
+                           labels=("worker",)).value(worker="w0") >= 2
+        # recovery: w0 comes back on the SAME registered host:port; after
+        # cooldown the next successful exchange is accounted as the probe
+        # and closes the breaker
+        w0 = workers[0]
+        w0.server = type(w0.server)(Doubler(), host=w0.server.host,
+                                    port=w0.server.port, registry=reg)
+        w0.server.start()
+        clk.advance(30.0)                           # past cooldown
+        for i in range(4):                          # round-robin hits w0
+            assert client.request(i) == 2 * i
+        assert client.breakers["w0"].state == "closed"
+    finally:
+        for w in workers:
+            w.stop()
+        svc.stop()
+
+
+def test_expired_client_deadline_never_poisons_worker_breakers():
+    # _http_json raises before any socket I/O when the caller's budget is
+    # gone; that is a CLIENT-side condition and must not feed any worker's
+    # breaker or failover counter
+    reg = MetricsRegistry()
+    svc = TopologyService(probe_interval_s=None, registry=reg).start()
+    worker = WorkerServer(Doubler(), server_id="w0",
+                          driver_address=svc.address, port=0,
+                          registry=reg).start()
+    try:
+        clk = FakeClock()
+        client = RoutingClient(svc.address, registry=reg)
+        from mmlspark_tpu.utils.resilience import Deadline
+        dead = Deadline.after(0.0, clk)
+        clk.advance(0.1)
+        for _ in range(6):
+            with pytest.raises(Exception):
+                client.request(1, deadline=dead)
+        assert client.breakers.get("w0") is None or \
+            client.breakers["w0"].state == "closed"
+        assert reg.counter("mmlspark_routing_failovers_total",
+                           labels=("worker",)).value(worker="w0") == 0
+        assert client.request(2) == 4.0     # worker still fully routable
+    finally:
+        worker.stop()
+        svc.stop()
+
+
+def test_4xx_reply_does_not_feed_breakers_or_failover(monkeypatch):
+    # 4xx is a verdict on the request, not the worker: no breaker feed, no
+    # failover hop, the HTTPError surfaces to the caller directly
+    import time as _time
+    from mmlspark_tpu.serving import distributed as dist
+    reg = MetricsRegistry()
+    client = RoutingClient("http://driver", registry=reg)
+    client._table = [{"server_id": "w0", "host": "h", "port": 1}]
+    client._fetched = _time.monotonic()          # fresh table: no refetch
+
+    def fake(url, payload=None, **kw):
+        raise urllib.error.HTTPError(url, 400, "bad request", {}, None)
+
+    monkeypatch.setattr(dist, "_http_json", fake)
+    with pytest.raises(urllib.error.HTTPError):
+        client.request({"malformed": True})
+    assert client.breakers["w0"].state == "closed"
+    assert client.breakers["w0"].failure_rate() == 0.0
+    assert reg.counter("mmlspark_routing_failovers_total",
+                       labels=("worker",)).value(worker="w0") == 0
+
+
+def test_histogram_bucket_conflict_raises():
+    reg = MetricsRegistry()
+    reg.histogram("mmlspark_test_rows", "rows", buckets=(10.0, 100.0))
+    reg.histogram("mmlspark_test_rows", "rows")  # no buckets: reuse ok
+    with pytest.raises(ValueError):
+        reg.histogram("mmlspark_test_rows", "rows", buckets=(1.0, 2.0))
+
+
+def test_stopped_server_unhooks_callback_gauges():
+    # a stopped server's sampler closures must leave the registry (they pin
+    # the server object and would emit frozen series forever)
+    reg = MetricsRegistry()
+    srv = PipelineServer(Doubler(), port=0, registry=reg).start()
+    label = srv._server_label
+    assert f'mmlspark_serving_queue_depth{{server="{label}"}}' \
+        in reg.to_prometheus()
+    srv.stop()
+    assert f'mmlspark_serving_queue_depth{{server="{label}"}}' \
+        not in reg.to_prometheus()
+
+
+def test_unstarted_server_registers_no_ghost_series():
+    # constructing a server (port still 0) must not leak "host:0" children
+    # into the registry; real series appear once start() resolves the port
+    reg = MetricsRegistry()
+    srv = PipelineServer(Doubler(), port=0, registry=reg)
+    assert srv._try_admit() is None              # pre-start sink absorbs it
+    assert "127.0.0.1:0" not in reg.to_prometheus()
+    srv.start()
+    try:
+        assert f"127.0.0.1:{srv.port}" in reg.to_prometheus()
+    finally:
+        srv.stop()
+
+
+def test_topology_probe_counters_and_eviction_metric():
+    reg = MetricsRegistry()
+    verdicts = {"w0": False, "w1": True}
+    svc = TopologyService(probe_interval_s=None, evict_after=2, registry=reg,
+                          prober=lambda w, t: verdicts[w["server_id"]])
+    with svc._lock:
+        svc._workers = {"w0": {"server_id": "w0", "host": "h", "port": 1},
+                        "w1": {"server_id": "w1", "host": "h", "port": 2}}
+    assert svc.probe_once() == []
+    assert svc.probe_once() == ["w0"]
+    probes = reg.counter("mmlspark_topology_probes_total",
+                         labels=("worker", "result"))
+    assert probes.value(worker="w0", result="fail") == 2
+    assert probes.value(worker="w1", result="ok") == 2
+    assert reg.counter("mmlspark_topology_evictions_total",
+                       labels=("worker",)).value(worker="w0") == 1
+
+
+# ---------------------------------------------------------- span back-dating
+
+def test_manual_span_backdates_to_enqueue_time_on_injected_clock():
+    clk = FakeClock(start=100.0)
+    reg = MetricsRegistry()
+    sp = Span("serving.request", clock=clk, start_s=90.0)
+    clk.advance(5.0)
+    sp.finish()
+    export_span(sp, reg)
+    assert sp.duration_s == pytest.approx(15.0)
+    assert reg.histogram("mmlspark_span_seconds", labels=("name",)) \
+        .sum(name="serving.request") == pytest.approx(15.0)

@@ -95,6 +95,29 @@ def test_breaker_trips_on_failure_rate_despite_interleaved_successes():
     assert b.state == "open"
 
 
+def test_breaker_straggler_success_does_not_cancel_open_cooldown():
+    # a call admitted BEFORE the trip may succeed while the breaker is open;
+    # that straggler must not close a breaker guarding a mostly-failing
+    # dependency (only a post-cooldown half-open probe may)
+    clk = FakeClock()
+    b = CircuitBreaker(failure_threshold=2, cooldown_s=10.0, clock=clk)
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "open"
+    b.record_success()                  # straggler from a pre-trip call
+    assert b.state == "open"
+    assert not b.allow()
+    clk.advance(10.0)
+    b.record_success()                  # post-cooldown straggler: still not
+    #                                     a probe — only allow() admits one
+    assert b.state == "half_open"       # the read flips open->half_open...
+    b.record_success()                  # ...but with NO admitted probe a
+    assert b.state == "half_open"       # straggler still must not close it
+    assert b.allow()                    # half-open probe
+    b.record_success()
+    assert b.state == "closed"
+
+
 def test_breaker_call_raises_circuit_open():
     clk = FakeClock()
     b = CircuitBreaker(failure_threshold=1, cooldown_s=7.0, clock=clk, name="x")

@@ -170,18 +170,26 @@ def test_sustained_concurrent_load_rps_and_p99():
 
     srv = PipelineServer(_Echo(), port=0, mode="continuous").start()
     try:
-        res = sustained_load("127.0.0.1", srv.port, srv.api_path,
-                             json.dumps([1.0, 2.0, 3.0]),
-                             {"Content-Type": "application/json"})
-        assert res["errors"] == 0, res
-        assert res["completed"] == 8 * 250, res
         # chip host measures ~3-6k RPS aggregate on this path; CI floor with
-        # shared-container headroom.  Recalibrated r6: the shared CI box
-        # itself swings 440-760 RPS on this path (measured on identical
-        # code, interleaved runs), so the old 700 floor tripped on noise —
-        # the realistic regression mode is 5-10x, not 20%, so 350 still
-        # catches anything real without gating on neighbor load
-        assert res["rps"] > 350, f"sustained RPS {res['rps']:.0f} regressed"
-        assert res["p99_ms"] < 150.0, f"sustained p99 {res['p99_ms']:.2f} ms"
+        # shared-container headroom.  Recalibrated r6 to 350 when the box
+        # swung 440-760; PR 2 re-measured UNCHANGED seed code dipping to
+        # 177-353 under neighbor load (1-in-3 failures at a one-shot 350
+        # floor on both old and new code).  The noise is ONE-SIDED —
+        # neighbors only ever slow this box down — so gate on the BEST of
+        # up to 3 attempts: keeps the full 350 floor's power against real
+        # regressions (5-10x mode) without gating on neighbor load.
+        attempts = []
+        for _ in range(3):
+            res = sustained_load("127.0.0.1", srv.port, srv.api_path,
+                                 json.dumps([1.0, 2.0, 3.0]),
+                                 {"Content-Type": "application/json"})
+            assert res["errors"] == 0, res
+            assert res["completed"] == 8 * 250, res
+            attempts.append((res["rps"], res["p99_ms"]))
+            if res["rps"] > 350 and res["p99_ms"] < 150.0:
+                break
+        assert any(rps > 350 and p99 < 150.0 for rps, p99 in attempts), \
+            "sustained serving regressed on every attempt: " + ", ".join(
+                f"{rps:.0f} rps / p99 {p99:.1f} ms" for rps, p99 in attempts)
     finally:
         srv.stop()
