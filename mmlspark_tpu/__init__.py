@@ -51,9 +51,15 @@ __version__ = "0.2.0"
 # `jax.experimental.shard_map.shard_map` (whose equivalent kwarg is
 # `check_rep`).  Install a translating alias so every call site works on
 # both — without it the whole parallel/ layer fails at call time.
-import jax as _jax
+# Tolerate a missing jax entirely: the pure-source tools (graft-lint,
+# `python -m mmlspark_tpu.analysis`) must run on lint-only environments;
+# compute modules fail at their own import time as before.
+try:
+    import jax as _jax
+except ImportError:
+    _jax = None
 
-if not hasattr(_jax, "shard_map"):
+if _jax is not None and not hasattr(_jax, "shard_map"):
     import functools as _functools
     from jax.experimental.shard_map import shard_map as _shard_map
 

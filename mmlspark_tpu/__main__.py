@@ -1,0 +1,28 @@
+"""``python -m mmlspark_tpu <command>`` — package tool entry points.
+
+Commands:
+  graft-lint [args...]   the static-analysis gate (alias: lint, analysis);
+                         same CLI as ``python -m mmlspark_tpu.analysis``
+  codegen [out_dir]      regenerate the codegen artifacts (default docs/api)
+  help                   this message
+"""
+import sys
+from typing import Optional, Sequence
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    cmd = argv.pop(0) if argv else "help"
+    if cmd in ("graft-lint", "lint", "analysis"):
+        from .analysis.cli import main as lint_main
+        return lint_main(argv)
+    if cmd == "codegen":
+        from .codegen.codegen import generate_all
+        generate_all(argv[0] if argv else "docs/api")
+        return 0
+    print(__doc__.strip())
+    return 0 if cmd in ("help", "-h", "--help") else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

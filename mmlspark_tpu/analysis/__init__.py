@@ -1,0 +1,30 @@
+"""graft-lint — repo-wide static analysis for the invariants this codebase
+actually enforces in review: tracer safety under XLA (TRC), resilience
+coverage at remote boundaries (RES), lock discipline in the telemetry layer
+(LCK), hot-path hygiene in serving (HOT), and stage contracts mirroring the
+fuzzing harness (STG).
+
+Usage::
+
+    python -m mmlspark_tpu.analysis                 # gate: 0 = clean
+    python -m mmlspark_tpu.analysis --format json
+    python -m mmlspark_tpu.analysis --update-baseline
+
+See ``docs/STATIC_ANALYSIS.md`` for the rule catalog, the pragma/baseline
+workflow, and how to add a checker.
+"""
+from .baseline import (BaselineEntry, load_baseline, save_baseline,
+                       split_findings, update_baseline)
+from .checkers import (HotPathChecker, LockDisciplineChecker,
+                       ResilienceCoverageChecker, TracerSafetyChecker)
+from .cli import default_checkers, main, rule_catalog, run_analysis
+from .engine import AnalysisEngine, Checker, Finding, iter_python_files
+from .stagecheck import StageContractChecker
+
+__all__ = [
+    "AnalysisEngine", "BaselineEntry", "Checker", "Finding",
+    "HotPathChecker", "LockDisciplineChecker", "ResilienceCoverageChecker",
+    "StageContractChecker", "TracerSafetyChecker", "default_checkers",
+    "iter_python_files", "load_baseline", "main", "rule_catalog",
+    "run_analysis", "save_baseline", "split_findings", "update_baseline",
+]

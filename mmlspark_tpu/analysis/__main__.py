@@ -1,0 +1,7 @@
+"""``python -m mmlspark_tpu.analysis`` — the graft-lint gate."""
+import sys
+
+from .cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

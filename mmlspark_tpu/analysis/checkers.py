@@ -1,0 +1,389 @@
+"""graft-lint checkers: TRC tracer safety, RES resilience coverage,
+LCK lock discipline, HOT hot-path hygiene.
+
+Each rule encodes an invariant this repo has actually shipped a fix for —
+see ``docs/STATIC_ANALYSIS.md`` for the catalog with the review history
+behind every rule.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .engine import Checker, Finding, ModuleContext, with_lock_items
+
+__all__ = ["TracerSafetyChecker", "ResilienceCoverageChecker",
+           "LockDisciplineChecker", "HotPathChecker"]
+
+
+# ---------------------------------------------------------------------------
+# TRC — tracer safety
+# ---------------------------------------------------------------------------
+
+#: transforms whose function argument is traced by XLA: a host call inside
+#: silently becomes either a compile-time constant (wrong results) or a
+#: forced host sync/recompile (the latency cliff the north-star forbids)
+_TRACING_ENTRY_POINTS = {
+    "jax.jit", "jit", "jax.pmap", "pmap", "jax.vmap", "vmap",
+    "jax.shard_map", "shard_map", "jax.experimental.shard_map.shard_map",
+    "jax.grad", "jax.value_and_grad", "jax.checkpoint", "jax.remat",
+    "jax.lax.scan", "lax.scan", "jax.lax.while_loop", "lax.while_loop",
+    "jax.lax.fori_loop", "lax.fori_loop", "jax.lax.cond", "lax.cond",
+    "jax.lax.map", "lax.map",
+}
+
+#: host-side calls that must never run under a tracer
+_TRC_BANNED_PREFIXES = {
+    "time.time": "reads the host clock (traced to a constant)",
+    "time.monotonic": "reads the host clock (traced to a constant)",
+    "time.perf_counter": "reads the host clock (traced to a constant)",
+    "datetime.datetime.now": "reads the host clock (traced to a constant)",
+    "numpy.random": "host RNG (traced to a constant; use jax.random)",
+    "uuid": "host entropy (traced to a constant)",
+    "os.urandom": "host entropy syscall (forces a host sync)",
+    "random.random": "host RNG (traced to a constant)",
+    "random.randint": "host RNG (traced to a constant)",
+    "threading.Lock": "host lock under a tracer",
+    "threading.RLock": "host lock under a tracer",
+}
+
+
+def _dotted_prefix_hit(dotted: str, table: Dict[str, str]) -> Optional[Tuple[str, str]]:
+    for prefix, why in table.items():
+        if dotted == prefix or dotted.startswith(prefix + "."):
+            return prefix, why
+    return None
+
+
+class _FnInfo:
+    __slots__ = ("node", "qualname", "calls", "banned", "param_names")
+
+    def __init__(self, node: ast.AST, qualname: str):
+        self.node = node
+        self.qualname = qualname
+        #: local names this function calls (intra-module edges)
+        self.calls: Set[str] = set()
+        #: (node, message) banned sites found inside this function
+        self.banned: List[Tuple[ast.AST, str, str]] = []
+        args = node.args
+        self.param_names = {a.arg for a in
+                            args.posonlyargs + args.args + args.kwonlyargs}
+
+
+class TracerSafetyChecker(Checker):
+    """TRC — functions reachable from jit/shard_map/pmap/scan call sites
+    must stay traceable: no host clocks/RNG/entropy, no print, no locks,
+    no ``.item()``/``float()`` host syncs on array arguments.
+
+    Reachability is module-local: roots are functions decorated with (or
+    passed to) a tracing entry point; edges are same-module calls by name.
+    """
+
+    rules = {
+        "TRC001": "host clock/RNG/entropy call inside traced code",
+        "TRC002": "print() inside traced code",
+        "TRC003": "lock acquisition inside traced code",
+        "TRC004": "host sync (.item()/float()/int() on a traced arg) "
+                  "inside traced code",
+    }
+
+    SCOPE_DIRS = ("parallel/", "ops/", "models/", "lightgbm/")
+
+    def interested(self, relpath: str) -> bool:
+        return any(f"/{d}" in f"/{relpath}" for d in self.SCOPE_DIRS)
+
+    def begin_module(self, ctx: ModuleContext) -> None:
+        ctx._trc_functions: Dict[str, _FnInfo] = {}
+        ctx._trc_roots: Set[str] = set()
+        ctx._trc_stack: List[_FnInfo] = []
+
+    # ------------------------------------------------------------- helpers
+    def _is_tracing_call(self, node: ast.Call, ctx: ModuleContext) -> bool:
+        dotted = ctx.dotted_name(node.func)
+        if dotted in _TRACING_ENTRY_POINTS:
+            return True
+        # functools.partial(jax.jit, ...) used as a decorator factory
+        if dotted in ("functools.partial", "partial") and node.args:
+            inner = ctx.dotted_name(node.args[0])
+            return inner in _TRACING_ENTRY_POINTS
+        return False
+
+    def _mark_function_args(self, node: ast.Call, ctx: ModuleContext) -> None:
+        """Names passed into a tracing entry point become roots."""
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Name):
+                ctx._trc_roots.add(arg.id)
+            elif isinstance(arg, ast.Attribute):
+                # self._step / cls.step — root by attribute name
+                ctx._trc_roots.add(arg.attr)
+
+    # ------------------------------------------------------------- events
+    def visit(self, node: ast.AST, ctx: ModuleContext) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qn = (ctx.scope_qualname() + "." if ctx.scope_stack else "") \
+                + node.name
+            info = _FnInfo(node, qn)
+            # last short-name definition wins; module-local resolution
+            ctx._trc_functions[node.name] = info
+            for dec in node.decorator_list:
+                dec_target = dec.func if isinstance(dec, ast.Call) else dec
+                dotted = ctx.dotted_name(dec_target)
+                if dotted in _TRACING_ENTRY_POINTS:
+                    ctx._trc_roots.add(node.name)
+                elif isinstance(dec, ast.Call) and \
+                        self._is_tracing_call(dec, ctx):
+                    ctx._trc_roots.add(node.name)
+            return
+        if isinstance(node, ast.Call) and self._is_tracing_call(node, ctx):
+            # jax.jit(f) / lax.scan(step, ...) at ANY scope roots its
+            # function arguments, including module-level `step = jit(fn)`
+            self._mark_function_args(node, ctx)
+            return
+        fn = self._enclosing(ctx)
+        if fn is None or not isinstance(node, (ast.Call, ast.With)):
+            return
+        if isinstance(node, ast.With):
+            if with_lock_items(node):
+                fn.banned.append((node, "TRC003",
+                                  "lock held inside traced code"))
+            return
+        dotted = ctx.dotted_name(node.func)
+        if dotted is not None:
+            hit = _dotted_prefix_hit(dotted, _TRC_BANNED_PREFIXES)
+            if hit is not None:
+                fn.banned.append((node, "TRC001",
+                                  f"{dotted}() — {hit[1]}"))
+                return
+            if dotted == "print":
+                fn.banned.append((node, "TRC002",
+                                  "print() forces a host sync under jit"))
+                return
+            if dotted in ("float", "int", "bool") and node.args and \
+                    isinstance(node.args[0], ast.Name) and \
+                    node.args[0].id in fn.param_names:
+                fn.banned.append((
+                    node, "TRC004",
+                    f"{dotted}({node.args[0].id}) concretizes a traced "
+                    "argument (host sync / ConcretizationTypeError)"))
+                return
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr == "item" and not node.args:
+                fn.banned.append((node, "TRC004",
+                                  ".item() forces a device->host sync"))
+            elif node.func.attr == "acquire":
+                fn.banned.append((node, "TRC003",
+                                  "lock.acquire() inside traced code"))
+            elif isinstance(node.func.value, ast.Name):
+                fn.calls.add(node.func.attr)  # self.method / mod.func edge
+        elif isinstance(node.func, ast.Name):
+            fn.calls.add(node.func.id)
+
+    def _enclosing(self, ctx: ModuleContext) -> Optional[_FnInfo]:
+        fnode = ctx.enclosing_function()
+        if fnode is None:
+            return None
+        for info in ctx._trc_functions.values():
+            if info.node is fnode:
+                return info
+        return None
+
+    def end_module(self, ctx: ModuleContext) -> None:
+        functions: Dict[str, _FnInfo] = ctx._trc_functions
+        # BFS over intra-module call edges from the traced roots
+        traced: Set[str] = set()
+        frontier = [r for r in ctx._trc_roots if r in functions]
+        while frontier:
+            name = frontier.pop()
+            if name in traced:
+                continue
+            traced.add(name)
+            for callee in functions[name].calls:
+                if callee in functions and callee not in traced:
+                    frontier.append(callee)
+        for name in sorted(traced):
+            info = functions[name]
+            for node, rule, message in info.banned:
+                ctx._findings.append(Finding(
+                    rule=rule, file=ctx.relpath, line=node.lineno,
+                    message=message, symbol=info.qualname))
+
+
+# ---------------------------------------------------------------------------
+# RES — resilience coverage
+# ---------------------------------------------------------------------------
+
+_RES_BANNED = {
+    "urllib.request.urlopen": "raw urlopen bypasses breaker + deadline "
+                              "clipping (route through io/http.py clients)",
+    "urllib.request.Request": "raw urllib request construction outside the "
+                              "resilient clients",
+    "urllib.request.build_opener": "raw urllib opener outside the resilient "
+                                   "clients",
+    "http.client.HTTPConnection": "raw http.client bypasses the resilient "
+                                  "clients",
+    "http.client.HTTPSConnection": "raw http.client bypasses the resilient "
+                                   "clients",
+    "requests.get": "requests bypasses breaker + deadline clipping",
+    "requests.post": "requests bypasses breaker + deadline clipping",
+    "requests.put": "requests bypasses breaker + deadline clipping",
+    "requests.delete": "requests bypasses breaker + deadline clipping",
+    "requests.request": "requests bypasses breaker + deadline clipping",
+    "requests.Session": "requests bypasses breaker + deadline clipping",
+    "socket.socket": "raw socket outside the resilient clients",
+    "socket.create_connection": "raw socket connection outside the "
+                                "resilient clients",
+}
+
+
+class ResilienceCoverageChecker(Checker):
+    """RES — every remote call outside ``io/http.py`` and ``serving/``
+    internals must route through the breaker/deadline-aware clients
+    (PR 1's contract; raw urllib has no budget and no circuit)."""
+
+    rules = {"RES001": "raw urllib/requests/socket outside the resilient "
+                       "HTTP clients"}
+
+    #: modules allowed to touch raw transports: the resilient clients
+    #: themselves and the serving internals that ARE the server side
+    ALLOWED = ("io/http.py", "serving/", "testing/chaos.py")
+
+    def interested(self, relpath: str) -> bool:
+        norm = f"/{relpath}"
+        return not any(f"/{a}" in norm or norm.endswith(f"/{a}")
+                       for a in (f"mmlspark_tpu/{p}" for p in self.ALLOWED))
+
+    def visit(self, node: ast.AST, ctx: ModuleContext) -> None:
+        if not isinstance(node, ast.Call):
+            return
+        dotted = ctx.dotted_name(node.func)
+        if dotted is None:
+            return
+        hit = _dotted_prefix_hit(dotted, _RES_BANNED)
+        if hit is not None:
+            ctx.report("RES001", node, f"{dotted}() — {hit[1]}")
+
+
+# ---------------------------------------------------------------------------
+# LCK — lock discipline
+# ---------------------------------------------------------------------------
+
+_LCK_IO_CALLS = {
+    "open": "file I/O under a lock",
+    "print": "console I/O under a lock",
+    "json.dumps": "serialization under a lock (PR 2: log_event now dumps "
+                  "outside; check-then-serialize instead)",
+    "json.dump": "serialization under a lock",
+    "json.loads": "deserialization under a lock",
+    "time.sleep": "sleeping under a lock",
+    "urllib.request.urlopen": "network I/O under a lock",
+    "socket.socket": "socket work under a lock",
+    "subprocess.run": "subprocess under a lock",
+}
+
+_LCK_CALLBACK_NAME = re.compile(r"^(fn|cb|callback|listener|hook|prober|"
+                                r"sampler)s?(_\w+)?$|^on_[a-z_]+$")
+
+
+class LockDisciplineChecker(Checker):
+    """LCK — nothing slow or re-entrant may run inside a ``with <lock>:``
+    body in the observability layer or the resilience primitives: no I/O
+    or serialization, no user-callback invocation (three PR 2 review fixes
+    were exactly this shape: drain under the lock, notify outside), and no
+    nested lock acquisition (ordering deadlocks)."""
+
+    rules = {
+        "LCK001": "I/O or serialization under a lock",
+        "LCK002": "callback invocation under a lock",
+        "LCK003": "nested lock acquisition",
+    }
+
+    SCOPE = ("observability/", "utils/resilience.py")
+
+    def interested(self, relpath: str) -> bool:
+        return any(f"/{s}" in f"/{relpath}" for s in self.SCOPE)
+
+    def visit(self, node: ast.AST, ctx: ModuleContext) -> None:
+        if isinstance(node, ast.With) and ctx.lock_depth > 0 and \
+                with_lock_items(node):
+            ctx.report("LCK003", node,
+                       "nested lock acquisition (lock-ordering deadlock "
+                       "risk — copy state out, release, then lock)")
+            return
+        if ctx.lock_depth == 0 or not isinstance(node, ast.Call):
+            return
+        dotted = ctx.dotted_name(node.func)
+        if dotted is not None:
+            hit = _dotted_prefix_hit(dotted, _LCK_IO_CALLS)
+            if hit is not None:
+                ctx.report("LCK001", node, f"{dotted}() — {hit[1]}")
+                return
+        if isinstance(node.func, ast.Name) and \
+                _LCK_CALLBACK_NAME.match(node.func.id):
+            ctx.report(
+                "LCK002", node,
+                f"callback {node.func.id}() invoked under a lock — drain "
+                "the work list under the lock, call outside it")
+        elif isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "acquire":
+            ctx.report("LCK003", node,
+                       "lock.acquire() while already holding a lock")
+
+
+# ---------------------------------------------------------------------------
+# HOT — hot-path hygiene
+# ---------------------------------------------------------------------------
+
+_HOT_BANNED = {
+    "uuid.uuid4": "per-call os.urandom syscall (~40us) in the serialized "
+                  "hot path — use a counter + process prefix "
+                  "(observability/tracing.py pattern)",
+    "uuid.uuid1": "uuid in the hot path — use a counter + process prefix",
+    "os.urandom": "entropy syscall in the hot path — amortize at module "
+                  "scope (one prefix per process)",
+}
+
+_HOT_LOG_CALL = re.compile(r"(^|\.)(log\w*|debug|info|warning|error|"
+                           r"exception|critical)$")
+
+
+class HotPathChecker(Checker):
+    """HOT — the serving score path and span creation must stay syscall-
+    and allocation-lean: PR 2 held serving overhead to ~10% only after
+    hand-removing uuid4/os.urandom from the serialized section and making
+    log serialization conditional.  Module-level use is exempt (that IS
+    the amortization pattern)."""
+
+    rules = {
+        "HOT001": "uuid4/os.urandom inside a hot-path function",
+        "HOT002": "f-string eagerly formatted into a logging call on the "
+                  "hot path",
+    }
+
+    SCOPE = ("serving/", "observability/tracing.py")
+
+    def interested(self, relpath: str) -> bool:
+        return any(f"/{s}" in f"/{relpath}" for s in self.SCOPE)
+
+    def visit(self, node: ast.AST, ctx: ModuleContext) -> None:
+        if not isinstance(node, ast.Call):
+            return
+        if ctx.enclosing_function() is None:
+            return  # module-level amortization is the sanctioned pattern
+        dotted = ctx.dotted_name(node.func)
+        if dotted is not None:
+            hit = _dotted_prefix_hit(dotted, _HOT_BANNED)
+            if hit is not None:
+                ctx.report("HOT001", node, f"{dotted}() — {hit[1]}")
+                return
+        name = dotted or (node.func.attr
+                          if isinstance(node.func, ast.Attribute) else "")
+        if name and _HOT_LOG_CALL.search(name):
+            for arg in node.args:
+                if isinstance(arg, ast.JoinedStr):
+                    ctx.report(
+                        "HOT002", node,
+                        "f-string formatted before the logging call can "
+                        "decide to drop it — pass structured fields and "
+                        "format lazily (core/logging gates on listeners)")
+                    return

@@ -1,0 +1,145 @@
+"""graft-lint CLI.
+
+    python -m mmlspark_tpu.analysis [paths...] [--format text|json]
+                                    [--update-baseline] [--baseline FILE]
+                                    [--rules TRC001,RES001,...] [--no-baseline]
+
+Exit status: 0 when every finding is baselined (or none), 1 when any
+unbaselined finding exists, 2 on usage errors.  Default scan target is the
+``mmlspark_tpu`` package the module was imported from; default baseline is
+``analysis-baseline.toml`` next to the package (the repo root).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from .baseline import (DEFAULT_BASELINE_NAME, load_baseline, split_findings,
+                       update_baseline)
+from .checkers import (HotPathChecker, LockDisciplineChecker,
+                       ResilienceCoverageChecker, TracerSafetyChecker)
+from .engine import AnalysisEngine, Checker, Finding, iter_python_files
+from .stagecheck import StageContractChecker
+
+__all__ = ["default_checkers", "run_analysis", "main", "rule_catalog"]
+
+
+def default_checkers() -> List[Checker]:
+    return [TracerSafetyChecker(), ResilienceCoverageChecker(),
+            LockDisciplineChecker(), HotPathChecker(),
+            StageContractChecker()]
+
+
+def rule_catalog() -> dict:
+    """rule id -> description across all shipped checkers."""
+    catalog = {}
+    for checker in default_checkers():
+        catalog.update(checker.rules)
+    return catalog
+
+
+def _package_root() -> str:
+    """The directory CONTAINING the mmlspark_tpu package (the repo root in
+    a checkout) — findings are reported relative to it."""
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg_dir)
+
+
+def run_analysis(paths: Optional[Sequence[str]] = None,
+                 root: Optional[str] = None,
+                 checkers: Optional[Sequence[Checker]] = None,
+                 rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Programmatic entry: scan ``paths`` (default: the installed
+    mmlspark_tpu package), return all findings before baselining."""
+    root = root or _package_root()
+    if paths is None:
+        paths = [os.path.join(root, "mmlspark_tpu")]
+    files: List[str] = []
+    for p in paths:
+        files.extend(iter_python_files(p))
+    engine = AnalysisEngine(checkers or default_checkers(), root=root)
+    findings = engine.run(files)
+    if rules:
+        wanted = set(rules)
+        findings = [f for f in findings if f.rule in wanted]
+    return findings
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="graft-lint",
+        description="AST invariant checker: tracer safety (TRC), resilience "
+                    "coverage (RES), lock discipline (LCK), hot-path "
+                    "hygiene (HOT), stage contracts (STG).")
+    parser.add_argument("paths", nargs="*",
+                        help="files/dirs to scan (default: the "
+                             "mmlspark_tpu package)")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline file (default: "
+                             f"{DEFAULT_BASELINE_NAME} at the repo root)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline: report every finding "
+                             "and fail on any")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline from current findings "
+                             "(existing justifications are preserved)")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule ids to restrict to "
+                             "(e.g. STG001,STG002)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    parser.add_argument("--root", default=None,
+                        help="repo root for relative paths (default: the "
+                             "package's parent directory)")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(rule_catalog().items()):
+            print(f"{rule}  {desc}")
+        return 0
+
+    root = os.path.abspath(args.root) if args.root else _package_root()
+    rules = [r.strip() for r in args.rules.split(",")] if args.rules else None
+    findings = run_analysis(args.paths or None, root=root, rules=rules)
+
+    baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE_NAME)
+    if args.update_baseline:
+        entries = update_baseline(baseline_path, findings)
+        print(f"baseline written: {baseline_path} ({len(entries)} entries)")
+        todo = sum(1 for e in entries if e.justification.startswith("TODO"))
+        if todo:
+            print(f"  {todo} entries need a justification before merge")
+        return 0
+
+    entries = [] if args.no_baseline else load_baseline(baseline_path)
+    new, accepted, stale = split_findings(findings, entries)
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [vars(f) for f in new],
+            "baselined": [vars(f) for f in accepted],
+            "stale_baseline_entries": [
+                {"rule": e.rule, "file": e.file, "symbol": e.symbol}
+                for e in stale],
+        }, indent=1))
+    else:
+        for f in new:
+            print(f.render())
+        if stale:
+            print(f"-- {len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'} (fixed sites — "
+                  "remove from the baseline):")
+            for e in stale:
+                print(f"   {e.rule} {e.file} [{e.symbol}]")
+        print(f"graft-lint: {len(new)} finding"
+              f"{'' if len(new) == 1 else 's'}, {len(accepted)} baselined, "
+              f"{len(stale)} stale")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

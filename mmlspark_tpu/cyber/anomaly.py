@@ -179,7 +179,7 @@ class AccessAnomaly(Estimator):
     res_col = Param("res_col", "resource column", "string", default="res")
     likelihood_col = Param("likelihood_col", "access count column (optional)",
                            "string", default=None)
-    rank_param = Param("rank", "latent factor rank", "int", default=10)
+    rank = Param("rank", "latent factor rank", "int", default=10)
     max_iter = Param("max_iter", "ALS iterations", "int", default=10)
     reg_param = Param("reg_param", "ridge regularization", "float", default=0.1)
     implicit_cf = Param("implicit_cf", "Hu-Koren implicit CF (reference "

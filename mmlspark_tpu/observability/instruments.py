@@ -10,7 +10,8 @@ from typing import Optional
 
 from .metrics import MetricsRegistry, get_registry
 
-__all__ = ["BREAKER_STATE_CODES", "instrument_breaker"]
+__all__ = ["BREAKER_STATE_CODES", "instrument_breaker",
+           "uninstrument_breaker"]
 
 #: numeric encoding for the breaker-state gauge (alerting rules compare
 #: against these: anything > 0 means degraded)
@@ -50,4 +51,44 @@ def instrument_breaker(breaker, registry: Optional[MetricsRegistry] = None,
         transitions.inc(breaker=bname, to=new)
 
     breaker.add_listener(on_transition)
+    # remembered so uninstrument_breaker can detach it — instrument after
+    # uninstrument must not leave two listeners double-counting transitions
+    _listeners(reg)[bname] = on_transition
     return breaker
+
+
+def _listeners(reg: MetricsRegistry) -> dict:
+    """Per-registry map of breaker name -> transition listener."""
+    table = getattr(reg, "_breaker_listeners", None)
+    if table is None:
+        table = reg._breaker_listeners = {}
+    return table
+
+
+def breaker_registry_name(breaker) -> str:
+    """The name a breaker was registered under by ``instrument_breaker``
+    (when no explicit ``name=`` override was given)."""
+    return breaker.name or f"breaker-{id(breaker):x}"
+
+
+def uninstrument_breaker(breaker_or_name,
+                         registry: Optional[MetricsRegistry] = None) -> None:
+    """Reverse of ``instrument_breaker`` for a breaker that is gone for
+    good (e.g. its worker was evicted from the topology): drops the
+    ``/stats`` entry and the state/failure-rate gauge series, whose
+    callback closures would otherwise pin the breaker and scrape frozen
+    values forever.  The ``transitions_total`` counter series stays — it
+    is history and holds no object references.  No-op if never registered.
+    """
+    reg = registry or get_registry()
+    name = breaker_or_name if isinstance(breaker_or_name, str) \
+        else breaker_registry_name(breaker_or_name)
+    breaker = reg.breakers.pop(name, None)
+    listener = _listeners(reg).pop(name, None)
+    if breaker is not None and listener is not None:
+        breaker.remove_listener(listener)
+    for fam_name in ("mmlspark_breaker_state",
+                     "mmlspark_breaker_failure_rate"):
+        fam = reg.family(fam_name)  # never CREATE an empty family here
+        if fam is not None:
+            fam.remove(breaker=name)

@@ -325,6 +325,12 @@ class MetricsRegistry:
         self.breakers: Dict[str, object] = {}
 
     # ------------------------------------------------------------- families
+    def family(self, name: str) -> Optional[_Family]:
+        """An already-registered family by name, or None — lookups that
+        must not create (and thereafter scrape) an empty family."""
+        with self._lock:
+            return self._families.get(name)
+
     def _get_or_make(self, cls, name, help, labels, **kw) -> _Family:
         with self._lock:
             fam = self._families.get(name)

@@ -33,7 +33,7 @@ class ExecutorConfig:
 
 
 def free_port() -> int:
-    with socket.socket() as s:
+    with socket.socket() as s:  # graft-lint: disable=RES001 — binds an ephemeral local port; no remote I/O, nothing to breaker/deadline
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
 

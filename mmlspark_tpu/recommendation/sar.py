@@ -88,9 +88,9 @@ class SARModel(Model):
     user_col = Param("user_col", "user id column", "string", default="user")
     item_col = Param("item_col", "item id column", "string", default="item")
     rating_col = Param("rating_col", "rating column", "string", default="rating")
-    affinity_param = ComplexParam("affinity", "user x item affinity")
-    similarity_param = ComplexParam("similarity", "item x item similarity")
-    seen_param = ComplexParam("seen", "user x item seen mask")
+    affinity = ComplexParam("affinity", "user x item affinity")
+    similarity = ComplexParam("similarity", "item x item similarity")
+    seen = ComplexParam("seen", "user x item seen mask")
     user_ids = Param("user_ids", "user vocabulary", "list")
     item_ids = Param("item_ids", "item vocabulary", "list")
 

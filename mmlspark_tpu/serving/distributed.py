@@ -27,6 +27,7 @@ from typing import Callable, Dict, List, Optional
 
 from .server import PipelineServer
 from ..observability import get_registry, instrument_breaker
+from ..observability.instruments import uninstrument_breaker
 from ..observability.tracing import TRACE_HEADER, current_trace_id
 from ..utils.resilience import CircuitBreaker, Deadline, current_deadline
 
@@ -357,6 +358,17 @@ class RoutingClient:
                 self._table = sorted(table.values(),
                                      key=lambda w: w["server_id"])
                 self._fetched = now
+                # a worker id the topology no longer routes to (evicted or
+                # deregistered) takes its breaker with it: the breaker dict
+                # entry AND its state/failure-rate gauge series would
+                # otherwise grow without bound under fresh-id churn and
+                # scrape frozen values forever (ROADMAP PR 2 follow-up).
+                # A re-registered id simply gets a fresh breaker.
+                live = {w["server_id"] for w in self._table}
+                dead = [(sid, self.breakers.pop(sid))
+                        for sid in list(self.breakers) if sid not in live]
+            for _sid, breaker in dead:  # registry ops outside our lock
+                uninstrument_breaker(breaker, self.registry)
 
     def _pick(self, key: Optional[str], exclude=()) -> Dict:
         self._refresh()

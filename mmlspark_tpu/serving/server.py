@@ -18,11 +18,11 @@ throughput trick the reference gets from continuous processing);
 """
 from __future__ import annotations
 
+import itertools
 import json
 import queue
 import threading
 import time
-import uuid as uuid_mod
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional
@@ -34,6 +34,13 @@ from ..observability import get_registry
 from ..observability.tracing import (Span, TRACE_HEADER, export_span,
                                      new_trace_id, trace_span)
 from ..utils.resilience import Deadline, deadline_scope
+
+# entry ids need uniqueness within the process, not entropy: uuid4's
+# per-call os.urandom syscall (~40 us on this kernel) sat inside the
+# serialized admission path — same counter pattern as span ids in
+# observability/tracing.py.  itertools.count.__next__ is atomic under
+# the GIL, so handler threads share it without a lock.
+_ENTRY_IDS = itertools.count()
 
 
 @dataclass
@@ -118,7 +125,8 @@ class PipelineServer:
                  clock: Callable[[], float] = time.monotonic,
                  registry=None,
                  shed_queue_delay_ewma_s: Optional[float] = None,
-                 ewma_alpha: float = 0.2):
+                 ewma_alpha: float = 0.2,
+                 micro_batch_deadline_margin_s: float = 0.0):
         if mode not in ("continuous", "micro_batch"):
             raise ValueError("mode must be continuous|micro_batch")
         self.model = model
@@ -143,6 +151,10 @@ class PipelineServer:
         self.shed_queue_delay_ewma_s = shed_queue_delay_ewma_s
         self.ewma_alpha = float(ewma_alpha)
         self._queue_ewma = 0.0
+        # micro-batch early flush: never wait out the trigger interval past
+        # the point where the tightest drained entry's deadline (minus this
+        # reserved scoring margin) would expire in the batch buffer
+        self.micro_batch_deadline_margin_s = float(micro_batch_deadline_margin_s)
         # metrics: families on the (shared, injectable) registry; children
         # are labelled per server instance once the port is resolved so many
         # servers coexist in one registry/process
@@ -268,7 +280,7 @@ class PipelineServer:
                 # adopt the caller's trace id (X-MMLSpark-Trace-Id) so the
                 # worker-side spans of this request join the caller's trace
                 trace_id = self.headers.get(TRACE_HEADER) or new_trace_id()
-                entry = _Entry(uid=str(uuid_mod.uuid4()), payload=payload,
+                entry = _Entry(uid=f"e{next(_ENTRY_IDS):x}", payload=payload,
                                headers=dict(self.headers), t_enq=t_enq,
                                t_deadline=t_enq + budget_s,
                                trace_id=trace_id)
@@ -418,10 +430,24 @@ class PipelineServer:
             return []
         batch = [first]
         if self.mode == "micro_batch":
-            deadline = time.monotonic() + self.interval_ms / 1000.0
-            while len(batch) < self.max_batch and time.monotonic() < deadline:
+            flush_at = time.monotonic() + self.interval_ms / 1000.0
+            while len(batch) < self.max_batch:
+                wait_s = flush_at - time.monotonic()
+                if wait_s <= 0:
+                    break
+                # deadline-aware trigger (PR 1 follow-up): waiting out the
+                # full interval past the tightest admitted deadline would
+                # turn a scoreable request into a certain 504 — flush as
+                # soon as the most impatient entry's slack (minus the
+                # margin reserved for scoring itself) runs out.  Entry
+                # deadlines live on the injectable server clock; the
+                # trigger interval stays on the wall clock.
+                slack_s = min(e.t_deadline for e in batch) - self.clock() \
+                    - self.micro_batch_deadline_margin_s
+                if slack_s <= 0:
+                    break
                 try:
-                    batch.append(self._q.get(timeout=max(0.0, deadline - time.monotonic())))
+                    batch.append(self._q.get(timeout=min(wait_s, slack_s)))
                 except queue.Empty:
                     break
         else:  # continuous: take whatever is already waiting

@@ -17,10 +17,10 @@ Here the same three pieces exist as first-class objects:
 """
 from __future__ import annotations
 
+import itertools
 import json
 import threading
 import time
-import uuid as uuid_mod
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional
 
@@ -28,6 +28,11 @@ import numpy as np
 
 from ..core import DataFrame, Transformer
 from .server import ServingStats, _default_encode
+
+# request ids key the pending-reply map: process uniqueness suffices, and
+# uuid4's per-call entropy syscall sat on the request hot path (same
+# counter pattern as serving/server.py entry ids and tracing span ids)
+_REQUEST_IDS = itertools.count()
 
 
 class _Pending:
@@ -93,7 +98,7 @@ class HTTPStreamSource:
                 except Exception as e:  # noqa: BLE001
                     self._json(400, {"error": f"bad request: {e}"})
                     return
-                uid = str(uuid_mod.uuid4())
+                uid = f"r{next(_REQUEST_IDS):x}"
                 entry = _Pending(payload)
                 with src._lock:
                     src._pending[uid] = entry

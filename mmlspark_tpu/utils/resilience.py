@@ -257,6 +257,15 @@ class CircuitBreaker:
         lock after every state transition."""
         self._listeners.append(fn)
 
+    def remove_listener(self, fn) -> None:
+        """Detach a listener previously registered with ``add_listener``
+        (no-op if absent) — re-instrumenting a breaker must not leave the
+        old listener double-counting transitions."""
+        try:
+            self._listeners.remove(fn)
+        except ValueError:
+            pass
+
     def _transition(self, new_state: str) -> None:
         # caller holds the lock; notification drains after release
         if self._state != new_state:

@@ -583,13 +583,13 @@ class VowpalWabbitContextualBandit(_VWBase):
 
     shared_col = Param("shared_col", "shared-context sparse features column", "string",
                        default="shared_features")
-    features_col2 = Param("action_col", "per-action features column (list of sparse "
-                          "dicts per row)", "string", default="action_features")
+    action_col = Param("action_col", "per-action features column (list of sparse "
+                       "dicts per row)", "string", default="action_features")
     chosen_action_col = Param("chosen_action_col", "1-based chosen action", "string",
                               default="chosen_action")
     cost_col = Param("cost_col", "observed cost of chosen action", "string", default="cost")
-    probability_col2 = Param("probability_col", "logging policy probability", "string",
-                             default="probability")
+    probability_col = Param("probability_col", "logging policy probability", "string",
+                            default="probability")
     cb_type = Param("cb_type", "bandit estimator: ips (inverse-propensity "
                     "weights) | mtr (regress observed costs unweighted)",
                     "string", default="ips")

@@ -1,0 +1,14 @@
+"""RES true-positive fixture: raw transports outside the resilient
+clients.  Parsed by graft-lint only — never imported or executed."""
+import socket
+import urllib.request
+
+
+def fetch(url):
+    req = urllib.request.Request(url, method="GET")        # RES001
+    with urllib.request.urlopen(req, timeout=5) as resp:   # RES001
+        return resp.read()
+
+
+def probe(host, port):
+    return socket.create_connection((host, port), timeout=1)   # RES001

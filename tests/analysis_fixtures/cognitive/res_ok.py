@@ -1,0 +1,11 @@
+"""RES near-miss fixture: urllib.parse (no network) and the resilient
+client path — must produce zero findings.  Parsed by graft-lint only."""
+import urllib.parse
+
+from mmlspark_tpu.io.http import HTTPClient, HTTPRequestData
+
+
+def fetch(base_url, query, breaker):
+    url = f"{base_url}?q={urllib.parse.quote(query)}"
+    client = HTTPClient(retries=2, breaker=breaker)
+    return client.send(HTTPRequestData(url=url))

@@ -1,0 +1,25 @@
+"""LCK true-positive fixture: slow/re-entrant work under a lock.
+Parsed by graft-lint only — never imported or executed."""
+import json
+import threading
+
+
+class LeakyRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._listeners = []
+        self._events = []
+
+    def snapshot(self):
+        with self._lock:
+            return json.dumps(self._events)        # LCK001
+
+    def notify(self, old, new):
+        with self._lock:
+            for fn in self._listeners:
+                fn(self, old, new)                 # LCK002
+
+    def merge(self, other):
+        with self._lock:
+            with other._lock:                      # LCK003
+                self._events.extend(other._events)

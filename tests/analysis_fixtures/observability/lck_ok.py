@@ -1,0 +1,29 @@
+"""LCK near-miss fixture: the sanctioned copy-out pattern — state is
+snapshotted under the lock, serialization and callbacks run outside it.
+Must produce zero findings.  Parsed by graft-lint only."""
+import json
+import threading
+
+
+class DisciplinedRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._listeners = []
+        self._events = []
+
+    def snapshot(self):
+        with self._lock:
+            events = list(self._events)
+        return json.dumps(events)
+
+    def notify(self, old, new):
+        with self._lock:
+            listeners = list(self._listeners)
+        for fn in listeners:
+            fn(self, old, new)
+
+    def merge(self, other):
+        with other._lock:
+            incoming = list(other._events)
+        with self._lock:
+            self._events.extend(incoming)

@@ -1,0 +1,45 @@
+"""TRC true-positive fixture: host calls reachable from tracing entry
+points.  Parsed by graft-lint only — never imported or executed."""
+import threading
+import time
+import uuid
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_LOCK = threading.Lock()
+
+
+@jax.jit
+def locked_step(x):
+    with _LOCK:                           # TRC003
+        return x + 1
+
+
+def _noise(x):
+    # reachable from the jitted root through one call edge
+    t = time.time()                       # TRC001
+    print("noise at", t)                  # TRC002
+    return x * np.random.rand()           # TRC001
+
+
+@jax.jit
+def step(x):
+    tag = uuid.uuid4()                    # TRC001
+    return _noise(x) + float(x), tag      # TRC004: float() on a traced arg
+
+
+def _scan_body(carry, x):
+    return carry + x.item(), x            # TRC004: .item() host sync
+
+
+def run(xs):
+    return jax.lax.scan(_scan_body, 0.0, xs)
+
+
+def _shard_fn(block):
+    return jnp.sum(block) + time.perf_counter()   # TRC001
+
+
+sharded = jax.shard_map(_shard_fn, mesh=None, in_specs=None, out_specs=None)

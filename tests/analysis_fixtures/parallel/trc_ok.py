@@ -1,0 +1,28 @@
+"""TRC near-miss fixture: the same host calls, but only in UNtraced host
+code — must produce zero findings.  Parsed by graft-lint only."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def host_setup(n):
+    # host-side staging: clocks/RNG are fine outside the traced graph
+    t0 = time.time()
+    data = np.random.rand(n)
+    print("staged", n, "rows in", time.time() - t0)
+    return data
+
+
+@jax.jit
+def step(x):
+    # pure traced compute: device RNG, no host syncs
+    key = jax.random.PRNGKey(0)
+    return x * jax.random.uniform(key, x.shape) + jnp.float32(0.5)
+
+
+def evaluate(xs):
+    out = step(jnp.asarray(xs))
+    # .item() AFTER the traced call returns is host code, not traced code
+    return float(np.asarray(out).sum())

@@ -1,0 +1,11 @@
+"""HOT true-positive fixture: entropy syscalls and eager f-string logging
+inside hot-path functions.  Parsed by graft-lint only."""
+import os
+import uuid
+
+
+def handle_request(payload, logger):
+    rid = str(uuid.uuid4())                  # HOT001
+    salt = os.urandom(4)                     # HOT001
+    logger.debug(f"scored request {rid}")    # HOT002
+    return rid, salt, payload

@@ -1,0 +1,15 @@
+"""HOT near-miss fixture: the sanctioned amortization pattern — one
+module-level entropy read, counter ids per call, lazy log formatting.
+Must produce zero findings.  Parsed by graft-lint only."""
+import itertools
+import os
+
+# module scope IS the amortization pattern: one syscall per process
+_ID_PREFIX = os.urandom(8).hex()
+_ID_COUNTER = itertools.count()
+
+
+def handle_request(payload, logger):
+    rid = f"{_ID_PREFIX}{next(_ID_COUNTER):x}"
+    logger.debug("scored request %s", rid)
+    return rid, payload

@@ -1,0 +1,12 @@
+"""STG near-miss fixture: a contract-clean stage — attribute names match
+declared params, manual accessors are backed by params, module sits in a
+registered subpackage.  Must produce zero findings."""
+from mmlspark_tpu.core import Param, Transformer
+
+
+class GoodTransformer(Transformer):
+    input_col = Param("input_col", "input column", "string", default="input")
+    scale = Param("scale", "multiplier applied per row", "float", default=1.0)
+
+    def set_scale(self, value):      # fine: 'scale' is a declared param
+        return self.set("scale", value)

@@ -1,0 +1,13 @@
+"""STG true-positive fixture: a stage with param-name drift, a manual
+accessor without a param, living in a module the codegen registry cannot
+discover.  Parsed by graft-lint only (checker configured with
+``package="stgpkg"``, ``subpackages=("registered",)``)."""
+from mmlspark_tpu.core import Param, Transformer
+
+
+class RogueTransformer(Transformer):          # STG002: 'rogue' not registered
+    in_col = Param("input_col", "input column", "string")   # STG001 drift
+
+    def set_threshold(self, value):           # STG003: no 'threshold' param
+        self._threshold = value
+        return self

@@ -146,6 +146,33 @@ def test_pipeline_server_micro_batch_parallel():
         server.stop()
 
 
+def test_pipeline_server_micro_batch_deadline_flush():
+    """Deadline-aware micro-batch trigger (ROADMAP PR 1 follow-up): an
+    entry whose budget would expire before the trigger interval elapses
+    flushes the batch early and gets scored, instead of aging into a
+    certain 504 while the server idles out its interval."""
+    import time as _time
+    from mmlspark_tpu.serving import PipelineServer
+    # the interval alone would sit on the request for 10 s — far past the
+    # 2 s budget; the margin makes the flush land at ~1 s, budget intact
+    server = PipelineServer(AddReply(), port=0, mode="micro_batch",
+                            micro_batch_interval_ms=10_000,
+                            micro_batch_deadline_margin_s=1.0).start()
+    try:
+        t0 = _time.monotonic()
+        req = urllib.request.Request(
+            server.address, data=json.dumps({"value": 21}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-MMLSpark-Deadline-Ms": "2000"}, method="POST")
+        with urllib.request.urlopen(req, timeout=8) as r:
+            assert json.loads(r.read().decode()) == {"double": 42}
+        elapsed = _time.monotonic() - t0
+        assert elapsed < 5.0, \
+            f"flush waited {elapsed:.1f}s — deadline trigger did not fire"
+    finally:
+        server.stop()
+
+
 def test_text_sentiment_against_mock(mock_service):
     from mmlspark_tpu.cognitive import TextSentiment
     df = DataFrame.from_dict({"text": np.array(["great product", "terrible"], dtype=object)})

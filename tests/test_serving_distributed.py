@@ -68,6 +68,46 @@ def test_routing_client_fails_over_dead_worker():
         svc.stop()
 
 
+def test_routing_client_prunes_breakers_for_departed_workers():
+    """A worker id gone from the routing table (evicted or deregistered)
+    takes its per-worker breaker AND its gauge series with it — the
+    ROADMAP PR 2 follow-up: unbounded fresh-id churn must not grow the
+    breaker dict or leave frozen breaker_state series in the registry."""
+    from mmlspark_tpu.observability import MetricsRegistry
+
+    reg = MetricsRegistry()
+    svc = TopologyService(registry=reg).start()
+    workers = [WorkerServer(Doubler(), server_id=f"w{i}",
+                            driver_address=svc.address, port=0).start()
+               for i in range(2)]
+    try:
+        client = RoutingClient(svc.address, registry=reg, refresh_s=0.0)
+        for i in range(4):  # round robin: both breakers get created
+            assert client.request(i) == 2 * i
+        assert set(client.breakers) == {"w0", "w1"}
+        assert {"worker:w0", "worker:w1"} <= set(reg.breakers)
+
+        workers[1].stop()  # deregisters w1: gone from the table for good
+        assert client.request(5) == 10  # refresh sees the shrunken table
+        assert set(client.breakers) == {"w0"}
+        assert "worker:w1" not in reg.breakers and "worker:w0" in reg.breakers
+        state_series = [s["labels"]["breaker"] for s in
+                        reg.to_dict()["mmlspark_breaker_state"]["samples"]]
+        assert state_series == ["worker:w0"], \
+            "evicted worker's gauge series must be removed"
+
+        # a re-registered id simply gets a fresh breaker
+        workers[1] = WorkerServer(Doubler(), server_id="w1",
+                                  driver_address=svc.address, port=0).start()
+        for i in range(4):
+            assert client.request(i) == 2 * i
+        assert set(client.breakers) == {"w0", "w1"}
+    finally:
+        for w in workers:
+            w.stop()
+        svc.stop()
+
+
 def test_streaming_source_sink_round_trip():
     query = (read_stream()
              .server(port=0, api_path="/score")

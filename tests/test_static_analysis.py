@@ -1,0 +1,211 @@
+"""graft-lint (mmlspark_tpu/analysis) — fixture pairs per checker, pragma +
+baseline workflows, CLI exit codes, and the tier-1 repo gate.
+
+Reference framing: FuzzingTest.scala:18 enforces stage coverage by
+reflection; graft-lint is the source-level analogue for the invariants the
+PR 1/PR 2 review rounds enforced by hand (deadline clipping, lock
+discipline, hot-path hygiene, tracer safety, stage contracts).
+"""
+import json
+import os
+
+import pytest
+
+from mmlspark_tpu.analysis import (AnalysisEngine, BaselineEntry, Finding,
+                                   HotPathChecker, LockDisciplineChecker,
+                                   ResilienceCoverageChecker,
+                                   StageContractChecker, TracerSafetyChecker,
+                                   load_baseline, main, rule_catalog,
+                                   run_analysis, save_baseline,
+                                   split_findings, update_baseline)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "analysis_fixtures")
+BASELINE = os.path.join(REPO, "analysis-baseline.toml")
+
+
+def _scan(checker, *relpaths, root=FIXTURES):
+    engine = AnalysisEngine([checker], root=root)
+    return engine.run([os.path.join(root, rp) for rp in relpaths])
+
+
+# ---------------------------------------------------------------------------
+# per-checker fixture pairs: one file must trip, its near-miss must not
+# ---------------------------------------------------------------------------
+
+PAIRS = [
+    (TracerSafetyChecker, "parallel/trc_bad.py", "parallel/trc_ok.py",
+     {"TRC001", "TRC002", "TRC003", "TRC004"}),
+    (ResilienceCoverageChecker, "cognitive/res_bad.py",
+     "cognitive/res_ok.py", {"RES001"}),
+    (LockDisciplineChecker, "observability/lck_bad.py",
+     "observability/lck_ok.py", {"LCK001", "LCK002", "LCK003"}),
+    (HotPathChecker, "serving/hot_bad.py", "serving/hot_ok.py",
+     {"HOT001", "HOT002"}),
+]
+
+
+@pytest.mark.parametrize("checker_cls,bad,ok,expected_rules", PAIRS,
+                         ids=[p[1].split("/")[-1][:3] for p in PAIRS])
+def test_fixture_pair(checker_cls, bad, ok, expected_rules):
+    tripped = _scan(checker_cls(), bad)
+    assert {f.rule for f in tripped} == expected_rules, \
+        [f.render() for f in tripped]
+    clean = _scan(checker_cls(), ok)
+    assert clean == [], [f.render() for f in clean]
+
+
+def test_trc_reaches_through_call_edges_and_module_level_roots():
+    findings = _scan(TracerSafetyChecker(), "parallel/trc_bad.py")
+    symbols = {f.symbol for f in findings}
+    # _noise is only reachable THROUGH the jitted root's call edge
+    assert "_noise" in symbols
+    # _shard_fn is rooted by a module-level shard_map(...) call site
+    assert "_shard_fn" in symbols
+    # _scan_body is rooted by being passed to lax.scan inside run()
+    assert "_scan_body" in symbols
+
+
+def test_stage_contract_fixtures():
+    checker = StageContractChecker(subpackages=("registered",),
+                                   package="stgpkg")
+    findings = _scan(checker, "stgpkg/rogue/stg_bad.py",
+                     "stgpkg/registered/stg_ok.py")
+    rules = {f.rule for f in findings}
+    assert rules == {"STG001", "STG002", "STG003"}, \
+        [f.render() for f in findings]
+    assert all("stg_bad.py" in f.file for f in findings), \
+        "the clean stage must not trip anything"
+
+
+# ---------------------------------------------------------------------------
+# suppression: inline pragmas and the baseline file
+# ---------------------------------------------------------------------------
+
+def test_inline_pragma_suppresses_one_line(tmp_path):
+    src = tmp_path / "serving" / "pragma_case.py"
+    src.parent.mkdir()
+    src.write_text(
+        "import uuid\n\n\n"
+        "def hot(payload):\n"
+        "    a = uuid.uuid4()  # graft-lint: disable=HOT001 — load-bearing\n"
+        "    b = uuid.uuid4()\n"
+        "    return a, b, payload\n")
+    findings = _scan(HotPathChecker(), "serving/pragma_case.py",
+                     root=str(tmp_path))
+    assert [f.line for f in findings] == [6], [f.render() for f in findings]
+
+
+def test_file_pragma_suppresses_whole_file(tmp_path):
+    src = tmp_path / "serving" / "filewide.py"
+    src.parent.mkdir()
+    src.write_text(
+        "# graft-lint: disable-file=HOT001\n"
+        "import uuid\n\n\n"
+        "def hot():\n"
+        "    return uuid.uuid4()\n")
+    assert _scan(HotPathChecker(), "serving/filewide.py",
+                 root=str(tmp_path)) == []
+
+
+def test_baseline_round_trip_and_split(tmp_path):
+    path = str(tmp_path / "base.toml")
+    entries = [BaselineEntry("HOT001", "a/b.py", 'Cls.meth"x"', 7,
+                             'quotes "and" backslash \\ survive'),
+               BaselineEntry("RES001", "c.py", "fetch", 3, "local socket")]
+    save_baseline(path, entries)
+    loaded = load_baseline(path)
+    assert {(e.rule, e.file, e.symbol, e.line, e.justification)
+            for e in loaded} == \
+        {(e.rule, e.file, e.symbol, e.line, e.justification)
+         for e in entries}
+
+    hit = Finding("HOT001", "a/b.py", 99, "msg", symbol='Cls.meth"x"')
+    miss = Finding("HOT001", "a/b.py", 99, "msg", symbol="other")
+    new, accepted, stale = split_findings([hit, miss], loaded)
+    assert [f.symbol for f in new] == ["other"]
+    assert [f.symbol for f in accepted] == ['Cls.meth"x"']
+    assert [e.rule for e in stale] == ["RES001"]  # fixed site surfaces
+
+
+def test_baseline_ratchets_within_a_symbol(tmp_path):
+    """An entry covers `count` findings (default 1): a SECOND same-rule
+    violation inside an already-baselined function is NEW — the baseline
+    cannot become a blanket waiver for a whole symbol."""
+    path = str(tmp_path / "base.toml")
+    save_baseline(path, [BaselineEntry("RES001", "m.py", "fetch", 3,
+                                       "reviewed: local socket")])
+    one = Finding("RES001", "m.py", 3, "msg", symbol="fetch")
+    two = Finding("RES001", "m.py", 9, "msg", symbol="fetch")
+    new, accepted, stale = split_findings([one, two], load_baseline(path))
+    assert len(accepted) == 1 and len(new) == 1 and not stale
+    # widening is explicit: count = 2 in the file accepts both
+    save_baseline(path, [BaselineEntry("RES001", "m.py", "fetch", 3,
+                                       "two reviewed sites", count=2)])
+    new, accepted, _ = split_findings([one, two], load_baseline(path))
+    assert len(accepted) == 2 and not new
+
+
+def test_update_baseline_preserves_justifications(tmp_path):
+    path = str(tmp_path / "base.toml")
+    f1 = Finding("HOT001", "a.py", 5, "m", symbol="f")
+    f2 = Finding("RES001", "b.py", 9, "m", symbol="g")
+    update_baseline(path, [f1])
+    entries = load_baseline(path)
+    assert entries[0].justification.startswith("TODO")
+    entries[0].justification = "deliberate: reviewed in PR 3"
+    save_baseline(path, entries)
+    # a second update keeps the human justification, adds the TODO stub,
+    # and drops nothing that still fires
+    update_baseline(path, [f1, f2])
+    by_rule = {e.rule: e for e in load_baseline(path)}
+    assert by_rule["HOT001"].justification == "deliberate: reviewed in PR 3"
+    assert by_rule["RES001"].justification.startswith("TODO")
+    # a fixed finding falls out on the next update
+    update_baseline(path, [f2])
+    assert [e.rule for e in load_baseline(path)] == ["RES001"]
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate: the shipped package scans clean against the baseline
+# ---------------------------------------------------------------------------
+
+def test_repo_package_scans_clean_against_baseline():
+    findings = run_analysis()
+    entries = load_baseline(BASELINE)
+    new, accepted, stale = split_findings(findings, entries)
+    assert not new, "unbaselined findings:\n" + \
+        "\n".join(f.render() for f in new)
+    assert not stale, "baseline entries for fixed sites — remove them: " + \
+        str([(e.rule, e.file, e.symbol) for e in stale])
+    for e in entries:
+        assert e.justification and not e.justification.startswith("TODO"), \
+            f"baseline entry {e.key()} lacks a real justification"
+
+
+def test_cli_exit_codes_and_json():
+    # clean package -> 0
+    assert main([]) == 0
+    # adding any fixture violation file to the scan -> nonzero
+    bad = os.path.join(FIXTURES, "serving", "hot_bad.py")
+    assert main([os.path.join(REPO, "mmlspark_tpu"), bad]) == 1
+    assert main(["--list-rules"]) == 0
+    # json mode stays parseable with findings present (capsys-free: just
+    # verify the call is rc=1; format correctness is covered above)
+    assert main([bad, "--format", "json"]) == 1
+
+
+def test_cli_json_output_shape(capsys):
+    bad = os.path.join(FIXTURES, "serving", "hot_bad.py")
+    main([bad, "--format", "json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert {f["rule"] for f in payload["findings"]} == {"HOT001", "HOT002"}
+    assert payload["baselined"] == []
+
+
+def test_rule_catalog_documented():
+    """Every shipped rule id appears in docs/STATIC_ANALYSIS.md — the
+    catalog cannot silently drift from the implementation."""
+    doc = open(os.path.join(REPO, "docs", "STATIC_ANALYSIS.md")).read()
+    for rule in rule_catalog():
+        assert rule in doc, f"rule {rule} missing from docs/STATIC_ANALYSIS.md"

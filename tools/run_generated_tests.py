@@ -14,6 +14,21 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main(out_dir: str = "generated/tests") -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # fail fast on stale stage contracts: a stage with param-name drift or
+    # outside the registry's SUBPACKAGES would generate wrong (or no)
+    # binding tests, so the STG sweep gates generation itself
+    from mmlspark_tpu.analysis import (StageContractChecker, load_baseline,
+                                       run_analysis, split_findings)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings = run_analysis(checkers=[StageContractChecker()])
+    baseline = load_baseline(os.path.join(repo, "analysis-baseline.toml"))
+    new, _, _ = split_findings(findings, baseline)
+    if new:
+        print("stage-contract (STG) violations — fix or baseline before "
+              "generating binding tests:")
+        for f in new:
+            print(f"  {f.render()}")
+        return 1
     from mmlspark_tpu.codegen import generate_tests
     paths = generate_tests(out_dir)
     print(f"generated {len(paths)} per-stage test files in {out_dir}")
