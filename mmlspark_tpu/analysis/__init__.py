@@ -16,7 +16,8 @@ workflow, and how to add a checker.
 from .baseline import (BaselineEntry, load_baseline, save_baseline,
                        split_findings, update_baseline)
 from .checkers import (HotPathChecker, LockDisciplineChecker,
-                       ResilienceCoverageChecker, TracerSafetyChecker)
+                       ResilienceCoverageChecker, TracerSafetyChecker,
+                       UndeadlinedRetryChecker)
 from .cli import default_checkers, main, rule_catalog, run_analysis
 from .engine import AnalysisEngine, Checker, Finding, iter_python_files
 from .stagecheck import StageContractChecker
@@ -24,7 +25,8 @@ from .stagecheck import StageContractChecker
 __all__ = [
     "AnalysisEngine", "BaselineEntry", "Checker", "Finding",
     "HotPathChecker", "LockDisciplineChecker", "ResilienceCoverageChecker",
-    "StageContractChecker", "TracerSafetyChecker", "default_checkers",
-    "iter_python_files", "load_baseline", "main", "rule_catalog",
-    "run_analysis", "save_baseline", "split_findings", "update_baseline",
+    "StageContractChecker", "TracerSafetyChecker", "UndeadlinedRetryChecker",
+    "default_checkers", "iter_python_files", "load_baseline", "main",
+    "rule_catalog", "run_analysis", "save_baseline", "split_findings",
+    "update_baseline",
 ]

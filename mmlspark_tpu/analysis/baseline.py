@@ -146,10 +146,17 @@ def split_findings(findings: Iterable[Finding],
     return new, accepted, stale
 
 
-def update_baseline(path: str, findings: Iterable[Finding]) -> List[BaselineEntry]:
+def update_baseline(path: str, findings: Iterable[Finding],
+                    preserved: Sequence[BaselineEntry] = ()
+                    ) -> List[BaselineEntry]:
     """Merge current findings into the baseline: existing justifications are
     preserved, new findings get a TODO placeholder (CI policy: a reviewer
-    replaces it before merge), entries that no longer fire are dropped."""
+    replaces it before merge), entries that no longer fire are dropped.
+
+    ``preserved`` entries are written back verbatim regardless of the
+    findings — a rule-restricted scan (``--rules STG --update-baseline``)
+    passes its out-of-scope entries here, so restricting the scan can
+    never silently delete another family's justified suppressions."""
     existing = {e.key(): e for e in load_baseline(path)}
     merged: Dict[Tuple[str, str, str], BaselineEntry] = {}
     for f in findings:
@@ -166,6 +173,8 @@ def update_baseline(path: str, findings: Iterable[Finding]) -> List[BaselineEntr
         else:
             merged[f.key()] = BaselineEntry.for_finding(
                 f, "TODO: justify or fix")
+    for e in preserved:
+        merged.setdefault(e.key(), e)
     entries = list(merged.values())
     save_baseline(path, entries)
     return entries

@@ -14,7 +14,8 @@ from typing import Dict, List, Optional, Set, Tuple
 from .engine import Checker, Finding, ModuleContext, with_lock_items
 
 __all__ = ["TracerSafetyChecker", "ResilienceCoverageChecker",
-           "LockDisciplineChecker", "HotPathChecker"]
+           "UndeadlinedRetryChecker", "LockDisciplineChecker",
+           "HotPathChecker"]
 
 
 # ---------------------------------------------------------------------------
@@ -262,6 +263,97 @@ class ResilienceCoverageChecker(Checker):
         hit = _dotted_prefix_hit(dotted, _RES_BANNED)
         if hit is not None:
             ctx.report("RES001", node, f"{dotted}() — {hit[1]}")
+
+
+#: retry helpers whose backoff loops are unbounded without a budget
+_RETRY_HELPERS = {"with_retries", "retry_with_timeout"}
+
+#: with-items that install an ambient Deadline for their block
+_DEADLINE_SCOPES = {"deadline_scope"}
+
+
+class UndeadlinedRetryChecker(Checker):
+    """RES002 — a ``with_retries``/``retry_with_timeout`` call site with no
+    deadline in scope retries on its own configured schedule, unbounded by
+    any caller budget (PR 1's contract: budgets clip every retry loop).
+    Statically visible evidence of a budget, any one of which passes:
+
+    - an explicit ``deadline=`` argument;
+    - the call sits lexically inside ``with deadline_scope(...)`` or
+      ``with trace_span(..., deadline_s=...)``;
+    - the enclosing function declares a ``deadline`` parameter (it is the
+      documented convention for threading an explicit budget through).
+
+    A site whose budget is installed by a *caller* (runtime-ambient, not
+    lexically visible) is a known false positive — pragma it with the
+    reason, or baseline it, exactly like RES001 local-socket sites.
+    """
+
+    rules = {"RES002": "with_retries/retry_with_timeout call site with no "
+                       "ambient Deadline/deadline_scope in scope"}
+
+    #: the primitives' own modules (definitions + facade) are exempt
+    EXCLUDED = ("utils/resilience.py", "utils/fault.py", "testing/")
+
+    def interested(self, relpath: str) -> bool:
+        norm = f"/{relpath}"
+        return not any(f"/mmlspark_tpu/{e}" in norm for e in self.EXCLUDED)
+
+    # The engine walk has no scope-exit hook, so ambient-deadline depth is
+    # tracked by a private recursive pass over the module tree instead.
+    def end_module(self, ctx: ModuleContext) -> None:
+        self._walk(ctx.tree, ctx, depth=0, fn_stack=[])
+
+    def _installs_deadline(self, node: ast.With, ctx: ModuleContext) -> bool:
+        for item in node.items:
+            expr = item.context_expr
+            if not isinstance(expr, ast.Call):
+                continue
+            dotted = ctx.dotted_name(expr.func) or ""
+            leaf = dotted.rsplit(".", 1)[-1]
+            if leaf in _DEADLINE_SCOPES:
+                return True
+            if leaf == "trace_span" and any(kw.arg == "deadline_s"
+                                            for kw in expr.keywords):
+                return True
+        return False
+
+    def _walk(self, node: ast.AST, ctx: ModuleContext, depth: int,
+              fn_stack: List[ast.AST]) -> None:
+        if isinstance(node, ast.With) and self._installs_deadline(node, ctx):
+            depth += 1
+        is_fn = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda))
+        if is_fn:
+            fn_stack = fn_stack + [node]
+            # a def/lambda under a deadline_scope block runs LATER, when
+            # the scope is gone — the lexical With above it is no budget
+            # for the body, so the depth resets at the function boundary
+            depth = 0
+        if isinstance(node, ast.Call):
+            dotted = ctx.dotted_name(node.func) or ""
+            if dotted.rsplit(".", 1)[-1] in _RETRY_HELPERS and depth == 0 \
+                    and not any(kw.arg == "deadline" for kw in node.keywords) \
+                    and not self._fn_threads_deadline(fn_stack):
+                ctx._findings.append(Finding(
+                    rule="RES002", file=ctx.relpath, line=node.lineno,
+                    message=f"{dotted.rsplit('.', 1)[-1]}() without an "
+                            "ambient deadline — retries/backoff are "
+                            "unbounded by any caller budget (wrap in "
+                            "deadline_scope or pass deadline=)",
+                    symbol=".".join(getattr(f, "name", "<lambda>")
+                                    for f in fn_stack)))
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, ctx, depth, fn_stack)
+
+    @staticmethod
+    def _fn_threads_deadline(fn_stack: List[ast.AST]) -> bool:
+        for fn in reversed(fn_stack):
+            args = fn.args
+            if any(a.arg == "deadline" for a in
+                   args.posonlyargs + args.args + args.kwonlyargs):
+                return True
+        return False
 
 
 # ---------------------------------------------------------------------------

@@ -20,7 +20,8 @@ from typing import List, Optional, Sequence
 from .baseline import (DEFAULT_BASELINE_NAME, load_baseline, split_findings,
                        update_baseline)
 from .checkers import (HotPathChecker, LockDisciplineChecker,
-                       ResilienceCoverageChecker, TracerSafetyChecker)
+                       ResilienceCoverageChecker, TracerSafetyChecker,
+                       UndeadlinedRetryChecker)
 from .engine import AnalysisEngine, Checker, Finding, iter_python_files
 from .stagecheck import StageContractChecker
 
@@ -29,8 +30,8 @@ __all__ = ["default_checkers", "run_analysis", "main", "rule_catalog"]
 
 def default_checkers() -> List[Checker]:
     return [TracerSafetyChecker(), ResilienceCoverageChecker(),
-            LockDisciplineChecker(), HotPathChecker(),
-            StageContractChecker()]
+            UndeadlinedRetryChecker(), LockDisciplineChecker(),
+            HotPathChecker(), StageContractChecker()]
 
 
 def rule_catalog() -> dict:
@@ -63,8 +64,12 @@ def run_analysis(paths: Optional[Sequence[str]] = None,
     engine = AnalysisEngine(checkers or default_checkers(), root=root)
     findings = engine.run(files)
     if rules:
-        wanted = set(rules)
-        findings = [f for f in findings if f.rule in wanted]
+        # exact ids or family prefixes: "STG" matches STG001..STG003 (the
+        # pre-commit hook restricts by family without hardcoding every id);
+        # empty strings would prefix-match everything, so they are dropped
+        wanted = tuple(r for r in rules if r)
+        if wanted:
+            findings = [f for f in findings if f.rule.startswith(wanted)]
     return findings
 
 
@@ -88,8 +93,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         help="rewrite the baseline from current findings "
                              "(existing justifications are preserved)")
     parser.add_argument("--rules", default=None,
-                        help="comma-separated rule ids to restrict to "
-                             "(e.g. STG001,STG002)")
+                        help="comma-separated rule ids or family prefixes "
+                             "to restrict to (e.g. STG001,STG002 or "
+                             "TRC,RES,LCK,HOT)")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalog and exit")
     parser.add_argument("--root", default=None,
@@ -103,12 +109,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 0
 
     root = os.path.abspath(args.root) if args.root else _package_root()
-    rules = [r.strip() for r in args.rules.split(",")] if args.rules else None
+    # drop empty segments: a stray trailing comma would otherwise become a
+    # ""-prefix that matches EVERY rule, silently un-restricting the scan
+    rules = [r.strip() for r in args.rules.split(",") if r.strip()] \
+        if args.rules else None
     findings = run_analysis(args.paths or None, root=root, rules=rules)
 
     baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE_NAME)
     if args.update_baseline:
-        entries = update_baseline(baseline_path, findings)
+        # a rule-restricted rewrite must not drop other families' entries:
+        # findings were filtered, so out-of-scope entries would all look
+        # "no longer firing" to the merge and be deleted with their
+        # human-written justifications
+        preserved = [e for e in load_baseline(baseline_path)
+                     if not e.rule.startswith(tuple(rules))] if rules else []
+        entries = update_baseline(baseline_path, findings, preserved)
         print(f"baseline written: {baseline_path} ({len(entries)} entries)")
         todo = sum(1 for e in entries if e.justification.startswith("TODO"))
         if todo:
@@ -116,6 +131,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 0
 
     entries = [] if args.no_baseline else load_baseline(baseline_path)
+    if rules:
+        # a restricted scan must not report out-of-scope entries as stale
+        entries = [e for e in entries if e.rule.startswith(tuple(rules))]
     new, accepted, stale = split_findings(findings, entries)
 
     if args.format == "json":

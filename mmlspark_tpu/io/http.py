@@ -204,6 +204,17 @@ class HTTPClient:
                     return last_err
         return last_err
 
+    def send_json(self, url: str, payload: Any,
+                  headers: Optional[Dict[str, str]] = None,
+                  deadline: Optional[Deadline] = None,
+                  trace_id: Optional[str] = None) -> HTTPResponseData:
+        """POST ``payload`` as JSON through the full resilient path
+        (breaker, deadline clipping, retries).  The one-call shape internal
+        clients want — the observability span exporter POSTs OTLP batches
+        through here so graft-lint RES coverage holds by construction."""
+        return self.send(HTTPRequestData.post_json(url, payload, headers),
+                         deadline=deadline, trace_id=trace_id)
+
 
 class AsyncHTTPClient(HTTPClient):
     """Bounded-concurrency async client (reference AsyncClient, Clients.scala:48).

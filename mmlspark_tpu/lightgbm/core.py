@@ -1224,8 +1224,10 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
     _phase_totals: Dict[str, float] = {}
 
     def _observe_phase(phase: str, seconds: float, times: int = 1) -> None:
+        # exemplar: every phase bucket keeps the training trace id, so a
+        # slow-iteration outlier on /metrics resolves to this fit's trace
         for _ in range(times):
-            _phase_h.observe(seconds, phase=phase)
+            _phase_h.observe(seconds, _train_span.trace_id, phase=phase)
         _phase_totals[phase] = _phase_totals.get(phase, 0.0) + seconds * times
 
     _parent_span = current_span()

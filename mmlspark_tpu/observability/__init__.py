@@ -10,7 +10,13 @@ event ring, ``utils/stopwatch.py``, the hand-rolled serving counters):
   ``X-MMLSpark-Trace-Id`` through io/http clients -> RoutingClient ->
   PipelineServer; finished spans feed the registry and the logging ring;
 - ``instruments`` — adapters (CircuitBreaker -> state gauge / failure-rate
-  gauge / transition counter + ``/stats`` exposure).
+  gauge / transition counter + ``/stats`` exposure; SpanCollector ->
+  export/drop counters + flush-latency histogram + queue-depth gauge);
+- ``collector``   — bounded drop-counting span ring behind
+  ``GET /trace/<id>`` / ``GET /debug/slow``, with an optional OTLP-shaped
+  exporter (file sink or ``MMLSPARK_TPU_OTLP_ENDPOINT`` POST through the
+  breaker-guarded io/http client).  Histograms carry exemplars linking
+  bucket outliers to trace ids.
 
 Hot paths instrumented: ``serving/server.py`` (GET /metrics, queue gauges,
 queue-vs-score phase histograms, EWMA shed signal), ``serving/
@@ -22,10 +28,13 @@ from .metrics import (Counter, DEFAULT_LATENCY_BUCKETS, Gauge, Histogram,
                       MetricsRegistry, get_registry, set_registry)
 from .tracing import (Span, TRACE_HEADER, current_span, current_trace_id,
                       new_trace_id, trace_span)
-from .instruments import BREAKER_STATE_CODES, instrument_breaker
+from .instruments import (BREAKER_STATE_CODES, instrument_breaker,
+                          instrument_collector)
+from .collector import OTLP_ENDPOINT_ENV, SpanCollector, get_collector
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "DEFAULT_LATENCY_BUCKETS", "get_registry", "set_registry",
            "Span", "TRACE_HEADER", "current_span", "current_trace_id",
            "new_trace_id", "trace_span", "BREAKER_STATE_CODES",
-           "instrument_breaker"]
+           "instrument_breaker", "instrument_collector",
+           "OTLP_ENDPOINT_ENV", "SpanCollector", "get_collector"]

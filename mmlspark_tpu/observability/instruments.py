@@ -11,7 +11,7 @@ from typing import Optional
 from .metrics import MetricsRegistry, get_registry
 
 __all__ = ["BREAKER_STATE_CODES", "instrument_breaker",
-           "uninstrument_breaker"]
+           "uninstrument_breaker", "instrument_collector"]
 
 #: numeric encoding for the breaker-state gauge (alerting rules compare
 #: against these: anything > 0 means degraded)
@@ -55,6 +55,54 @@ def instrument_breaker(breaker, registry: Optional[MetricsRegistry] = None,
     # uninstrument must not leave two listeners double-counting transitions
     _listeners(reg)[bname] = on_transition
     return breaker
+
+
+def instrument_collector(collector, registry: Optional[MetricsRegistry] = None
+                         ) -> dict:
+    """Wire a ``SpanCollector``'s public surface into a registry — the
+    collector watches the pipeline; these series watch the collector:
+
+    - ``mmlspark_span_ring_dropped_total`` — ring overflow (oldest span
+      evicted before any ``/trace`` query could see it);
+    - ``mmlspark_otlp_export_spans_total{result}`` — exported (``ok``),
+      in failed batches (``fail``), or dropped on export-queue overflow
+      (``dropped``);
+    - ``mmlspark_otlp_export_batches_total{result}`` — flush outcomes;
+    - ``mmlspark_otlp_flush_seconds`` — per-flush latency (serialize +
+      sink write, breaker short-circuits included);
+    - ``mmlspark_otlp_export_queue_depth`` — callback gauge, sampled at
+      scrape time.
+
+    Returns the bound children keyed by the names the collector's hot and
+    flush paths use (children resolved once, never per call).  The
+    collector's breaker (HTTP sinks) additionally goes through
+    ``instrument_breaker`` so a dead endpoint shows up as an open circuit
+    on ``/metrics`` and ``/stats``.
+    """
+    reg = registry or get_registry()
+    spans = reg.counter("mmlspark_otlp_export_spans_total",
+                        "spans by export outcome", labels=("result",))
+    batches = reg.counter("mmlspark_otlp_export_batches_total",
+                          "export flushes by outcome", labels=("result",))
+    children = {
+        "ring_dropped": reg.counter(
+            "mmlspark_span_ring_dropped_total",
+            "spans evicted from the collector ring on overflow").labels(),
+        "spans_ok": spans.labels(result="ok"),
+        "spans_fail": spans.labels(result="fail"),
+        "spans_dropped": spans.labels(result="dropped"),
+        "batches_ok": batches.labels(result="ok"),
+        "batches_fail": batches.labels(result="fail"),
+        "flush_seconds": reg.histogram(
+            "mmlspark_otlp_flush_seconds",
+            "span export flush latency").labels(),
+    }
+    reg.gauge("mmlspark_otlp_export_queue_depth",
+              "spans buffered for export").set_function(
+        lambda c=collector: c.queue_depth())
+    if getattr(collector, "breaker", None) is not None:
+        instrument_breaker(collector.breaker, reg)
+    return children
 
 
 def _listeners(reg: MetricsRegistry) -> dict:

@@ -22,6 +22,13 @@ Design points:
   deterministic suites drive time with ``utils.resilience.FakeClock``.
 - **Callback gauges** (``set_function``) read live values at scrape time —
   queue depths and breaker states are sampled, never pushed.
+- **Exemplars.**  ``observe(value, trace_id=...)`` retains a tiny
+  per-bucket reservoir of ``(value, trace_id, ts)`` samples — last write
+  per bucket plus one slot biased to the maximum observation — so a
+  histogram outlier links straight to the trace that caused it
+  (OpenMetrics exemplar syntax on the text exposition, ``exemplars`` on
+  the JSON one).  Cost when no trace id is supplied: one ``is None``
+  check.
 - Thread-safe: one lock per family; children are plain slots updated under
   it.  The hot path (child inc/observe) is a dict hit + float add.
 """
@@ -218,19 +225,30 @@ class Gauge(_Family):
 
 
 class _HistogramChild:
-    __slots__ = ("_uppers", "_counts", "_overflow", "_sum", "_count", "_lock")
+    __slots__ = ("_uppers", "_counts", "_overflow", "_sum", "_count", "_lock",
+                 "_clock", "_exemplars", "_max_exemplar")
 
-    def __init__(self, uppers: Tuple[float, ...]):
+    def __init__(self, uppers: Tuple[float, ...],
+                 clock: Callable[[], float] = time.monotonic):
         self._uppers = uppers
         self._counts = [0] * len(uppers)       # per-bucket, not cumulative
         self._overflow = 0                      # > last finite bound (+Inf)
         self._sum = 0.0
         self._count = 0
         self._lock = threading.Lock()
+        self._clock = clock
+        # exemplar reservoir: lazily allocated on the first traced
+        # observation — one (value, trace_id, ts) slot per bucket (index
+        # len(uppers) is the +Inf overflow bucket, last write wins) plus a
+        # biased-to-max slot so THE outlier survives any write pattern
+        self._exemplars: Optional[List[Optional[Tuple[float, str, float]]]] = None
+        self._max_exemplar: Optional[Tuple[float, str, float]] = None
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, trace_id: Optional[str] = None) -> None:
         v = float(value)
         i = bisect.bisect_left(self._uppers, v)
+        # clock read + tuple build stay OUTSIDE the lock (LCK discipline)
+        ex = None if trace_id is None else (v, str(trace_id), self._clock())
         with self._lock:
             self._sum += v
             self._count += 1
@@ -238,6 +256,13 @@ class _HistogramChild:
                 self._counts[i] += 1
             else:
                 self._overflow += 1
+            if ex is not None:
+                slots = self._exemplars
+                if slots is None:
+                    slots = self._exemplars = [None] * (len(self._uppers) + 1)
+                slots[min(i, len(self._uppers))] = ex
+                if self._max_exemplar is None or v >= self._max_exemplar[0]:
+                    self._max_exemplar = ex
 
     @property
     def sum(self) -> float:
@@ -258,6 +283,26 @@ class _HistogramChild:
                 out.append((ub, cum))
             out.append((math.inf, cum + self._overflow))
             return out
+
+    def exemplars(self) -> Optional[Dict[float, Tuple[float, str, float]]]:
+        """{bucket_upper_bound: (value, trace_id, ts)} for buckets holding
+        an exemplar; key ``math.inf`` is the +Inf bucket, which prefers the
+        biased-to-max slot (THE outlier) over its own last write.  None
+        when no traced observation was ever recorded."""
+        with self._lock:
+            slots = self._exemplars
+            if slots is None:
+                return None
+            slots = list(slots)
+            max_ex = self._max_exemplar
+        out: Dict[float, Tuple[float, str, float]] = {}
+        for ub, ex in zip(self._uppers, slots):
+            if ex is not None:
+                out[ub] = ex
+        inf_ex = max_ex or slots[-1]
+        if inf_ex is not None:
+            out[math.inf] = inf_ex
+        return out
 
     def percentile(self, q: float) -> float:
         """histogram_quantile estimator: linear interpolation inside the
@@ -286,18 +331,23 @@ class Histogram(_Family):
     kind = "histogram"
 
     def __init__(self, name: str, help: str, labels: Sequence[str] = (),
-                 buckets: Optional[Sequence[float]] = None):
+                 buckets: Optional[Sequence[float]] = None,
+                 clock: Callable[[], float] = time.monotonic):
         super().__init__(name, help, labels)
         bs = tuple(sorted(buckets)) if buckets else DEFAULT_LATENCY_BUCKETS
         if not bs:
             raise ValueError("histogram needs at least one bucket")
         self.buckets = bs
+        self.clock = clock  # stamps exemplar timestamps
 
     def _new_child(self):
-        return _HistogramChild(self.buckets)
+        return _HistogramChild(self.buckets, self.clock)
 
-    def observe(self, value: float, **labels) -> None:
-        self.labels(**labels).observe(value)
+    def observe(self, value: float, trace_id: Optional[str] = None,
+                **labels) -> None:
+        """Record one observation; ``trace_id`` (reserved — cannot be a
+        label name) attaches an exemplar linking the sample to a trace."""
+        self.labels(**labels).observe(value, trace_id)
 
     def percentile(self, q: float, **labels) -> float:
         return self.labels(**labels).percentile(q)
@@ -360,7 +410,7 @@ class MetricsRegistry:
     def histogram(self, name: str, help: str = "", labels: Sequence[str] = (),
                   buckets: Optional[Sequence[float]] = None) -> Histogram:
         return self._get_or_make(Histogram, name, help, labels,
-                                 buckets=buckets)
+                                 buckets=buckets, clock=self.clock)
 
     def timer(self, hist: Histogram, **labels):
         """Context manager observing the block's duration on ``clock``."""
@@ -382,19 +432,44 @@ class MetricsRegistry:
         with self._lock:
             return [self._families[k] for k in sorted(self._families)]
 
-    def to_prometheus(self) -> str:
-        """Prometheus text exposition format 0.0.4."""
+    def to_prometheus(self, openmetrics: bool = False) -> str:
+        """Prometheus text exposition format 0.0.4; ``openmetrics=True``
+        renders the OpenMetrics dialect instead: histogram bucket lines
+        carry exemplar suffixes, and counter metadata drops the ``_total``
+        suffix from the family name (the spec puts ``_total`` on the
+        sample, not the family — a conformant parser rejects both a
+        suffixed family and an exemplar in 0.0.4, so the two dialects must
+        never mix).
+
+        Callers gate on the scraper's Accept header (``PipelineServer``
+        /metrics does) and, for full OpenMetrics compliance, append the
+        ``# EOF`` terminator themselves.
+        """
         lines: List[str] = []
         for fam in self.families():
+            meta_name = fam.name
+            if openmetrics and fam.kind == "counter" and \
+                    meta_name.endswith("_total"):
+                meta_name = meta_name[:-len("_total")]
             if fam.help:
-                lines.append(f"# HELP {fam.name} {fam.help}")
-            lines.append(f"# TYPE {fam.name} {fam.kind}")
+                lines.append(f"# HELP {meta_name} {fam.help}")
+            lines.append(f"# TYPE {meta_name} {fam.kind}")
             for key, child in fam._snapshot():
                 if isinstance(fam, Histogram):
+                    ex_by_ub = (child.exemplars() or {}) if openmetrics \
+                        else {}
                     for ub, cum in child.cumulative():
                         lbl = _fmt_labels(fam.label_names, key,
                                           [("le", _fmt_value(ub))])
-                        lines.append(f"{fam.name}_bucket{lbl} {cum}")
+                        line = f"{fam.name}_bucket{lbl} {cum}"
+                        ex = ex_by_ub.get(ub)
+                        if ex is not None:
+                            # OpenMetrics exemplar syntax (timestamp
+                            # omitted: registry clocks are monotonic)
+                            line += (' # {trace_id="'
+                                     f'{_escape_label(ex[1])}"}} '
+                                     f"{_fmt_value(ex[0])}")
+                        lines.append(line)
                     base = _fmt_labels(fam.label_names, key)
                     lines.append(f"{fam.name}_sum{base} "
                                  f"{_fmt_value(child.sum)}")
@@ -413,12 +488,19 @@ class MetricsRegistry:
             for key, child in fam._snapshot():
                 labels = dict(zip(fam.label_names, key))
                 if isinstance(fam, Histogram):
-                    samples.append({
+                    sample = {
                         "labels": labels, "sum": child.sum,
                         "count": child.count,
                         "p50": child.percentile(50.0),
                         "p95": child.percentile(95.0),
-                        "p99": child.percentile(99.0)})
+                        "p99": child.percentile(99.0)}
+                    exemplars = child.exemplars()
+                    if exemplars:
+                        sample["exemplars"] = [
+                            {"le": _fmt_value(ub), "value": v,
+                             "trace_id": tid, "ts": ts}
+                            for ub, (v, tid, ts) in sorted(exemplars.items())]
+                    samples.append(sample)
                 else:
                     samples.append({"labels": labels, "value": child.value})
             out[fam.name] = {"type": fam.kind, "help": fam.help,

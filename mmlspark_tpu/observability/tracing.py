@@ -124,7 +124,10 @@ def current_trace_id() -> Optional[str]:
 
 
 def export_span(span: Span, registry: Optional[MetricsRegistry] = None) -> None:
-    """Record a finished span into the registry + the logging event ring."""
+    """Record a finished span into the registry (histogram observation
+    carries the span's trace id as an exemplar), the per-registry
+    ``SpanCollector`` ring (behind ``/trace/<id>`` + ``/debug/slow`` and
+    the OTLP exporter), and the logging event ring."""
     span.finish()
     reg = registry or get_registry()
     # per-registry child cache keyed by span name (low-cardinality: stage
@@ -141,7 +144,14 @@ def export_span(span: Span, registry: Optional[MetricsRegistry] = None) -> None:
             reg.histogram("mmlspark_span_seconds", "span durations by name",
                           labels=("name",)).labels(name=span.name))
     pair[0].inc()
-    pair[1].observe(span.duration_s)
+    pair[1].observe(span.duration_s, span.trace_id)
+    # bounded ring for /trace + /debug/slow + OTLP export; record() is one
+    # deque append and never blocks this (often request-serialized) caller
+    collector = getattr(reg, "_span_collector", None)
+    if collector is None:
+        from .collector import get_collector  # lazy: collector imports us
+        collector = get_collector(reg)
+    collector.record(span)
     from ..core.logging import log_event  # lazy: logging lazily imports us
     log_event(span.to_event())
 

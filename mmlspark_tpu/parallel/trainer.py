@@ -81,10 +81,13 @@ class Trainer:
         self._train_step = None
         self._state_shardings = None
         from ..observability import get_registry
+        from ..observability.tracing import current_trace_id
         self._m_step = get_registry().histogram(
             "mmlspark_parallel_train_step_seconds",
             "train_step dispatch+wait time on the host (async under jit: "
             "the device may still be running when the call returns)")
+        # bound once: train_step runs per batch, no per-call import lookup
+        self._current_trace_id = current_trace_id
 
     # ------------------------------------------------------------------ init
     def init_state(self, rng, example_batch) -> TrainState:
@@ -164,7 +167,10 @@ class Trainer:
             self._train_step = self._build_train_step()
         t0 = time.perf_counter()
         out = self._train_step(state, batch)
-        self._m_step.observe(time.perf_counter() - t0)
+        # exemplar when a span is active (e.g. a traced fit loop): a slow
+        # step's histogram bucket keeps the trace id of the run that hit it
+        self._m_step.observe(time.perf_counter() - t0,
+                             self._current_trace_id())
         return out
 
 

@@ -31,6 +31,7 @@ import numpy as np
 
 from ..core import DataFrame, Transformer
 from ..observability import get_registry
+from ..observability.collector import get_collector
 from ..observability.tracing import (Span, TRACE_HEADER, export_span,
                                      new_trace_id, trace_span)
 from ..utils.resilience import Deadline, deadline_scope
@@ -99,7 +100,12 @@ class PipelineServer:
     """Serve a fitted pipeline as a JSON web service.
 
     POST <api_path> with a JSON object (one row) -> JSON reply from
-    ``reply_col``.  GET /stats -> counters; GET /health -> ok.
+    ``reply_col``.  GET /stats -> counters; GET /health -> ok;
+    GET /metrics -> Prometheus exposition (with exemplars);
+    GET /trace/<id> -> assembled span tree for a recent trace;
+    GET /debug/slow[?k=N] -> top-K slowest recent requests with phase
+    breakdown and shed/deadline verdict (see docs/OBSERVABILITY.md,
+    "Debugging a slow request").
 
     Graceful degradation: admission is bounded — once ``max_queue_depth``
     requests are in flight, further POSTs are shed immediately with 503 +
@@ -126,7 +132,9 @@ class PipelineServer:
                  registry=None,
                  shed_queue_delay_ewma_s: Optional[float] = None,
                  ewma_alpha: float = 0.2,
-                 micro_batch_deadline_margin_s: float = 0.0):
+                 micro_batch_deadline_margin_s: float = 0.0,
+                 micro_batch_ewma_flush_s: Optional[float] = None,
+                 slow_k: int = 10):
         if mode not in ("continuous", "micro_batch"):
             raise ValueError("mode must be continuous|micro_batch")
         self.model = model
@@ -155,6 +163,14 @@ class PipelineServer:
         # the point where the tightest drained entry's deadline (minus this
         # reserved scoring margin) would expire in the batch buffer
         self.micro_batch_deadline_margin_s = float(micro_batch_deadline_margin_s)
+        # EWMA-predicted early flush (ROADMAP PR 2 follow-up): once the
+        # scorer-maintained queue-delay EWMA says entries are already
+        # paying this much delay, waiting out the rest of the trigger
+        # interval costs more latency than the batch amortization gains —
+        # take what is queued and flush now.  None = off.
+        self.micro_batch_ewma_flush_s = micro_batch_ewma_flush_s
+        # /debug/slow default depth
+        self.slow_k = int(slow_k)
         # metrics: families on the (shared, injectable) registry; children
         # are labelled per server instance once the port is resolved so many
         # servers coexist in one registry/process
@@ -247,9 +263,46 @@ class PipelineServer:
                     d["breakers"] = server.registry.breaker_stats()
                     self._write_raw(200, json.dumps(d).encode())
                 elif self.path == "/metrics":
-                    body = server.registry.to_prometheus().encode()
-                    self._write_raw(
-                        200, body, b"text/plain; version=0.0.4; charset=utf-8")
+                    # content negotiation: exemplars are only legal under
+                    # the OpenMetrics content type — a 0.0.4 parser reads
+                    # the ` # {...}` suffix as a malformed timestamp and
+                    # fails the ENTIRE scrape.  Prometheus asks for
+                    # OpenMetrics explicitly when it wants exemplars.
+                    accept = self.headers.get("Accept", "")
+                    if "application/openmetrics-text" in accept:
+                        body = (server.registry.to_prometheus(openmetrics=True)
+                                + "# EOF\n").encode()
+                        ctype = (b"application/openmetrics-text; "
+                                 b"version=1.0.0; charset=utf-8")
+                    else:
+                        body = server.registry.to_prometheus().encode()
+                        ctype = b"text/plain; version=0.0.4; charset=utf-8"
+                    self._write_raw(200, body, ctype)
+                elif self.path.startswith("/trace/"):
+                    # slow-request diagnostics: a /metrics exemplar's trace
+                    # id resolves here to the assembled span tree while the
+                    # trace is still in the collector ring
+                    trace_id = self.path[len("/trace/"):]
+                    tree = get_collector(server.registry).trace_tree(trace_id)
+                    if tree is None:
+                        self._respond(404, {"error": "unknown or evicted "
+                                                     "trace", "traceId": trace_id})
+                    else:
+                        self._respond(200, tree)
+                elif self.path.split("?", 1)[0] == "/debug/slow":
+                    k = server.slow_k
+                    query = self.path.partition("?")[2]
+                    for part in query.split("&"):
+                        if part.startswith("k="):
+                            try:
+                                k = int(part[2:])
+                            except ValueError:
+                                pass
+                    slow = get_collector(server.registry).slowest(
+                        k=k, name="serving.request",
+                        server=server._server_label)
+                    self._respond(200, {"server": server._server_label,
+                                        "slowest": slow})
                 else:
                     self._respond(404, {"error": "not found"})
 
@@ -335,7 +388,10 @@ class PipelineServer:
                             stats.latency_sum += latency_s
                             stats.latency_count += 1
                         server._c_status["replied"].inc()
-                        server._h_latency.observe(latency_s)
+                        # exemplar: the bucket this latency lands in keeps
+                        # this request's trace id — a p99 outlier on
+                        # /metrics resolves to /trace/<id>
+                        server._h_latency.observe(latency_s, trace_id)
                     elif status == 503:
                         with stats.lock:
                             stats.shed += 1
@@ -431,6 +487,27 @@ class PipelineServer:
         batch = [first]
         if self.mode == "micro_batch":
             flush_at = time.monotonic() + self.interval_ms / 1000.0
+            if self.micro_batch_ewma_flush_s is not None:
+                # EWMA-predicted trigger (PR 2 follow-up): the scorer's
+                # queue-delay EWMA predicts what further waiting costs the
+                # entries in hand.  Once the prediction eats the bound,
+                # the batch gains cannot pay for the wait — take whatever
+                # is queued and flush now; below the bound, pull the flush
+                # point forward so total predicted delay stays bounded.
+                # The EWMA only moves in _score_batch (this same worker
+                # thread), so one read per drain is exact.
+                with self.stats.lock:
+                    predicted = self._queue_ewma
+                ewma_slack_s = self.micro_batch_ewma_flush_s - predicted
+                if ewma_slack_s <= 0:
+                    while len(batch) < self.max_batch:
+                        try:
+                            batch.append(self._q.get_nowait())
+                        except queue.Empty:
+                            break
+                    return batch
+                flush_at = min(flush_at,
+                               time.monotonic() + ewma_slack_s)
             while len(batch) < self.max_batch:
                 wait_s = flush_at - time.monotonic()
                 if wait_s <= 0:
@@ -479,14 +556,17 @@ class PipelineServer:
             for e in batch:
                 self._queue_ewma = (alpha * max(0.0, now - e.t_enq)
                                     + (1.0 - alpha) * self._queue_ewma)
+        verdicts: Dict[str, str] = {}
         for e in batch:
-            self._h_phase_queue.observe(max(0.0, now - e.t_enq))
+            self._h_phase_queue.observe(max(0.0, now - e.t_enq), e.trace_id)
             if now > e.t_deadline:
                 e.status, e.reply = 504, {"error": "deadline expired in queue"}
+                verdicts[e.uid] = "deadline_expired_in_queue"
             elif self.max_queue_age_s is not None and \
                     now - e.t_enq > self.max_queue_age_s:
                 e.status, e.reply = 503, {"error": "shed: queue age exceeded"}
                 e.retry_after_s = self.shed_retry_after_s
+                verdicts[e.uid] = "shed_queue_age"
             else:
                 live.append(e)
         score_s = 0.0
@@ -521,18 +601,24 @@ class PipelineServer:
                     e.status, e.reply = 500, {"error": str(ex)}
             score_s = max(0.0, self.clock() - t_score0)
             for e in live:
-                self._h_phase_score.observe(score_s)
+                self._h_phase_score.observe(score_s, e.trace_id)
         with self.stats.lock:
             self._pending -= len(batch)
         for e in batch:
             # one serving.request span per entry, back-dated to its enqueue
             # time on the server clock: queue wait + score in one record,
-            # joined to the caller's trace
+            # joined to the caller's trace.  `server` scopes /debug/slow to
+            # one instance in a shared registry; `verdict` names the
+            # shed/deadline decision the slow-request view reports.
             span = Span("serving.request", trace_id=e.trace_id,
                         clock=self.clock, start_s=e.t_enq,
                         attributes={"status": e.status,
                                     "queue_s": round(max(0.0, now - e.t_enq), 6),
-                                    "score_s": round(score_s, 6)})
+                                    "score_s": round(score_s, 6),
+                                    "server": self._server_label,
+                                    "verdict": verdicts.get(
+                                        e.uid, "ok" if e.status == 200
+                                        else "error")})
             if e.status != 200:
                 span.status = f"http:{e.status}"
             span.finish()

@@ -1,0 +1,23 @@
+"""RES002 fixture: retry helpers with NO deadline actually in scope — the
+backoff schedule is the only bound, so a caller's budget cannot clip it.
+Parsed by graft-lint only, never imported."""
+from mmlspark_tpu.utils.resilience import (deadline_scope, retry_with_timeout,
+                                           with_retries)
+
+
+def flaky_fetch(fn):
+    # no ambient scope, no deadline= argument, no deadline parameter
+    return with_retries(fn, retries=5, initial_delay_s=0.5)
+
+
+def flaky_init(fn):
+    return retry_with_timeout(fn, timeout_s=3.0, retries=4)
+
+
+def deferred_callback(fn, callbacks):
+    with deadline_scope(1.0):
+        def cb():
+            # cb runs LATER, after the with-block exits: the scope above
+            # is not a budget for this body — still a violation
+            return with_retries(fn, retries=3)
+        callbacks.append(cb)

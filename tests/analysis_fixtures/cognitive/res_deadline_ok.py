@@ -1,0 +1,32 @@
+"""RES002 near-miss fixture: every retry call site has a statically visible
+budget — a deadline_scope block, an explicit deadline= argument, a threaded
+deadline parameter, or a trace_span(..., deadline_s=...) block.  Must
+produce zero findings.  Parsed by graft-lint only, never imported."""
+from mmlspark_tpu.observability.tracing import trace_span
+from mmlspark_tpu.utils.resilience import (Deadline, deadline_scope,
+                                           retry_with_timeout, with_retries)
+
+
+def bounded_fetch(fn):
+    with deadline_scope(2.0):
+        return with_retries(fn, retries=5, initial_delay_s=0.5)
+
+
+def explicit_budget(fn):
+    return retry_with_timeout(fn, timeout_s=3.0,
+                              deadline=Deadline.after(2.0))
+
+
+def threaded_budget(fn, deadline):
+    # convention: a `deadline` parameter is the caller's budget, handed on
+    return with_retries(fn, retries=3, deadline=deadline)
+
+
+def threaded_ambient(fn, deadline):
+    # the parameter alone counts — runtime installs it as the ambient scope
+    return with_retries(fn, retries=3)
+
+
+def span_budget(fn):
+    with trace_span("init", deadline_s=5.0):
+        return with_retries(fn, retries=3)

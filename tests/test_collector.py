@@ -1,0 +1,362 @@
+"""SpanCollector + slow-request diagnostics: the deep-diagnostics loop on
+top of PR 2's aggregate telemetry.
+
+Covers the acceptance path end to end: a synthetic slow request's latency
+lands in a histogram bucket whose exemplar carries its trace id, the id
+resolves on ``GET /trace/<id>`` to a span tree with the slow phase visible,
+``GET /debug/slow`` surfaces it with its phase breakdown, and with
+``MMLSPARK_TPU_OTLP_ENDPOINT`` pointing at a test sink the same spans
+arrive as OTLP-shaped JSON — while a dead or hung sink never slows the
+scoring path (bounded buffer, drop counting, one breaker probe per
+cooldown).
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+from mmlspark_tpu.io.http import HTTPResponseData
+from mmlspark_tpu.observability import MetricsRegistry, get_collector
+from mmlspark_tpu.observability.collector import (OTLP_ENDPOINT_ENV,
+                                                  SpanCollector)
+from mmlspark_tpu.observability.tracing import Span
+from mmlspark_tpu.serving import PipelineServer
+from mmlspark_tpu.utils.resilience import CircuitBreaker, FakeClock
+from tests.serving_helpers import Doubler
+from tests.test_observability import parse_prometheus
+
+
+def _span(name, trace_id, clock, start_s, end_s, parent_id=None, **attrs):
+    s = Span(name, trace_id=trace_id, parent_id=parent_id, clock=clock,
+             start_s=start_s, attributes=attrs)
+    s.finish(end_s)
+    return s
+
+
+class SlowDoubler(Doubler):
+    """Doubler that stalls scoring only for the trigger payload (21) — THE
+    synthetic slow request, with fast neighbors for contrast."""
+
+    def _transform(self, df):
+        def per_part(p):
+            if any(float(v) == 21.0 for v in p["request"]):
+                time.sleep(0.08)
+            vals = np.asarray([2 * float(v) for v in p["request"]], float)
+            return {**p, "reply": vals}
+        return df.map_partitions(per_part)
+
+
+# ---------------------------------------------------------------- ring/buffer
+
+def test_collector_overflow_drops_oldest_and_counts():
+    clk = FakeClock()
+    reg = MetricsRegistry(clock=clk)
+    coll = SpanCollector(capacity=4, registry=reg, clock=clk,
+                         endpoint="", epoch_offset_s=0.0)
+    assert not coll.exporting
+    for i in range(6):
+        coll.record(_span(f"s{i}", f"t{i}", clk, 0.0, 0.1))
+    # oldest two evicted, newest four answer queries
+    assert coll.trace("t0") == [] and coll.trace("t1") == []
+    assert len(coll.trace("t5")) == 1
+    drops = reg.counter("mmlspark_span_ring_dropped_total")
+    assert drops.labels().value == 2
+    # registry carries the full collector surface (telemetry-coverage
+    # satellite): batches/spans/flush families exist from construction
+    for fam in ("mmlspark_otlp_export_spans_total",
+                "mmlspark_otlp_export_batches_total",
+                "mmlspark_otlp_flush_seconds",
+                "mmlspark_otlp_export_queue_depth"):
+        assert reg.family(fam) is not None, fam
+
+
+def test_trace_tree_assembles_parentage():
+    clk = FakeClock()
+    reg = MetricsRegistry(clock=clk)
+    coll = SpanCollector(registry=reg, clock=clk, endpoint="",
+                         epoch_offset_s=0.0)
+    root = _span("serving.request", "tr", clk, 0.0, 1.0)
+    child = _span("Doubler.transform", "tr", clk, 0.2, 0.8,
+                  parent_id=root.span_id)
+    grand = _span("stopwatch.ingest", "tr", clk, 0.3, 0.4,
+                  parent_id=child.span_id)
+    for s in (child, grand, root):
+        coll.record(s)
+    tree = coll.trace_tree("tr")
+    assert tree["spanCount"] == 3
+    assert [r["name"] for r in tree["roots"]] == ["serving.request"]
+    lvl1 = tree["roots"][0]["children"]
+    assert [c["name"] for c in lvl1] == ["Doubler.transform"]
+    assert [g["name"] for g in lvl1[0]["children"]] == ["stopwatch.ingest"]
+    assert coll.trace_tree("missing") is None
+
+
+# ------------------------------------------------- the E2E diagnostics loop
+
+def test_slow_request_traceable_end_to_end():
+    """/metrics outlier -> exemplar trace id -> /trace/<id> phase breakdown
+    -> /debug/slow: the acceptance loop, over a real socket."""
+    reg = MetricsRegistry()
+    srv = PipelineServer(SlowDoubler(), port=0, registry=reg).start()
+    try:
+        # a fast request first, then THE slow one with a caller trace id
+        req = urllib.request.Request(
+            srv.address, data=b"1",
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=5).read()
+        tid = "slowslowslowslow0123456789abcdef"
+        req = urllib.request.Request(
+            srv.address, data=b"21",
+            headers={"Content-Type": "application/json",
+                     "X-MMLSpark-Trace-Id": tid})
+        resp = urllib.request.urlopen(req, timeout=5)
+        assert json.loads(resp.read()) == 42.0
+        assert resp.headers["X-MMLSpark-Trace-Id"] == tid
+
+        # 1. the latency histogram's outlier bucket carries the trace id —
+        # under the NEGOTIATED OpenMetrics content type (exemplar syntax
+        # would break a plain 0.0.4 parser, so the default scrape stays
+        # clean of it)
+        plain = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=5)
+        assert "0.0.4" in plain.headers["Content-Type"]
+        plain_text = plain.read().decode()
+        assert " # " not in plain_text
+        parse_prometheus(plain_text)  # must stay 0.0.4-parseable
+        om_req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/metrics",
+            headers={"Accept": "application/openmetrics-text; version=1.0.0"})
+        om = urllib.request.urlopen(om_req, timeout=5)
+        assert om.headers["Content-Type"].startswith(
+            "application/openmetrics-text")
+        text = om.read().decode()
+        assert text.endswith("# EOF\n")
+        # OpenMetrics counter naming: _total lives on the sample, not the
+        # family metadata (0.0.4 keeps the suffixed family name)
+        assert "# TYPE mmlspark_serving_requests counter" in text
+        assert "# TYPE mmlspark_serving_requests_total counter" \
+            in plain_text
+        _, _, exemplars = parse_prometheus(text)
+        latency_ex = {k: v for k, v in exemplars.items()
+                      if k[0] == "mmlspark_serving_request_latency_seconds_bucket"}
+        assert latency_ex, "latency histogram exposed no exemplars"
+        # the slow request IS the max: the +Inf (biased-to-max) slot has it
+        inf_ex = [v for k, v in latency_ex.items()
+                  if ("le", "+Inf") in k[1]]
+        assert inf_ex and inf_ex[0][0] == {"trace_id": tid}
+        assert inf_ex[0][1] >= 0.08
+
+        # 2. the trace id resolves to the span tree with the slow phase
+        tree = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/trace/{tid}", timeout=5).read())
+        assert tree["traceId"] == tid
+        by_name = {}
+        stack = list(tree["roots"])
+        while stack:
+            node = stack.pop()
+            by_name[node["name"]] = node
+            stack.extend(node["children"])
+        req_span = by_name["serving.request"]
+        assert req_span["attributes"]["status"] == 200
+        assert req_span["attributes"]["verdict"] == "ok"
+        # the phase breakdown shows scoring (the sleep) dominating
+        assert req_span["attributes"]["score_s"] >= 0.08
+        assert "serving.score" in by_name
+        # the stage verb span joined the same trace too; log_verb exports
+        # through the process-global registry, so it lands in THAT
+        # registry's collector ring
+        from mmlspark_tpu.observability import get_registry
+        verb_spans = get_collector(get_registry()).trace(tid)
+        assert "SlowDoubler.transform" in {s.name for s in verb_spans}
+
+        # 3. /debug/slow ranks it first with the same breakdown
+        slow = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/debug/slow?k=5", timeout=5).read())
+        assert slow["server"] == srv._server_label
+        top = slow["slowest"][0]
+        assert top["traceId"] == tid
+        assert top["score_s"] >= 0.08 and top["verdict"] == "ok"
+
+        # unknown trace -> 404
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/trace/deadbeef", timeout=5)
+        assert err.value.code == 404
+    finally:
+        srv.stop()
+
+
+# --------------------------------------------------------------- OTLP export
+
+class _SinkHandler(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        self.server.received.append(json.loads(self.rfile.read(length)))
+        body = b"{}"
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture
+def otlp_sink():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _SinkHandler)
+    httpd.received = []
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield httpd
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_otlp_export_reaches_http_sink_via_env_knob(otlp_sink, monkeypatch):
+    url = f"http://127.0.0.1:{otlp_sink.server_port}/v1/traces"
+    monkeypatch.setenv(OTLP_ENDPOINT_ENV, url)
+    reg = MetricsRegistry()
+    srv = PipelineServer(Doubler(), port=0, registry=reg).start()
+    try:
+        tid = "abcd" * 8
+        req = urllib.request.Request(
+            srv.address, data=b"2",
+            headers={"Content-Type": "application/json",
+                     "X-MMLSpark-Trace-Id": tid})
+        urllib.request.urlopen(req, timeout=5).read()
+        coll = get_collector(reg)
+        assert coll.exporting and coll.endpoint == url
+        deadline = time.monotonic() + 5.0
+        while not otlp_sink.received and time.monotonic() < deadline:
+            coll.flush_now()
+            time.sleep(0.01)
+        assert otlp_sink.received, "no OTLP payload reached the sink"
+        payload = otlp_sink.received[0]
+        # OTLP/JSON shape: resourceSpans -> scopeSpans -> spans
+        rs = payload["resourceSpans"][0]
+        attrs = {a["key"]: a["value"] for a in rs["resource"]["attributes"]}
+        assert attrs["service.name"] == {"stringValue": "mmlspark_tpu"}
+        spans = [s for batch in otlp_sink.received
+                 for s in batch["resourceSpans"][0]["scopeSpans"][0]["spans"]]
+        ours = [s for s in spans if s["traceId"] == tid]
+        assert ours, "request spans did not arrive at the collector"
+        assert {"serving.request", "serving.score"} <= \
+            {s["name"] for s in ours}
+        for s in ours:
+            assert int(s["endTimeUnixNano"]) >= int(s["startTimeUnixNano"])
+            assert s["status"]["code"] == 1
+        # export accounting
+        ok = reg.counter("mmlspark_otlp_export_batches_total",
+                         labels=("result",)).value(result="ok")
+        assert ok >= 1
+        assert reg.histogram("mmlspark_otlp_flush_seconds").count() >= 1
+    finally:
+        srv.stop()
+
+
+def test_otlp_file_sink_writes_payload_lines(tmp_path):
+    clk = FakeClock()
+    reg = MetricsRegistry(clock=clk)
+    path = tmp_path / "spans.jsonl"
+    coll = SpanCollector(registry=reg, clock=clk, epoch_offset_s=0.0,
+                         endpoint=f"file://{path}", batch_size=2)
+    coll.stop(drain=False)  # deterministic: flush by hand, no thread
+    for i in range(3):
+        coll.record(_span(f"s{i}", "tf", clk, float(i), float(i) + 0.5))
+    assert coll.flush_now() == 2 and coll.flush_now() == 1
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert len(lines) == 2
+    spans = lines[0]["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert [s["name"] for s in spans] == ["s0", "s1"]
+    assert spans[0]["startTimeUnixNano"] == "0"
+    assert spans[0]["endTimeUnixNano"] == str(int(0.5e9))
+    assert reg.counter("mmlspark_otlp_export_spans_total",
+                       labels=("result",)).value(result="ok") == 3
+
+
+# ----------------------------------------- failure isolation (dead/hung sink)
+
+def test_dead_sink_costs_one_probe_per_cooldown_and_never_blocks():
+    calls = []
+
+    def dead_transport(req, timeout_s):
+        calls.append(req.url)
+        raise ConnectionRefusedError("collector down")
+
+    clk = FakeClock()
+    reg = MetricsRegistry()
+    breaker = CircuitBreaker(failure_threshold=2, cooldown_s=30.0,
+                             clock=clk, name="otlp-export")
+    coll = SpanCollector(registry=reg, endpoint="http://127.0.0.1:1/v1/traces",
+                         breaker=breaker, transport=dead_transport,
+                         batch_size=4)
+    coll.stop(drain=False)  # drive flushes by hand
+    # explicit construction self-registers: export_span must feed THIS
+    # collector, not a hidden implicit one
+    assert reg._span_collector is coll
+    srv = PipelineServer(Doubler(), port=0, registry=reg).start()
+    try:
+        t0 = time.monotonic()
+        for i in range(10):
+            req = urllib.request.Request(
+                srv.address, data=str(i).encode(),
+                headers={"Content-Type": "application/json"})
+            assert urllib.request.urlopen(req, timeout=5).read()
+        elapsed = time.monotonic() - t0
+        # scoring never waited on the dead sink (record() is an append)
+        assert elapsed < 5.0
+        # two failed flushes trip the breaker...
+        assert coll.flush_now() and coll.flush_now()
+        assert breaker.state == "open"
+        n_attempts = len(calls)
+        # ...after which flushes short-circuit: batches fail WITHOUT a
+        # network attempt, spans are dropped, the queue cannot grow
+        while coll.flush_now():
+            pass
+        assert len(calls) == n_attempts, "open breaker still hit the network"
+        assert coll.queue_depth() == 0
+        fails = reg.counter("mmlspark_otlp_export_batches_total",
+                            labels=("result",)).value(result="fail")
+        assert fails >= 3
+        # one probe per cooldown: past cooldown exactly one attempt goes out
+        clk.advance(30.0)
+        coll.record(_span("probe", "tp", time.monotonic, 0.0, 0.1))
+        coll.flush_now()
+        assert len(calls) == n_attempts + 1
+    finally:
+        srv.stop()
+
+
+def test_hung_sink_never_blocks_the_scoring_path():
+    release = threading.Event()
+
+    def hung_transport(req, timeout_s):
+        release.wait(10.0)  # a sink that answers only when freed
+        return HTTPResponseData(status_code=200)
+
+    reg = MetricsRegistry()
+    coll = SpanCollector(registry=reg,
+                         endpoint="http://127.0.0.1:1/v1/traces",
+                         transport=hung_transport, batch_size=1,
+                         flush_interval_s=0.01)
+    srv = PipelineServer(Doubler(), port=0, registry=reg).start()
+    try:
+        t0 = time.monotonic()
+        for i in range(10):
+            req = urllib.request.Request(
+                srv.address, data=str(i).encode(),
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=5).read()
+        assert time.monotonic() - t0 < 5.0, \
+            "scoring path waited on a hung export"
+    finally:
+        release.set()
+        srv.stop()
+        coll.stop(drain=False)

@@ -33,9 +33,20 @@ from tests.serving_helpers import Doubler
 
 def parse_prometheus(text):
     """Tiny exposition-format parser: returns ({(name, frozenset(labels)):
-    value}, {name: type}).  Raises on malformed lines, so the round-trip
-    test also validates the format itself."""
-    values, types = {}, {}
+    value}, {name: type}, {key: (exemplar_labels, exemplar_value)}).
+    Raises on malformed lines — including malformed OpenMetrics exemplar
+    suffixes (``... # {trace_id="x"} 0.042``) — so the round-trip tests
+    also validate the format itself."""
+    values, types, exemplars = {}, {}, {}
+
+    def parse_labels(rest, line):
+        labels = []
+        for pair in rest.split(","):
+            k, v = pair.split("=", 1)
+            assert v.startswith('"') and v.endswith('"'), line
+            labels.append((k, v[1:-1]))
+        return labels
+
     for line in text.splitlines():
         if not line:
             continue
@@ -45,22 +56,25 @@ def parse_prometheus(text):
             types[name] = kind
             continue
         if line.startswith("#"):
-            assert line.startswith("# HELP "), line
+            assert line.startswith("# HELP ") or line == "# EOF", line
             continue
+        exemplar = None
+        if " # " in line:  # OpenMetrics exemplar suffix on a bucket line
+            line, _, ex = line.partition(" # ")
+            assert ex.startswith("{"), ex
+            ex_labels, _, ex_val = ex[1:].partition("} ")
+            exemplar = (dict(parse_labels(ex_labels, ex)), float(ex_val))
         body, sval = line.rsplit(" ", 1)
         if "{" in body:
             name, rest = body.split("{", 1)
             assert rest.endswith("}"), line
-            labels = []
-            for pair in rest[:-1].split(","):
-                k, v = pair.split("=", 1)
-                assert v.startswith('"') and v.endswith('"'), line
-                labels.append((k, v[1:-1]))
-            key = (name, frozenset(labels))
+            key = (name, frozenset(parse_labels(rest[:-1], line)))
         else:
             key = (body, frozenset())
         values[key] = float(sval)
-    return values, types
+        if exemplar is not None:
+            exemplars[key] = exemplar
+    return values, types, exemplars
 
 
 def test_prometheus_exposition_round_trip():
@@ -76,7 +90,7 @@ def test_prometheus_exposition_round_trip():
     for v in (0.001, 0.01, 0.01, 5.0):
         h.observe(v)
 
-    values, types = parse_prometheus(reg.to_prometheus())
+    values, types, _ = parse_prometheus(reg.to_prometheus())
     assert types == {"mmlspark_test_ops_total": "counter",
                      "mmlspark_test_depth": "gauge",
                      "mmlspark_test_live": "gauge",
@@ -103,6 +117,47 @@ def test_prometheus_exposition_round_trip():
     d = reg.to_dict()
     assert d["mmlspark_test_latency_seconds"]["samples"][0]["count"] == 4
     assert d["mmlspark_test_ops_total"]["type"] == "counter"
+
+
+def test_histogram_exemplars_round_trip_prometheus_and_json():
+    clk = FakeClock(start=50.0)
+    reg = MetricsRegistry(clock=clk)
+    h = reg.histogram("mmlspark_test_ex_seconds", "exemplars",
+                      buckets=(0.01, 0.1, 1.0))
+    h.observe(0.005)                       # untraced: no exemplar
+    h.observe(0.05, "trace-old")
+    clk.advance(1.0)
+    h.observe(0.06, "trace-new")           # same bucket: last write wins
+    h.observe(0.5, "trace-big")            # the outlier -> max slot
+    h.observe(0.3, "trace-mid")            # overwrites le=1.0's last write
+
+    # exemplar suffixes are OpenMetrics-only: the default (0.0.4) text
+    # stays clean for scrapers that did not negotiate them
+    assert " # " not in reg.to_prometheus()
+    values, _, exemplars = parse_prometheus(
+        reg.to_prometheus(openmetrics=True))
+    key = lambda le: ("mmlspark_test_ex_seconds_bucket",
+                      frozenset([("le", le)]))
+    assert values[key("0.1")] == 3          # cumulative counts unchanged
+    # last write per bucket
+    assert exemplars[key("0.1")] == ({"trace_id": "trace-new"}, 0.06)
+    assert exemplars[key("1")] == ({"trace_id": "trace-mid"}, 0.3)
+    # +Inf carries the biased-to-max slot: THE outlier survives later,
+    # smaller writes into its own bucket
+    assert exemplars[key("+Inf")] == ({"trace_id": "trace-big"}, 0.5)
+    # untraced bucket has no exemplar
+    assert key("0.01") not in exemplars
+
+    # JSON twin: same exemplars, with FakeClock timestamps
+    sample = reg.to_dict()["mmlspark_test_ex_seconds"]["samples"][0]
+    by_le = {e["le"]: e for e in sample["exemplars"]}
+    assert by_le["0.1"]["trace_id"] == "trace-new"
+    assert by_le["0.1"]["ts"] == pytest.approx(51.0)
+    assert by_le["+Inf"]["value"] == pytest.approx(0.5)
+    # a histogram that never saw a trace id exposes no exemplars key
+    reg.histogram("mmlspark_test_noex_seconds", "none").observe(0.5)
+    assert "exemplars" not in \
+        reg.to_dict()["mmlspark_test_noex_seconds"]["samples"][0]
 
 
 def test_histogram_percentiles_match_numpy_reference():
@@ -295,7 +350,7 @@ def test_metrics_endpoint_serves_prometheus_with_breakers():
             urllib.request.urlopen(req, timeout=5).read()
         text = urllib.request.urlopen(
             f"http://127.0.0.1:{srv.port}/metrics").read().decode()
-        values, types = parse_prometheus(text)
+        values, types, _ = parse_prometheus(text)
         label = f"127.0.0.1:{srv.port}"
         sv = frozenset([("server", label)])
         # acceptance: latency histogram, queue gauge, counters, breaker state
@@ -372,6 +427,46 @@ def test_queue_delay_ewma_sheds_and_recovers_on_fakeclock():
         assert srv._queue_ewma < 0.1
     finally:
         srv.stop()
+
+
+def test_micro_batch_ewma_flush_trigger_on_fakeclock():
+    """PR 2 follow-up (the last one): the queue-delay EWMA the scorer
+    already maintains for shedding doubles as a micro-batch flush trigger.
+    Once predicted queue delay eats the configured bound, waiting out the
+    10 s trigger interval costs more than the batch gains — _drain grabs
+    what is queued and flushes immediately."""
+    import time as _time
+    clk = FakeClock()
+    srv = PipelineServer(Doubler(), port=0, mode="micro_batch",
+                         micro_batch_interval_ms=10_000, clock=clk,
+                         registry=MetricsRegistry(), ewma_alpha=1.0,
+                         micro_batch_ewma_flush_s=0.5)
+    # seed the EWMA through the scorer: one entry waited 1 s on the fake
+    # clock (alpha=1.0 makes the EWMA exactly that delay)
+    assert srv._try_admit() is None
+    e = _Entry(uid="a", payload=1.0, headers={}, t_enq=clk())
+    clk.advance(1.0)
+    srv._score_batch([e])
+    assert srv._queue_ewma == pytest.approx(1.0)
+    # two queued entries; the EWMA (1.0 s) exceeds the 0.5 s bound, so the
+    # drain must return both well inside the 10 s trigger interval
+    for uid in ("b", "c"):
+        srv._q.put(_Entry(uid=uid, payload=1.0, headers={}, t_enq=clk()))
+    t0 = _time.monotonic()
+    batch = srv._drain()
+    elapsed = _time.monotonic() - t0
+    assert sorted(x.uid for x in batch) == ["b", "c"]
+    assert elapsed < 2.0, f"EWMA flush trigger did not fire ({elapsed:.1f}s)"
+    # below the bound the wait is CLIPPED to the remaining EWMA slack, not
+    # the full interval: drain of a lone entry returns in ~(bound - ewma)
+    with srv.stats.lock:
+        srv._queue_ewma = 0.4                      # 0.1 s slack remains
+    srv._q.put(_Entry(uid="d", payload=1.0, headers={}, t_enq=clk()))
+    t0 = _time.monotonic()
+    batch = srv._drain()
+    elapsed = _time.monotonic() - t0
+    assert [x.uid for x in batch] == ["d"]
+    assert elapsed < 2.0, f"EWMA wait clip did not apply ({elapsed:.1f}s)"
 
 
 def test_fixed_depth_shed_reason_still_applies():

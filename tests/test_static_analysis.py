@@ -15,6 +15,7 @@ from mmlspark_tpu.analysis import (AnalysisEngine, BaselineEntry, Finding,
                                    HotPathChecker, LockDisciplineChecker,
                                    ResilienceCoverageChecker,
                                    StageContractChecker, TracerSafetyChecker,
+                                   UndeadlinedRetryChecker,
                                    load_baseline, main, rule_catalog,
                                    run_analysis, save_baseline,
                                    split_findings, update_baseline)
@@ -38,6 +39,8 @@ PAIRS = [
      {"TRC001", "TRC002", "TRC003", "TRC004"}),
     (ResilienceCoverageChecker, "cognitive/res_bad.py",
      "cognitive/res_ok.py", {"RES001"}),
+    (UndeadlinedRetryChecker, "cognitive/res_deadline_bad.py",
+     "cognitive/res_deadline_ok.py", {"RES002"}),
     (LockDisciplineChecker, "observability/lck_bad.py",
      "observability/lck_ok.py", {"LCK001", "LCK002", "LCK003"}),
     (HotPathChecker, "serving/hot_bad.py", "serving/hot_ok.py",
@@ -64,6 +67,24 @@ def test_trc_reaches_through_call_edges_and_module_level_roots():
     assert "_shard_fn" in symbols
     # _scan_body is rooted by being passed to lax.scan inside run()
     assert "_scan_body" in symbols
+
+
+def test_res002_fires_once_per_unbudgeted_site():
+    findings = _scan(UndeadlinedRetryChecker(), "cognitive/res_deadline_bad.py")
+    # deferred_callback.cb: a def under a deadline_scope runs later, when
+    # the scope is gone — the lexical block must not suppress the finding
+    assert sorted(f.symbol for f in findings) == \
+        ["deferred_callback.cb", "flaky_fetch", "flaky_init"]
+
+
+def test_rules_filter_accepts_family_prefixes():
+    """--rules TRC,RES,... (family prefixes) restricts like exact ids do —
+    the pre-commit hook leans on this to skip the cross-module STG pass
+    when linting staged files only."""
+    bad = os.path.join(FIXTURES, "serving", "hot_bad.py")
+    findings = run_analysis([bad], root=FIXTURES, rules=["HOT"])
+    assert findings and all(f.rule.startswith("HOT") for f in findings)
+    assert run_analysis([bad], root=FIXTURES, rules=["STG"]) == []
 
 
 def test_stage_contract_fixtures():
@@ -164,6 +185,28 @@ def test_update_baseline_preserves_justifications(tmp_path):
     # a fixed finding falls out on the next update
     update_baseline(path, [f2])
     assert [e.rule for e in load_baseline(path)] == ["RES001"]
+
+
+def test_rule_restricted_update_preserves_other_families(tmp_path):
+    """--rules STG --update-baseline must not delete TRC/HOT/... entries:
+    the filtered findings make every out-of-scope entry look fixed, so the
+    CLI passes them through as preserved."""
+    path = str(tmp_path / "base.toml")
+    keep = BaselineEntry("HOT001", "a.py", "f", 5, "reviewed: load-bearing")
+    save_baseline(path, [keep])
+    stg = Finding("STG001", "b.py", 9, "m", symbol="Cls.p")
+    entries = update_baseline(path, [stg], preserved=[keep])
+    by_rule = {e.rule: e for e in entries}
+    assert by_rule["HOT001"].justification == "reviewed: load-bearing"
+    assert by_rule["STG001"].justification.startswith("TODO")
+    # and the CLI wires it: a HOT-restricted rewrite records the live HOT
+    # findings, ratchets in-scope stale entries, and keeps STG untouched
+    assert main(["--rules", "HOT", "--update-baseline",
+                 "--baseline", path,
+                 os.path.join(FIXTURES, "serving", "hot_bad.py")]) == 0
+    rules_after = {e.rule for e in load_baseline(path)}
+    assert "STG001" in rules_after, "out-of-scope entry was deleted"
+    assert {"HOT001", "HOT002"} <= rules_after
 
 
 # ---------------------------------------------------------------------------

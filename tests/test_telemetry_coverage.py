@@ -27,6 +27,36 @@ def test_base_verbs_are_instrumented():
     assert "log_verb" in inspect.getsource(Transformer.transform)
 
 
+def test_collector_public_surface_is_instrumented():
+    """The span collector watches everything else, so the registry must
+    watch the collector: its hot path (record) and flush path must book
+    the drop/batch/span counters and the flush-latency histogram that
+    ``instruments.instrument_collector`` declares, and every declared
+    family must actually be registered at construction.  Source-level like
+    the stage sweep, so a refactor cannot silently drop the accounting."""
+    from mmlspark_tpu.observability import MetricsRegistry, collector
+
+    record_src = inspect.getsource(collector.SpanCollector.record)
+    flush_src = inspect.getsource(collector.SpanCollector.flush_now)
+    # hot path books ring + export-queue drops; flush path books latency
+    # and per-result batch/span outcomes (the _m children bound once by
+    # instrument_collector)
+    for needle in ('_m["ring_dropped"]', '_m["spans_dropped"]'):
+        assert needle in record_src, f"record() lost {needle}"
+    for needle in ('_m["flush_seconds"]', 'batches_', 'spans_'):
+        assert needle in flush_src, f"flush_now() lost {needle}"
+
+    reg = MetricsRegistry()
+    collector.SpanCollector(registry=reg, endpoint="")
+    for family in ("mmlspark_span_ring_dropped_total",
+                   "mmlspark_otlp_export_spans_total",
+                   "mmlspark_otlp_export_batches_total",
+                   "mmlspark_otlp_flush_seconds",
+                   "mmlspark_otlp_export_queue_depth"):
+        assert reg.family(family) is not None, \
+            f"instrument_collector no longer registers {family}"
+
+
 def test_every_stage_routes_verbs_through_log_verb():
     classes = all_stage_classes()
     assert len(classes) >= 80, f"only {len(classes)} stages discovered"
