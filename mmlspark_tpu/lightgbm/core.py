@@ -107,6 +107,15 @@ class GBDTParams:
     # cutting ICI traffic from O(F*B) to O(k*B) per node on wide data.
     # 0 = full histogram psum (data_parallel).
     voting_k: int = 0
+    # quantized training (LightGBM 4.x "Quantized Training of GBDT", same
+    # param names): per-row grad/hess stochastically rounded to
+    # num_grad_quant_bins integer levels once per iteration, histograms
+    # accumulated as packed integers (ops.histogram quantized builders) and
+    # rescaled only at split-gain time; sibling subtraction is exact in
+    # integer space.  None = auto: ON for accelerator backends, OFF on CPU
+    # (train() resolves it; MMLSPARK_TPU_HIST_QUANT=0/1 is the escape hatch)
+    use_quantized_grad: Optional[bool] = None
+    num_grad_quant_bins: int = 16
 
     def resolve(self) -> "GBDTParams":
         """Normalize growth mode.  Leaf-wise (LightGBM semantics: numLeaves
@@ -129,6 +138,9 @@ class GBDTParams:
             raise ValueError(f"growth must be leaf|level|auto, got {p.growth!r}")
         if p.boosting_type == "rf" and p.bagging_freq == 0:
             p.bagging_freq, p.bagging_fraction = 1, min(p.bagging_fraction, 0.632)
+        if not 4 <= p.num_grad_quant_bins <= 128:
+            raise ValueError("num_grad_quant_bins must be in [4, 128] "
+                             f"(int8 operand lanes), got {p.num_grad_quant_bins}")
         return p
 
     @property
@@ -311,7 +323,7 @@ def _params_sig(p: "GBDTParams") -> tuple:
             p.bagging_fraction, p.bagging_freq,
             tuple(p.categorical_features or ()), tuple(p.cat_subset or ()),
             p.max_cat_to_onehot, p.cat_smooth, p.cat_l2, p.max_cat_threshold,
-            p.voting_k)
+            p.voting_k, p.use_quantized_grad, p.num_grad_quant_bins)
 
 
 def _cached(key, builder):
@@ -325,6 +337,24 @@ def _cached(key, builder):
 # ---------------------------------------------------------------------------
 # tree grower
 # ---------------------------------------------------------------------------
+
+def _check_quant_psum_bound(use_quant: bool, quant_bins: int,
+                            axis_name, psum_row_bound) -> None:
+    """Sharded overflow guard: the quantized builders check int32 overflow
+    against their LOCAL shard's rows, but the psum accumulates GLOBAL sums
+    — a root-level cell can hold up to the total row count.  The grower
+    knows the static global bound, so the check belongs here (review
+    finding: 8 shards x 20M rows each passes every local guard yet wraps
+    the hessian lane after the allreduce)."""
+    if not use_quant or axis_name is None or psum_row_bound is None:
+        return
+    qh_cap = max(1, quant_bins - 1)
+    if int(psum_row_bound) * qh_cap >= (1 << 31):
+        raise ValueError(
+            "quantized histograms overflow int32 after the cross-shard "
+            f"psum above {(1 << 31) // qh_cap} total rows at "
+            f"{quant_bins} quantization bins — lower num_grad_quant_bins "
+            "or disable use_quantized_grad")
 
 class _CatTools:
     """Categorical split machinery shared by both growers: static masks, the
@@ -403,25 +433,26 @@ class _CatTools:
 
 def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
                      params: GBDTParams, axis_name: str = None,
-                     backend: str = "auto"):
+                     backend: str = "auto", psum_row_bound: int = None):
     """Level-wise grower.  Returns grow(binned, grad, hess, hist_mask,
     feat_mask, edges) -> (left_child, right_child, split_feature, threshold,
     threshold_bin, split_gain, internal_value, internal_count, leaf_value,
     leaf_count, leaf_of_row).  With `axis_name`, the function is
     meant to run inside shard_map over row shards: local histograms are
     psum'd over that mesh axis (the LGBM_NetworkInit ring replacement) and
-    all split decisions replicate deterministically across shards."""
+    all split decisions replicate deterministically across shards.
+    ``psum_row_bound`` (sharded only) is the static GLOBAL row count, which
+    lets the quantized path pack grad/hess lanes into one int32 channel for
+    the allreduce when the bound allows (``collectives.histogram_psum``)."""
     import jax
     import jax.numpy as jnp
     from ..models.gbdt import perfect_tree_children
     from ..ops import histogram as hist_ops
+    from ..parallel.collectives import histogram_psum
 
-    def hist(binned, g, h, node, num_nodes, max_rows=None):
-        out = hist_ops.build(binned, g, h, node, num_nodes, num_bins,
-                             backend=backend, max_rows=max_rows)
-        if axis_name is not None:
-            out = jax.lax.psum(out, axis_name)
-        return out
+    use_quant = bool(params.use_quantized_grad)
+    quant_bins = params.num_grad_quant_bins
+    _check_quant_psum_bound(use_quant, quant_bins, axis_name, psum_row_bound)
 
     D, F, B = max_depth, num_features, num_bins
     I = 2 ** D - 1     # internal nodes
@@ -451,6 +482,40 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
 
     def grow(binned, grad, hess, hist_mask, feat_mask, edges):
         n = binned.shape[0]
+        if use_quant:
+            # quantize ONCE per tree: every level's histogram is then an
+            # exact integer function of the same per-row ints, so sibling
+            # subtraction below never leaves integer space
+            qg, qh, g_scale, h_scale = hist_ops.quantize_gradients(
+                grad, hess, quant_bins, seed=params.seed, axis_name=axis_name)
+
+        def build_local(node_a, num_nodes, max_rows=None):
+            if use_quant:
+                return hist_ops.build_quantized(
+                    binned, qg, qh, node_a, num_nodes, num_bins,
+                    quant_bins=quant_bins, backend=backend,
+                    max_rows=max_rows, node_rows_bound=max_rows)
+            return hist_ops.build(binned, grad, hess, node_a, num_nodes,
+                                  num_bins, backend=backend,
+                                  max_rows=max_rows)
+
+        def hist(node_a, num_nodes, max_rows=None):
+            out = build_local(node_a, num_nodes, max_rows=max_rows)
+            if axis_name is not None:
+                out = histogram_psum(out, axis_name,
+                                     row_bound=psum_row_bound,
+                                     quant_bins=quant_bins) \
+                    if use_quant else jax.lax.psum(out, axis_name)
+            return out
+
+        def dehist(h_):
+            # rescale integer sums to (grad, hess, count) floats — applied
+            # only where gains/leaf stats are computed, never to the
+            # subtraction chain
+            if not use_quant:
+                return h_
+            return hist_ops.dequantize_histogram(h_, g_scale, h_scale)
+
         node = jnp.zeros((n,), jnp.int32)          # level-local node, all rows
         split_feature = jnp.full((I,), -1, jnp.int32)
         threshold_bin = jnp.zeros((I,), jnp.int32)
@@ -528,19 +593,15 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
                 # valid on the PRE-psum local histograms (local_right =
                 # local_parent - local_left).
                 if d == 0:
-                    local = hist_ops.build(binned, grad, hess,
-                                           jnp.where(hist_mask, node, -1), 1,
-                                           num_bins, backend=backend)
+                    local = build_local(jnp.where(hist_mask, node, -1), 1)
                 else:
                     left_node = jnp.where(hist_mask & (node % 2 == 0),
                                           node // 2, -1)
-                    left_local = hist_ops.build(binned, grad, hess, left_node,
-                                                nodes_d // 2, num_bins,
-                                                backend=backend)
+                    left_local = build_local(left_node, nodes_d // 2)
                     local = jnp.stack([left_local, prev_hist - left_local],
                                       axis=1).reshape(nodes_d, F, B, 3)
                 prev_hist = local
-                gain_l, _, _ = split_gains(local, feat_mask[None, :],
+                gain_l, _, _ = split_gains(dehist(local), feat_mask[None, :],
                                            edge_finite, cat_b[None, :],
                                            sub_b[None, :])
                 per_feat = gain_l.max(axis=2)        # (nodes, F) local best
@@ -555,7 +616,11 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
                 _, sel = jax.lax.top_k(votes, k2)    # (nodes, k2) global pick
                 sel_hist = jnp.take_along_axis(
                     local, sel[:, :, None, None], axis=1)
-                sel_hist = jax.lax.psum(sel_hist, axis_name)
+                sel_hist = histogram_psum(sel_hist, axis_name,
+                                          row_bound=psum_row_bound,
+                                          quant_bins=quant_bins) \
+                    if use_quant else jax.lax.psum(sel_hist, axis_name)
+                sel_hist = dehist(sel_hist)
                 edge3 = jnp.take_along_axis(
                     jnp.broadcast_to(edge_finite, (nodes_d, F, B)),
                     sel[:, :, None], axis=1)
@@ -565,8 +630,7 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
                 Fs = k2
             else:
                 if d == 0:
-                    hist_d = hist(binned, grad, hess,
-                                  jnp.where(hist_mask, node, -1), 1)
+                    hist_d = hist(jnp.where(hist_mask, node, -1), 1)
                 else:
                     # sibling-subtraction with LightGBM's SMALLER-child rule:
                     # scatter only each parent's smaller child (by the
@@ -581,8 +645,7 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
                     small_node = jnp.where(hist_mask & in_small,
                                            node // 2, -1)
                     cap = None if axis_name is not None else n // 2 + nodes_d
-                    hist_small = hist(binned, grad, hess, small_node,
-                                      nodes_d // 2, max_rows=cap)
+                    hist_small = hist(small_node, nodes_d // 2, max_rows=cap)
                     hist_sib = prev_hist - hist_small
                     sl4 = small_left[:, None, None, None]
                     hist_d = jnp.stack(
@@ -591,9 +654,9 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
                         .reshape(nodes_d, F, B, 3)
                 prev_hist = hist_d
                 gain, pick, (Gp0, Hp0, Cp0) = split_gains(
-                    hist_d, feat_mask[None, :], edge_finite, cat_b[None, :],
-                    sub_b[None, :])
-                hist_for_win = hist_d
+                    dehist(hist_d), feat_mask[None, :], edge_finite,
+                    cat_b[None, :], sub_b[None, :])
+                hist_for_win = dehist(hist_d)
                 sel = None
                 Fs = F
 
@@ -664,7 +727,8 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
 
 def make_leafwise_grower(num_leaves: int, depth_cap: int, num_features: int,
                          num_bins: int, params: GBDTParams,
-                         axis_name: str = None, backend: str = "auto"):
+                         axis_name: str = None, backend: str = "auto",
+                         psum_row_bound: int = None):
     """Leaf-wise (best-first) grower — LightGBM's defining growth algorithm
     (reference exposes ``numLeaves`` default 31, ``LightGBMParams.scala:331``;
     the native engine grows by global best gain).
@@ -689,7 +753,11 @@ def make_leafwise_grower(num_leaves: int, depth_cap: int, num_features: int,
     import jax
     import jax.numpy as jnp
     from ..ops import histogram as hist_ops
+    from ..parallel.collectives import histogram_psum
 
+    use_quant = bool(params.use_quantized_grad)
+    quant_bins = params.num_grad_quant_bins
+    _check_quant_psum_bound(use_quant, quant_bins, axis_name, psum_row_bound)
     L, M, F, B = num_leaves, num_leaves - 1, num_features, num_bins
     ct = _CatTools(params, F, B)
     cat_np, sub_np = ct.cat_np, ct.sub_np
@@ -726,10 +794,31 @@ def make_leafwise_grower(num_leaves: int, depth_cap: int, num_features: int,
             edge_ok = jnp.where(cat_b[:, None],
                                 (jnp.arange(B) != B - 1)[None, :], edge_ok)
 
+        if use_quant:
+            # one quantization per tree — every per-leaf rebuild and every
+            # sibling subtraction below runs on the same per-row integers
+            qg, qh, g_scale, h_scale = hist_ops.quantize_gradients(
+                grad, hess, quant_bins, seed=params.seed, axis_name=axis_name)
+
         def local_hist(mask):
+            if use_quant:
+                return hist_ops.build_quantized(
+                    binned, qg, qh, jnp.where(mask, 0, -1), 1, B,
+                    quant_bins=quant_bins, backend=backend)[0]  # (F, B, 3)
             return hist_ops.build(binned, grad, hess,
                                   jnp.where(mask, 0, -1), 1, B,
                                   backend=backend)[0]          # (F, B, 3)
+
+        def psum_hist(h_):
+            return histogram_psum(h_, axis_name, row_bound=psum_row_bound,
+                                  quant_bins=quant_bins) \
+                if use_quant else jax.lax.psum(h_, axis_name)
+
+        def dehist(h_):
+            # integer sums -> (grad, hess, count) floats at gain time only
+            if not use_quant:
+                return h_
+            return hist_ops.dequantize_histogram(h_, g_scale, h_scale)
 
         def candidate_tables(hist_f3, fmask, depth_ok):
             """(F, B) gains + left-child pick stats from one leaf's (psum'd)
@@ -770,7 +859,10 @@ def make_leafwise_grower(num_leaves: int, depth_cap: int, num_features: int,
 
         def leaf_best(hist_f3, fmask, depth_ok):
             """Best candidate split of one leaf: (gain, feat, bin,
-            left-child (G,H,C), totals, member bitset)."""
+            left-child (G,H,C), totals, member bitset).  Accepts raw (int
+            in quantized mode) histograms and rescales here — gain math
+            always runs on float sums."""
+            hist_f3 = dehist(hist_f3)
             gain, left3, tot = candidate_tables(hist_f3, fmask, depth_ok)
             # edge_ok is sound for subset features too: their position-(B-1)
             # candidate (a prefix of all bins) is invalid regardless
@@ -786,7 +878,8 @@ def make_leafwise_grower(num_leaves: int, depth_cap: int, num_features: int,
             """Voting-parallel per-leaf split finding: rank features by
             LOCAL gain, psum ballots, then psum only the global top-2k
             features' histogram slices (O(k*B) ICI traffic per step)."""
-            gain_l, _, _ = candidate_tables(hist_local_f3, fmask, depth_ok)
+            gain_l, _, _ = candidate_tables(dehist(hist_local_f3), fmask,
+                                            depth_ok)
             gain_l = jnp.where(edge_ok, gain_l, -jnp.inf)
             per_feat = gain_l.max(axis=1)                     # (F,)
             top_gain, top_idx = jax.lax.top_k(per_feat, voting_k)
@@ -795,10 +888,10 @@ def make_leafwise_grower(num_leaves: int, depth_cap: int, num_features: int,
             votes = jax.lax.psum(votes, axis_name)
             k2 = min(2 * voting_k, F)
             _, sel = jax.lax.top_k(votes, k2)                 # (k2,) features
-            sel_hist = jax.lax.psum(hist_local_f3[sel], axis_name)
+            sel_hist = dehist(psum_hist(hist_local_f3[sel]))
             cum = jnp.cumsum(sel_hist, axis=1)
-            tot = jax.lax.psum(
-                jnp.cumsum(hist_local_f3[:1], axis=1)[0, -1, :], axis_name)
+            tot = dehist(psum_hist(
+                jnp.cumsum(hist_local_f3[:1], axis=1)[0, -1, :]))
             left3 = jnp.where(cat_b[sel][:, None, None], sel_hist, cum) \
                 if has_cat else cum
             sub_edge = True
@@ -837,7 +930,7 @@ def make_leafwise_grower(num_leaves: int, depth_cap: int, num_features: int,
             # subtraction then remains exact on local stats); without it the
             # stored histograms are global
             if axis_name is not None and not use_voting:
-                return jax.lax.psum(x, axis_name)
+                return psum_hist(x)
             return x
 
         def depth_ok_of(d):
@@ -860,7 +953,9 @@ def make_leafwise_grower(num_leaves: int, depth_cap: int, num_features: int,
             sg=jnp.zeros((M,), jnp.float32),
             iv=jnp.zeros((M,), jnp.float32),
             ic=jnp.zeros((M,), jnp.float32),
-            hists=jnp.zeros((L, F, B, 3)).at[0].set(h_root),
+            hists=jnp.zeros((L, F, B, 3),
+                            jnp.int32 if use_quant else jnp.float32)
+            .at[0].set(h_root),
             best_gain=jnp.full((L,), -jnp.inf).at[0].set(g0),
             best_feat=jnp.zeros((L,), jnp.int32).at[0].set(f0),
             best_bin=jnp.zeros((L,), jnp.int32).at[0].set(b0),
@@ -941,7 +1036,7 @@ def make_leafwise_grower(num_leaves: int, depth_cap: int, num_features: int,
 
             hl = local_hist(hist_mask & (c["leaf_of_row"] == j))
             if axis_name is not None and not use_voting:
-                hl = jax.lax.psum(hl, axis_name)
+                hl = psum_hist(hl)
             hr = c["hists"][j] - hl
             c["hists"] = set_if(c["hists"], j, hl, do, L)
             c["hists"] = set_if(c["hists"], new_leaf, hr, do, L)
@@ -1151,17 +1246,19 @@ def _resolve_hist_backend() -> tuple:
             os.environ.get("MMLSPARK_TPU_HIST_BLOCK_ROWS", ""),
             os.environ.get("MMLSPARK_TPU_HIST_LO", ""),
             os.environ.get("MMLSPARK_TPU_HIST_RESID", ""),
-            os.environ.get("MMLSPARK_TPU_HIST_LAYOUT", ""))
+            os.environ.get("MMLSPARK_TPU_HIST_LAYOUT", ""),
+            os.environ.get("MMLSPARK_TPU_HIST_QUANT", ""))
 
 
 def _make_grower(p: GBDTParams, F: int, B: int, axis_name: str = None,
-                 backend: str = "auto"):
+                 backend: str = "auto", psum_row_bound: int = None):
     """Growth-mode dispatch (call with resolved params)."""
     if p.growth == "leaf":
         return make_leafwise_grower(p.num_leaves, p.max_depth, F, B, p,
-                                    axis_name=axis_name, backend=backend)
+                                    axis_name=axis_name, backend=backend,
+                                    psum_row_bound=psum_row_bound)
     return make_tree_grower(p.max_depth, F, B, p, axis_name=axis_name,
-                            backend=backend)
+                            backend=backend, psum_row_bound=psum_row_bound)
 
 
 @dataclasses.dataclass
@@ -1220,14 +1317,17 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
     _phase_h = get_registry().histogram(
         "mmlspark_lightgbm_phase_seconds",
         "per-iteration training phase timings (host-side)",
-        labels=("phase",))
+        labels=("phase", "backend", "quantized"))
     _phase_totals: Dict[str, float] = {}
 
     def _observe_phase(phase: str, seconds: float, times: int = 1) -> None:
         # exemplar: every phase bucket keeps the training trace id, so a
-        # slow-iteration outlier on /metrics resolves to this fit's trace
+        # slow-iteration outlier on /metrics resolves to this fit's trace;
+        # backend/quantized labels make A/B runs attributable on /metrics
         for _ in range(times):
-            _phase_h.observe(seconds, _train_span.trace_id, phase=phase)
+            _phase_h.observe(seconds, _train_span.trace_id, phase=phase,
+                             backend=_eff_backend,
+                             quantized="1" if p.use_quantized_grad else "0")
         _phase_totals[phase] = _phase_totals.get(phase, 0.0) + seconds * times
 
     _parent_span = current_span()
@@ -1237,6 +1337,21 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
         parent_id=_parent_span.span_id if _parent_span else None)
 
     p = params.resolve()
+    # histogram backend + quantization resolution, up front so every phase
+    # observation below carries the effective (backend, quantized) labels.
+    # All env knobs are read at trace time and key the jit caches.
+    hist_cfg = _resolve_hist_backend()
+    hist_backend = hist_cfg[0]
+    _eff_backend = hist_backend if hist_backend != "auto" else \
+        ("scatter" if jax.default_backend() == "cpu" else "matmul")
+    _uq = p.use_quantized_grad
+    if hist_cfg[5].strip():              # MMLSPARK_TPU_HIST_QUANT=0/1
+        # case-insensitive: an operator's QUANT=OFF during an incident must
+        # never fail open into force-ENABLING the feature
+        _uq = hist_cfg[5].strip().lower() not in ("0", "false", "off", "no")
+    if _uq is None:                      # auto: packed ints on accelerators
+        _uq = jax.default_backend() != "cpu"
+    p = dataclasses.replace(p, use_quantized_grad=bool(_uq))
     rng = np.random.default_rng(p.seed)
     X = np.asarray(X, np.float32)
     y = np.asarray(y, np.float32)
@@ -1296,8 +1411,6 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
                 sub.append(int(f_i))
         p = dataclasses.replace(p, cat_subset=tuple(sub))
 
-    hist_cfg = _resolve_hist_backend()
-    hist_backend = hist_cfg[0]
     sig = _params_sig(p) + (hist_cfg,)
     if shard_rows:
         from jax.sharding import PartitionSpec as P
@@ -1317,14 +1430,18 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
 
         # explicit SPMD: each shard builds local histograms, psum over ICI
         def _build_sharded():
+            # psum_row_bound = GLOBAL padded rows: the quantized path sizes
+            # its packed allreduce lanes from it, so it is baked into the
+            # closure — hence n in the cache key below
             grow_raw = _make_grower(p, F, B, axis_name=AXIS_DATA,
-                                    backend=hist_backend)
+                                    backend=hist_backend, psum_row_bound=n)
             return jax.jit(jax.shard_map(
                 grow_raw, mesh=mesh,
                 in_specs=(P(AXIS_DATA), P(AXIS_DATA), P(AXIS_DATA), P(AXIS_DATA),
                           P(), P()),
                 out_specs=(P(),) * 11 + (P(AXIS_DATA),), check_vma=False))
-        grower = _cached(("sharded_grower", sig, F, id(mesh)), _build_sharded)
+        grower = _cached(("sharded_grower", sig, F, id(mesh), n),
+                         _build_sharded)
     else:
         # the 200MB-at-bench-shape uint8 device put rides the memo too: the
         # device buffer is immutable to the trainer, so reuse is safe

@@ -79,6 +79,15 @@ def _shared_params(cls):
          "sorted-subset categorical splits", "double", 10.0),
         ("max_cat_threshold", "max categories on the smaller side of a "
          "sorted-subset split", "int", 32),
+        ("use_quantized_grad", "quantized training (LightGBM 4.x): "
+         "stochastically round per-row grad/hess to integer levels once "
+         "per iteration and build packed integer histograms, rescaling "
+         "only at split-gain time; unset = auto (on for accelerator "
+         "backends, off on CPU; MMLSPARK_TPU_HIST_QUANT=0/1 overrides)",
+         "bool", None),
+        ("num_grad_quant_bins", "quantization levels for grad/hess under "
+         "quantized training (reference name; 4-128, reference default 4 — "
+         "16 here holds every repo accuracy gate)", "int", 16),
     ]
     for name, doc, dtype, default in specs:
         setattr(cls, name, Param(name, doc, dtype, default))
@@ -131,7 +140,9 @@ class _LightGBMBase(Estimator, HasFeaturesCol, HasLabelCol, HasWeightCol):
             cat_smooth=self.get("cat_smooth"), cat_l2=self.get("cat_l2"),
             max_cat_threshold=self.get("max_cat_threshold"),
             voting_k=self.get("top_k")
-            if self.get("parallelism") == "voting_parallel" else 0)
+            if self.get("parallelism") == "voting_parallel" else 0,
+            use_quantized_grad=self.get("use_quantized_grad"),
+            num_grad_quant_bins=self.get("num_grad_quant_bins"))
         return p
 
     def _collect_xyw(self, df: DataFrame):

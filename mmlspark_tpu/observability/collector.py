@@ -40,11 +40,22 @@ from typing import Any, Callable, Deque, Dict, List, Optional
 
 from .metrics import MetricsRegistry, get_registry
 
-__all__ = ["SpanCollector", "get_collector", "OTLP_ENDPOINT_ENV"]
+__all__ = ["SpanCollector", "get_collector", "OTLP_ENDPOINT_ENV",
+           "OTLP_SAMPLE_ENV", "OTLP_SLOW_S_ENV"]
 
 #: env knob enabling span export (off when unset/empty).  ``http(s)://``
 #: values POST OTLP/JSON; ``file://<path>`` appends one payload per line.
 OTLP_ENDPOINT_ENV = "MMLSPARK_TPU_OTLP_ENDPOINT"
+
+#: tail-sampling mode: ``slow_error`` keeps only slow (>= the threshold
+#: below) or non-ok spans AT EXPORT TIME — the ring (and with it
+#: ``/trace/<id>`` + ``/debug/slow``) always sees everything; only the
+#: exporter's egress shrinks.  Unset/empty = export every span.
+OTLP_SAMPLE_ENV = "MMLSPARK_TPU_OTLP_SAMPLE"
+
+#: duration (seconds, float) at which a span counts as slow for
+#: tail-sampling; default 0.25.
+OTLP_SLOW_S_ENV = "MMLSPARK_TPU_OTLP_SLOW_S"
 
 
 def _otlp_value(v: Any) -> Dict[str, Any]:
@@ -73,7 +84,9 @@ class SpanCollector:
                  batch_size: int = 128, flush_interval_s: float = 2.0,
                  breaker=None, http_timeout_s: float = 5.0,
                  transport=None, epoch_offset_s: Optional[float] = None,
-                 service_name: str = "mmlspark_tpu"):
+                 service_name: str = "mmlspark_tpu",
+                 sample_mode: Optional[str] = None,
+                 slow_threshold_s: Optional[float] = None):
         self.registry = registry if registry is not None else get_registry()
         self.clock = clock
         self.capacity = max(1, int(capacity))
@@ -87,6 +100,16 @@ class SpanCollector:
             endpoint = os.environ.get(OTLP_ENDPOINT_ENV, "")
         self.endpoint = endpoint or ""
         self.exporting = bool(self.endpoint)
+        if sample_mode is None:
+            sample_mode = os.environ.get(OTLP_SAMPLE_ENV, "")
+        if sample_mode not in ("", "slow_error"):
+            raise ValueError(f"unknown {OTLP_SAMPLE_ENV} mode "
+                             f"{sample_mode!r}; expected 'slow_error'")
+        self.sample_mode = sample_mode
+        if slow_threshold_s is None:
+            slow_threshold_s = float(
+                os.environ.get(OTLP_SLOW_S_ENV, "") or 0.25)
+        self.slow_threshold_s = float(slow_threshold_s)
         self._file_sink = self.endpoint[len("file://"):] \
             if self.endpoint.startswith("file://") else None
         if epoch_offset_s is None:
@@ -219,16 +242,34 @@ class SpanCollector:
             while self.flush_now() and not self._stop.is_set():
                 pass
 
+    def _sample(self, span) -> bool:
+        """Tail-sampling verdict at export time: keep non-ok spans and
+        spans at/over the slow threshold; everything else is sampled out
+        (counted, never sent).  Ring queries are unaffected."""
+        if self.sample_mode != "slow_error":
+            return True
+        return span.status != "ok" or span.duration_s >= self.slow_threshold_s
+
     def flush_now(self) -> int:
         """Drain up to ``batch_size`` spans and export one payload.
         Returns the number of spans attempted (0 = queue empty).  A failed
         batch is dropped and counted — a dead sink must never make the
-        queue (or anything upstream of it) grow without bound."""
+        queue (or anything upstream of it) grow without bound.  With
+        tail-sampling on, fast-ok spans drain from the queue but are
+        dropped (``mmlspark_otlp_sampled_out_total``) before
+        serialization, so a healthy system exports ~nothing."""
         with self._lock:
             batch = [self._export_q.popleft()
                      for _ in range(min(self.batch_size, len(self._export_q)))]
         if not batch:
             return 0
+        drained = len(batch)
+        kept = [s for s in batch if self._sample(s)]
+        if len(kept) < drained:
+            self._m["sampled_out"].inc(drained - len(kept))
+        if not kept:
+            return drained          # queue drained; nothing crossed the wire
+        batch = kept
         payload = self.to_otlp(batch)
         t0 = self.clock()
         try:
@@ -239,7 +280,7 @@ class SpanCollector:
         result = "ok" if ok else "fail"
         self._m[f"batches_{result}"].inc()
         self._m[f"spans_{result}"].inc(len(batch))
-        return len(batch)
+        return drained
 
     def _send(self, payload: Dict[str, Any]) -> bool:
         if self._file_sink is not None:

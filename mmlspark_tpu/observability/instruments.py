@@ -71,7 +71,10 @@ def instrument_collector(collector, registry: Optional[MetricsRegistry] = None
     - ``mmlspark_otlp_flush_seconds`` — per-flush latency (serialize +
       sink write, breaker short-circuits included);
     - ``mmlspark_otlp_export_queue_depth`` — callback gauge, sampled at
-      scrape time.
+      scrape time;
+    - ``mmlspark_otlp_sampled_out_total`` — spans dropped by tail-sampling
+      at export time (``MMLSPARK_TPU_OTLP_SAMPLE=slow_error``): drained
+      from the queue but never serialized or sent.
 
     Returns the bound children keyed by the names the collector's hot and
     flush paths use (children resolved once, never per call).  The
@@ -96,6 +99,10 @@ def instrument_collector(collector, registry: Optional[MetricsRegistry] = None
         "flush_seconds": reg.histogram(
             "mmlspark_otlp_flush_seconds",
             "span export flush latency").labels(),
+        "sampled_out": reg.counter(
+            "mmlspark_otlp_sampled_out_total",
+            "spans dropped by slow_error tail-sampling at export "
+            "time").labels(),
     }
     reg.gauge("mmlspark_otlp_export_queue_depth",
               "spans buffered for export").set_function(

@@ -113,11 +113,16 @@ def bin_matrix(x: jnp.ndarray, edges: jnp.ndarray, num_bins: int) -> jnp.ndarray
 # ---------------------------------------------------------------------------
 
 def _node_pure_layout(binned, grad, hess, node_ids, num_nodes, R,
-                      sample_weight=None, residuals=True, max_rows=None):
+                      sample_weight=None, residuals=True, max_rows=None,
+                      quantized=False):
     """Shared host/device prep for the MXU histogram backend:
     sort rows by node and pad so every R-row block is node-pure, then build
     the bf16x2-decomposed weight channels (``residuals=False`` keeps just
     bf16-rounded grad/hess + count — 3 channels instead of 5).
+
+    With ``quantized=True``, ``grad``/``hess`` are the pre-quantized int
+    gradients and the weight channels come back as **int8**
+    (qg, qh, valid) — the packed-histogram operand layout.
 
     Returns (bb_all (N_pad, F) u8, w_ch (5 or 3, N_pad) f32, node_blk (NB,)
     i32, NB).  Masked rows (node < 0) land in dummy node P whose buffer is
@@ -139,11 +144,15 @@ def _node_pure_layout(binned, grad, hess, node_ids, num_nodes, R,
 
     n, F = binned.shape
     P = num_nodes
-    g = grad.astype(jnp.float32)
-    h = hess.astype(jnp.float32)
+    if quantized:
+        g = grad.astype(jnp.int32)
+        h = hess.astype(jnp.int32)
+    else:
+        g = grad.astype(jnp.float32)
+        h = hess.astype(jnp.float32)
+        if sample_weight is not None:
+            g, h = g * sample_weight, h * sample_weight
     c = jnp.ones_like(g)  # counts stay unweighted (min_data_in_leaf semantics)
-    if sample_weight is not None:
-        g, h = g * sample_weight, h * sample_weight
 
     import os as _os
     node_s = jnp.where(node_ids < 0, P, node_ids).astype(jnp.int32)
@@ -199,6 +208,13 @@ def _node_pure_layout(binned, grad, hess, node_ids, num_nodes, R,
     valid = (padded_idx >= 0)
     safe_idx = jnp.maximum(padded_idx, 0)
     bb_all = binned[safe_idx]                        # (N_pad, F) uint8
+    if quantized:
+        # int8 operand lanes: |qg| <= 64 and qh <= 127 by the quant_bins
+        # cap, so the per-row values are exact; accumulation is int32
+        vi = valid.astype(jnp.int32)
+        w_ch = jnp.stack([g[safe_idx] * vi, h[safe_idx] * vi, vi],
+                         axis=0).astype(jnp.int8)               # (3, N_pad)
+        return bb_all, w_ch, node_blk, NB
     # bf16x2 decomposition for the MXU inputs: grad/hess are signed and
     # cancellation-sensitive, so each carries a bf16 residual channel; counts
     # (small ints) are exact in bf16.  Accumulation itself is f32 on the MXU.
@@ -305,6 +321,287 @@ def build_histograms_matmul(binned: jnp.ndarray, grad: jnp.ndarray,
         acc3 = jnp.moveaxis(acc, 2, 0)
     hist = acc3.reshape(3, P, F, HI * LO)[..., :B]                     # (3,P,F,B)
     return jnp.moveaxis(hist, 0, -1)                                    # (P,F,B,3)
+
+
+# ---------------------------------------------------------------------------
+# quantized-gradient packed histograms (LightGBM 4.x quantized training)
+# ---------------------------------------------------------------------------
+#
+# "Quantized Training of Gradient Boosting Decision Trees": per-row grad/hess
+# quantize ONCE PER ITERATION to low-bit integers with stochastic rounding and
+# per-iteration scale factors; the histogram build then accumulates packed
+# integers instead of three f32 channels, and split gains are computed from
+# the rescaled integer sums.  Because every level of a tree reuses the SAME
+# per-row integers, sibling subtraction (right = parent - left) is EXACT in
+# integer space — no f32 cancellation drift between levels.
+
+def quantize_gradients(grad, hess, quant_bins: int, seed: int = 0,
+                       axis_name: Optional[str] = None):
+    """Stochastically round per-row grad/hess to small signed/unsigned ints.
+
+    Returns ``(qg, qh, g_scale, h_scale)`` with ``qg`` in
+    ``[-quant_bins//2, quant_bins//2]`` (int32), ``qh`` in
+    ``[0, quant_bins - 1]`` (int32), and ``E[qg * g_scale] == grad`` /
+    ``E[qh * h_scale] == hess`` (stochastic rounding is unbiased:
+    ``floor(x + u)``, ``u ~ U[0, 1)``).  Scales are per-call (one boosting
+    iteration); with ``axis_name`` they are ``pmax``'d over the mesh so
+    every shard quantizes in the SAME units and the psum'd integer
+    histograms stay meaningful.
+
+    The rounding noise needs no host RNG plumbing: the PRNG key folds in a
+    bitcast of the gradient sum, which changes every iteration (the scores
+    moved), decorrelating rounding patterns across iterations while staying
+    deterministic and tracer-safe.
+    """
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jrandom
+
+    g = grad.astype(jnp.float32)
+    h = hess.astype(jnp.float32)
+    qg_cap = max(1, quant_bins // 2)
+    qh_cap = max(1, quant_bins - 1)
+    gmax = jnp.max(jnp.abs(g))
+    hmax = jnp.max(h)
+    if axis_name is not None:
+        gmax = jax.lax.pmax(gmax, axis_name)
+        hmax = jax.lax.pmax(hmax, axis_name)
+    g_scale = jnp.maximum(gmax, 1e-12) / qg_cap
+    h_scale = jnp.maximum(hmax, 1e-12) / qh_cap
+    mix = jax.lax.bitcast_convert_type(
+        jnp.sum(g) + 3.0 * jnp.sum(h), jnp.int32)
+    key = jrandom.fold_in(jrandom.PRNGKey(seed), mix)
+    u = jrandom.uniform(key, (2,) + g.shape)
+    qg = jnp.clip(jnp.floor(g / g_scale + u[0]),
+                  -qg_cap, qg_cap).astype(jnp.int32)
+    qh = jnp.clip(jnp.floor(h / h_scale + u[1]),
+                  0, qh_cap).astype(jnp.int32)
+    return qg, qh, g_scale, h_scale
+
+
+def dequantize_histogram(hist_i32, g_scale, h_scale):
+    """(..., 3) int32 [sum_qg, sum_qh, count] -> (..., 3) f32
+    [sum_grad, sum_hess, count] — the rescale applied at split-gain time."""
+    import jax.numpy as jnp
+    f = hist_i32.astype(jnp.float32)
+    return jnp.stack([f[..., 0] * g_scale, f[..., 1] * h_scale, f[..., 2]],
+                     axis=-1)
+
+
+def _packed_layout(bound: int, quant_bins: int):
+    """Static lane plan for the scatter backend's int32 accumulation.
+
+    ``bound`` is the max rows any single (node, feature, bin) cell can
+    receive (== max rows per node).  The widest layout that still fits 31
+    bits wins — bit-width WIDENING as node row counts grow:
+
+    - ``all3``: grad, hess AND count share ONE int32 channel
+      (1 segment-sum instead of 3 — the deep-level / many-node regime);
+    - ``2ch``: grad alone + (hess, count) packed in the hessian lane's
+      spare bits (2 segment-sums);
+    - ``wide``: three separate int32 channels (root-scale nodes; exact for
+      any n with ``n * (quant_bins - 1) < 2**31``).
+    """
+    qg_cap = max(1, quant_bins // 2)
+    qh_cap = max(1, quant_bins - 1)
+    cbits = bound.bit_length()
+    hbits = (bound * qh_cap).bit_length()
+    gbits = (bound * qg_cap).bit_length()
+    if cbits + hbits + gbits <= 31:
+        return "all3", cbits, hbits
+    if cbits + hbits <= 31:
+        return "2ch", cbits, hbits
+    return "wide", cbits, hbits
+
+
+def build_histograms_quantized(binned: jnp.ndarray, qg: jnp.ndarray,
+                               qh: jnp.ndarray, node_ids: jnp.ndarray,
+                               num_nodes: int, num_bins: int,
+                               quant_bins: int = 16,
+                               node_rows_bound: Optional[int] = None,
+                               max_rows: Optional[int] = None) -> jnp.ndarray:
+    """Packed-integer scatter build: one int32 segment-sum pass instead of
+    three f32 ones whenever the static ``node_rows_bound`` lets the lanes
+    coexist (see ``_packed_layout``).
+
+    Args mirror ``build_histograms`` except grad/hess arrive pre-quantized
+    (``quantize_gradients``).  ``node_rows_bound`` is a STATIC caller
+    guarantee on the max rows any node receives; like ``max_rows`` it is a
+    trace-time contract — a violated bound silently corrupts lanes, so
+    callers must pass a true bound (or None for the safe n default).
+
+    Returns (num_nodes, F, B, 3) **int32**: [sum_qg, sum_qh, count].
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n, F = binned.shape
+    B = num_bins
+    S = num_nodes * F * B
+    node = node_ids.astype(jnp.int32)
+    qg = qg.astype(jnp.int32)
+    qh = qh.astype(jnp.int32)
+    bound = max(1, min(n, int(node_rows_bound or n), int(max_rows or n)))
+    qh_cap = max(1, quant_bins - 1)
+    if n * qh_cap >= (1 << 31):
+        raise ValueError("quantized histograms overflow int32 above "
+                         f"{(1 << 31) // qh_cap} rows at {quant_bins} bins")
+    mode, cbits, hbits = _packed_layout(bound, quant_bins)
+    KC, KH = 1 << cbits, 1 << hbits
+    if mode == "all3":
+        chans = [((qg * KH) + qh) * KC + 1]
+    elif mode == "2ch":
+        chans = [qg, qh * KC + 1]
+    else:
+        chans = [qg, qh, jnp.ones_like(qg)]
+
+    chunk = max(1024, min(n, (1 << 23) // max(F, 1)))
+    n_pad = -n % chunk
+    if n_pad:
+        node = jnp.concatenate([node, jnp.full((n_pad,), -1, jnp.int32)])
+        b_mat = jnp.concatenate([binned, jnp.zeros((n_pad, F), binned.dtype)])
+        chans = [jnp.concatenate([c, jnp.zeros((n_pad,), jnp.int32)])
+                 for c in chans]
+    else:
+        b_mat = binned
+    R = (n + n_pad) // chunk
+    f_idx = jnp.arange(F, dtype=jnp.int32)[None, :]
+    nc = len(chans)
+
+    def body(acc, args):
+        b_c, node_c = args[0], args[-1]
+        seg = ((node_c[:, None] * F + f_idx) * B + b_c.astype(jnp.int32)).reshape(-1)
+        sums = [jax.ops.segment_sum(
+            jnp.broadcast_to(x[:, None], (chunk, F)).reshape(-1), seg,
+            num_segments=S) for x in args[1:-1]]
+        return tuple(a + s for a, s in zip(acc, sums)), None
+
+    init = (jnp.zeros((S,), jnp.int32),) * nc
+    acc, _ = jax.lax.scan(
+        body, init,
+        (b_mat.reshape(R, chunk, F),
+         *[c.reshape(R, chunk) for c in chans],
+         node.reshape(R, chunk)))
+    if mode == "all3":
+        s = acc[0]
+        count = s % KC                   # lane terms above are multiples of
+        s2 = (s - count) // KC           # KC/KH, so floor mod/div decode
+        qh_s = s2 % KH                   # exactly (negative sums included)
+        qg_s = (s2 - qh_s) // KH
+    elif mode == "2ch":
+        qg_s = acc[0]
+        count = acc[1] % KC
+        qh_s = (acc[1] - count) // KC
+    else:
+        qg_s, qh_s, count = acc
+    return jnp.stack([qg_s, qh_s, count], axis=-1).reshape(
+        num_nodes, F, B, 3)
+
+
+def build_histograms_matmul_quantized(binned: jnp.ndarray, qg: jnp.ndarray,
+                                      qh: jnp.ndarray, node_ids: jnp.ndarray,
+                                      num_nodes: int, num_bins: int,
+                                      quant_bins: int = 16,
+                                      block_rows: int = 4096,
+                                      lo_width: int = 0,
+                                      max_rows: Optional[int] = None
+                                      ) -> jnp.ndarray:
+    """Packed-integer MXU build: the bandwidth lever on TPU.
+
+    Same node-pure block layout as ``build_histograms_matmul``, but the
+    weighted one-hot operands are **int8** (quantized values fit int8 up to
+    128 quantization levels) and the einsum accumulates **int32** on the
+    MXU's integer path.  Operand traffic per (row, feature) drops from
+    ``2*(5*HI + LO)`` bytes (bf16, residual channels) to ``3*HI + LO``
+    bytes — the ~3x hot-kernel bandwidth cut — and per-block integer sums
+    are exact, so cross-level sibling subtraction is too.
+
+    Returns (num_nodes, F, B, 3) **int32**: [sum_qg, sum_qh, count].
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n, F = binned.shape
+    B = num_bins
+    if B > 256:
+        raise ValueError("matmul backend supports max_bin <= 256")
+    if quant_bins > 128:
+        raise ValueError("int8 operand lanes cap num_grad_quant_bins at 128")
+    qh_cap = max(1, quant_bins - 1)
+    if n * qh_cap >= (1 << 31):
+        raise ValueError("quantized histograms overflow int32 above "
+                         f"{(1 << 31) // qh_cap} rows at {quant_bins} bins")
+    LO = lo_width or 16
+    if LO not in (16, 32, 64, 128):
+        raise ValueError("lo_width must be one of 16/32/64/128")
+    HI = (B + LO - 1) // LO
+    shift = LO.bit_length() - 1
+    P = num_nodes
+    R = min(block_rows, max(256, 1 << max(0, (n - 1)).bit_length()))
+
+    bb_all, w_ch, node_blk, NB = _node_pure_layout(
+        binned, qg, qh, node_ids, num_nodes, R, quantized=True,
+        max_rows=max_rows)
+    C = 3                                            # qg, qh, count
+
+    hi_iota = jnp.arange(HI, dtype=jnp.int32)
+    lo_iota = jnp.arange(LO, dtype=jnp.int32)
+
+    def body(acc, args):
+        bb, w, nb = args                             # (R,F) u8, (C,R) i8, ()
+        b32 = bb.astype(jnp.int32)
+        hi = b32 >> shift
+        lo = b32 & (LO - 1)
+        onehot_lo = (lo[:, :, None] == lo_iota).astype(jnp.int8)       # (R,F,LO)
+        onehot_hi = (hi[:, :, None] == hi_iota).astype(jnp.int8)       # (R,F,HI)
+        a = onehot_hi[:, :, None, :] * w.T[:, None, :, None]           # (R,F,C,HI)
+        a = a.reshape(R, F, C * HI)
+        blk = jnp.einsum("rfm,rfl->fml", a, onehot_lo,
+                         preferred_element_type=jnp.int32)             # (F,C*HI,LO)
+        return acc.at[nb].add(blk), None
+
+    acc0 = jnp.zeros((P + 1, F, C * HI, LO), jnp.int32)
+    acc, _ = jax.lax.scan(
+        body, acc0,
+        (bb_all.reshape(NB, R, F),
+         jnp.moveaxis(w_ch.reshape(C, NB, R), 1, 0), node_blk))
+    acc = acc[:P].reshape(P, F, C, HI, LO)
+    hist = jnp.moveaxis(acc, 2, 0).reshape(3, P, F, HI * LO)[..., :B]
+    return jnp.moveaxis(hist, 0, -1)                                   # (P,F,B,3)
+
+
+def build_quantized(binned, qg, qh, node_ids, num_nodes, num_bins,
+                    quant_bins: int = 16, backend: str = "auto",
+                    max_rows=None, node_rows_bound=None):
+    """Quantized-path backend dispatcher, mirroring ``build``: 'auto' picks
+    the int8 MXU build on accelerators and the packed int32 scatter on CPU;
+    ``MMLSPARK_TPU_HIST_BACKEND`` overrides only when the caller did not
+    request a specific backend.  Returns int32 (nodes, F, B, 3)
+    [sum_qg, sum_qh, count] — rescale with ``dequantize_histogram``."""
+    import os
+    if backend == "auto":
+        backend = os.environ.get("MMLSPARK_TPU_HIST_BACKEND", backend)
+    if backend == "pallas":
+        raise ValueError(
+            "the Pallas histogram backend was retired in round 5 (see "
+            "PARITY.md) — use backend='matmul' or 'scatter'")
+    if backend == "auto":
+        backend = "scatter" if jax.default_backend() == "cpu" else "matmul"
+    if backend == "matmul":
+        kw = {}
+        block_rows = int(os.environ.get("MMLSPARK_TPU_HIST_BLOCK_ROWS", "0"))
+        if block_rows:
+            kw["block_rows"] = block_rows
+        lo = int(os.environ.get("MMLSPARK_TPU_HIST_LO", "0"))
+        if lo:
+            kw["lo_width"] = lo
+        return build_histograms_matmul_quantized(
+            binned, qg, qh, node_ids, num_nodes, num_bins,
+            quant_bins=quant_bins, max_rows=max_rows, **kw)
+    return build_histograms_quantized(
+        binned, qg, qh, node_ids, num_nodes, num_bins,
+        quant_bins=quant_bins, node_rows_bound=node_rows_bound,
+        max_rows=max_rows)
 
 
 def build(binned, grad, hess, node_ids, num_nodes, num_bins,

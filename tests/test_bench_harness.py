@@ -61,3 +61,45 @@ def test_trailing_line_without_newline_is_still_parsed():
         "sys.stdout.flush()\n")
     got = bench._collect_multi(proc, ("MARK_A",), idle=10, hard=20)
     assert got.get("MARK_A") == [3.25]
+
+
+def test_health_gate_retries_once_then_succeeds():
+    # BENCH_r05: one silent health child wrote off every TPU phase while
+    # the relay was actually fine — the gate must give it a second chance
+    attempts = []
+
+    def spawn():
+        attempts.append(1)
+        if len(attempts) == 1:   # first child dies without the marker
+            return _child("print('no marker here')")
+        return _child("print('HEALTH_OK 256.0')")
+
+    ok, used = bench._health_gate(spawn=spawn, idle=10, hard=20)
+    assert ok and used == 2 and len(attempts) == 2
+
+
+def test_health_gate_gives_up_after_two_attempts():
+    def spawn():
+        return _child("print('still no marker')")
+
+    ok, used = bench._health_gate(spawn=spawn, idle=10, hard=20)
+    assert not ok and used == 2
+
+
+def test_hist_ab_markers_fold_into_extras():
+    proc = _child(
+        "print('HIST_AB_RATES 1000.0 2500.0 2.5')\n"
+        "print('HIST_AB_MODE cpu_scatter_proxy 120000 50')\n")
+    got = bench._collect_multi(proc, ("HIST_AB_RATES", "HIST_AB_MODE"),
+                               idle=10, hard=20)
+    bench.RESULT["extras"].clear()
+    try:
+        assert bench._record_hist_ab(got)
+        ex = bench.RESULT["extras"]
+        assert ex["hist_ab_packed_speedup"] == 2.5
+        assert ex["hist_ab_f32_rows_per_sec"] == 1000.0
+        assert ex["hist_ab_mode"] == "cpu_scatter_proxy"
+        assert ex["hist_ab_shape"] == "120000x50"
+        assert not bench._record_hist_ab({})   # absent markers -> False
+    finally:
+        bench.RESULT["extras"].clear()

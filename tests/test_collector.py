@@ -360,3 +360,61 @@ def test_hung_sink_never_blocks_the_scoring_path():
         release.set()
         srv.stop()
         coll.stop(drain=False)
+
+
+# ------------------------------------------------- tail-sampling (slow_error)
+
+def test_tail_sampling_keeps_only_slow_and_error_spans(tmp_path):
+    clk = FakeClock()
+    reg = MetricsRegistry(clock=clk)
+    path = tmp_path / "sampled.jsonl"
+    coll = SpanCollector(registry=reg, clock=clk, epoch_offset_s=0.0,
+                         endpoint=f"file://{path}", batch_size=16,
+                         sample_mode="slow_error", slow_threshold_s=0.1)
+    coll.stop(drain=False)  # deterministic: flush by hand
+    coll.record(_span("fast_ok", "t1", clk, 0.0, 0.01))       # sampled out
+    coll.record(_span("slow_ok", "t2", clk, 0.0, 0.5))        # kept: slow
+    err = Span("fast_err", trace_id="t3", clock=clk, start_s=0.0)
+    err.status = "error: boom"
+    err.finish(0.02)
+    coll.record(err)                                          # kept: error
+    assert coll.flush_now() == 3          # whole batch drained from queue
+    spans = [s for l in path.read_text().splitlines()
+             for s in json.loads(l)["resourceSpans"][0]["scopeSpans"][0]["spans"]]
+    assert sorted(x["name"] for x in spans) == ["fast_err", "slow_ok"]
+    assert reg.counter("mmlspark_otlp_sampled_out_total").labels().value == 1
+    # the RING still answers for the sampled-out trace — only egress shrank
+    assert len(coll.trace("t1")) == 1
+
+
+def test_tail_sampling_all_fast_batch_sends_nothing(tmp_path):
+    clk = FakeClock()
+    reg = MetricsRegistry(clock=clk)
+    path = tmp_path / "nothing.jsonl"
+    coll = SpanCollector(registry=reg, clock=clk, epoch_offset_s=0.0,
+                         endpoint=f"file://{path}", batch_size=8,
+                         sample_mode="slow_error", slow_threshold_s=0.1)
+    coll.stop(drain=False)
+    for i in range(5):
+        coll.record(_span(f"s{i}", f"t{i}", clk, 0.0, 0.001))
+    assert coll.flush_now() == 5          # queue drains...
+    assert coll.queue_depth() == 0
+    assert not path.exists()              # ...but nothing crossed the wire
+    assert reg.counter("mmlspark_otlp_sampled_out_total").labels().value == 5
+    spans_fam = reg.counter("mmlspark_otlp_export_spans_total",
+                            labels=("result",))
+    assert spans_fam.value(result="ok") == 0
+
+
+def test_tail_sampling_env_knob_drives_construction(tmp_path, monkeypatch):
+    monkeypatch.setenv("MMLSPARK_TPU_OTLP_SAMPLE", "slow_error")
+    monkeypatch.setenv("MMLSPARK_TPU_OTLP_SLOW_S", "0.2")
+    clk = FakeClock()
+    reg = MetricsRegistry(clock=clk)
+    coll = SpanCollector(registry=reg, clock=clk, endpoint="",
+                         epoch_offset_s=0.0)
+    assert coll.sample_mode == "slow_error"
+    assert coll.slow_threshold_s == 0.2
+    monkeypatch.setenv("MMLSPARK_TPU_OTLP_SAMPLE", "bogus")
+    with pytest.raises(ValueError):
+        SpanCollector(registry=MetricsRegistry(), clock=clk, endpoint="")

@@ -43,7 +43,8 @@ def test_collector_public_surface_is_instrumented():
     # instrument_collector)
     for needle in ('_m["ring_dropped"]', '_m["spans_dropped"]'):
         assert needle in record_src, f"record() lost {needle}"
-    for needle in ('_m["flush_seconds"]', 'batches_', 'spans_'):
+    for needle in ('_m["flush_seconds"]', 'batches_', 'spans_',
+                   '_m["sampled_out"]'):
         assert needle in flush_src, f"flush_now() lost {needle}"
 
     reg = MetricsRegistry()
@@ -52,9 +53,33 @@ def test_collector_public_surface_is_instrumented():
                    "mmlspark_otlp_export_spans_total",
                    "mmlspark_otlp_export_batches_total",
                    "mmlspark_otlp_flush_seconds",
-                   "mmlspark_otlp_export_queue_depth"):
+                   "mmlspark_otlp_export_queue_depth",
+                   "mmlspark_otlp_sampled_out_total"):
         assert reg.family(family) is not None, \
             f"instrument_collector no longer registers {family}"
+
+
+def test_lightgbm_phase_histogram_carries_backend_and_quant_labels():
+    """A/B attribution contract: every lightgbm training phase observation
+    — including the packed quantized-histogram path, which is just another
+    backend/quantized label pair on the SAME family — must book
+    ``mmlspark_lightgbm_phase_seconds`` with (phase, backend, quantized)
+    labels.  Source-level like the stage sweep: a refactor that books the
+    packed path into a different family (or drops the labels) would make
+    packed-vs-f32 runs unattributable on /metrics."""
+    from mmlspark_tpu.lightgbm import core as gbdt_core
+
+    src = inspect.getsource(gbdt_core.train)
+    assert '"mmlspark_lightgbm_phase_seconds"' in src
+    assert 'labels=("phase", "backend", "quantized")' in src, \
+        "phase histogram lost its backend/quantized labels"
+    assert "backend=_eff_backend" in src and "quantized=" in src, \
+        "_observe_phase no longer books the resolved backend/quantization"
+    # the quantized path must ride the same phase bookkeeping: the fused
+    # iteration (histogram build included) books histogram_split_update
+    # regardless of backend, so the only way to lose the packed phase is
+    # to lose the labels above or the observation below
+    assert src.count('_observe_phase("histogram_split_update"') >= 2
 
 
 def test_every_stage_routes_verbs_through_log_verb():
