@@ -32,6 +32,12 @@ _TRACING_ENTRY_POINTS = {
     "jax.lax.scan", "lax.scan", "jax.lax.while_loop", "lax.while_loop",
     "jax.lax.fori_loop", "lax.fori_loop", "jax.lax.cond", "lax.cond",
     "jax.lax.map", "lax.map",
+    # observability/compute.py's jax.jit drop-in: sites routed through it
+    # (the compute-plane telemetry contract) keep their TRC coverage —
+    # every from-import depth of the canonical path resolves here
+    "instrumented_jit", "compute.instrumented_jit",
+    "observability.compute.instrumented_jit",
+    "mmlspark_tpu.observability.compute.instrumented_jit",
 }
 
 #: host-side calls that must never run under a tracer

@@ -126,14 +126,15 @@ class JaxModel(Model, HasInputCol, HasOutputCol):
         fn = self._jit_cache.get(key)
         if fn is None:
             import jax
+            from ..observability.compute import instrumented_jit
             mesh = get_active_mesh()
             n_dev = mesh.devices.size
             pure = payload.pure_apply
             if n_dev > 1 and padded_n % n_dev == 0:
-                sharded = jax.jit(pure,
-                                  in_shardings=(replicated(mesh),
-                                                batch_sharded(mesh)),
-                                  out_shardings=replicated(mesh))
+                sharded = instrumented_jit(
+                    pure, name="dl.jax_model",
+                    in_shardings=(replicated(mesh), batch_sharded(mesh)),
+                    out_shardings=replicated(mesh))
                 if jax.process_count() > 1:
                     # multi-host: jit refuses host-local numpy for
                     # non-replicated shardings; every process holds the SAME
@@ -148,7 +149,7 @@ class JaxModel(Model, HasInputCol, HasOutputCol):
                 else:
                     fn = sharded
             else:
-                fn = jax.jit(pure)
+                fn = instrumented_jit(pure, name="dl.jax_model")
             self._jit_cache[key] = fn
         return fn
 

@@ -34,7 +34,9 @@ import numpy as np
 from ..core import (Binding, DataFrame, HasInputCol, HasOutputCol, Param,
                     Transformer)
 from ..core.schema import ColumnType
-from ..observability.tracing import TRACE_HEADER, current_trace_id
+from ..observability.tracing import (TRACE_HEADER, TRACEPARENT_HEADER,
+                                     current_span, current_trace_id,
+                                     format_traceparent)
 from ..stages.minibatch import FixedMiniBatchTransformer, FlattenBatch
 from ..utils.resilience import CircuitBreaker, Deadline, current_deadline
 
@@ -91,14 +93,31 @@ def _with_trace_header(req: HTTPRequestData,
                        trace_id: Optional[str] = None) -> HTTPRequestData:
     """Copy-on-write trace-id injection: the ambient span's trace id (or an
     explicit one — thread pools don't inherit the contextvar) rides
-    ``X-MMLSpark-Trace-Id`` so worker-side spans join the caller's trace.
-    An explicit header already on the request wins; the caller's request
-    object is never mutated."""
+    ``X-MMLSpark-Trace-Id`` AND a W3C ``traceparent`` (PR 4 follow-up: an
+    external frontend that only speaks Trace Context still joins the trace)
+    so worker-side spans join the caller's trace.  An explicit header
+    already on the request wins; the caller's request object is never
+    mutated."""
+    if req.headers and TRACE_HEADER in req.headers:
+        # explicit legacy header wins for the trace id, but the W3C pair
+        # must still ride next to it (a W3C-only downstream would start a
+        # disconnected trace otherwise)
+        if TRACEPARENT_HEADER in req.headers:
+            return req
+        headers = dict(req.headers)
+        span = current_span()
+        headers[TRACEPARENT_HEADER] = format_traceparent(
+            headers[TRACE_HEADER], span.span_id if span is not None else None)
+        return dataclasses.replace(req, headers=headers)
     tid = trace_id or current_trace_id()
-    if tid is None or (req.headers and TRACE_HEADER in req.headers):
+    if tid is None:
         return req
     headers = dict(req.headers or {})
     headers[TRACE_HEADER] = tid
+    if TRACEPARENT_HEADER not in headers:
+        span = current_span()
+        headers[TRACEPARENT_HEADER] = format_traceparent(
+            tid, span.span_id if span is not None else None)
     return dataclasses.replace(req, headers=headers)
 
 

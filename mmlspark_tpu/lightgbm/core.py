@@ -45,6 +45,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..models.gbdt import GBDTBooster
+from ..observability.compute import instrumented_jit
 from ..ops.histogram import build_histograms
 from .binning import BinMapper
 
@@ -266,7 +267,6 @@ def make_lambdarank_grad_fn(y: np.ndarray, group_ptr: np.ndarray,
     rq, rs = jnp.asarray(row_q), jnp.asarray(row_slot)
     covered = jnp.asarray(covered_np)
 
-    @jax.jit
     def fn(scores):
         S = scores[:, 0][pack] * M
         gain = (2.0 ** Y - 1.0) * M
@@ -293,7 +293,7 @@ def make_lambdarank_grad_fn(y: np.ndarray, group_ptr: np.ndarray,
         h_row = jnp.where(covered, H[rq, rs], 1e-16)
         return g_row[:, None], h_row[:, None]
 
-    return fn
+    return instrumented_jit(fn, name="lightgbm.lambdarank_grads")
 
 
 def lambdarank_grads(scores: np.ndarray, y: np.ndarray, group_ptr: np.ndarray,
@@ -1089,7 +1089,6 @@ def make_binned_walker(depth_bound: int,
     D = max(1, depth_bound)
     cats = frozenset(categorical_features or ())
 
-    @jax.jit
     def walk(binned, split_feature, threshold_bin, left_child, right_child,
              bitset=None):
         n = binned.shape[0]
@@ -1113,7 +1112,7 @@ def make_binned_walker(depth_bound: int,
             node = jnp.where(node >= 0, child, node)
         return ~node
 
-    return walk
+    return instrumented_jit(walk, name="lightgbm.tree_walk")
 
 
 # ---------------------------------------------------------------------------
@@ -1426,7 +1425,9 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
         y, w = y_pad, w_pad
         n = binned_np.shape[0]
         sharding = batch_sharded(mesh)
-        binned = jax.device_put(binned_np, sharding)
+        from ..observability.compute import device_put as _obs_device_put
+        binned = _obs_device_put(binned_np, sharding,
+                                 site="lightgbm.binned_shards")
 
         # explicit SPMD: each shard builds local histograms, psum over ICI
         def _build_sharded():
@@ -1435,11 +1436,12 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
             # closure — hence n in the cache key below
             grow_raw = _make_grower(p, F, B, axis_name=AXIS_DATA,
                                     backend=hist_backend, psum_row_bound=n)
-            return jax.jit(jax.shard_map(
+            return instrumented_jit(jax.shard_map(
                 grow_raw, mesh=mesh,
                 in_specs=(P(AXIS_DATA), P(AXIS_DATA), P(AXIS_DATA), P(AXIS_DATA),
                           P(), P()),
-                out_specs=(P(),) * 11 + (P(AXIS_DATA),), check_vma=False))
+                out_specs=(P(),) * 11 + (P(AXIS_DATA),), check_vma=False),
+                name="lightgbm.sharded_grower")
         grower = _cached(("sharded_grower", sig, F, id(mesh), n),
                          _build_sharded)
     else:
@@ -1453,8 +1455,9 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
             if bin_cache is not None:
                 bin_cache["binned_dev"] = binned
         grower = _cached(("grower", sig, F),
-                         lambda: jax.jit(_make_grower(p, F, B,
-                                                      backend=hist_backend)))
+                         lambda: instrumented_jit(
+                             _make_grower(p, F, B, backend=hist_backend),
+                             name="lightgbm.grower"))
     objective = make_objective(p)
     D = p.depth_bound                 # static walk bound during training
     L = p.num_leaves                  # leaf slots (level-wise: 2^max_depth)
@@ -1582,15 +1585,18 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
     # donated scores buffer is never also passed as another (aliased) arg.
     _iter_jit = {} if shard_rows else {
         False: _cached(("iter", sig, F, K, n, False),
-                       lambda: jax.jit(partial(_iter_body, g_pre=None,
-                                               h_pre=None, use_pre=False),
-                                       donate_argnums=(0,))),
+                       lambda: instrumented_jit(
+                           partial(_iter_body, g_pre=None,
+                                   h_pre=None, use_pre=False),
+                           donate_argnums=(0,), name="lightgbm.iter")),
         True: _cached(("iter", sig, F, K, n, True),
-                      lambda: jax.jit(partial(_iter_body, use_pre=True),
-                                      donate_argnums=(0,)))}
+                      lambda: instrumented_jit(
+                          partial(_iter_body, use_pre=True),
+                          donate_argnums=(0,), name="lightgbm.iter_pre"))}
 
     import jax.random as jrandom
-    jit_objective = jax.jit(objective) if objective is not None else None
+    jit_objective = instrumented_jit(objective, name="lightgbm.objective") \
+        if objective is not None else None
     start_iter = len(tree_weights) // K
 
     # ---- scan-chunked multi-iteration path: CH boosting iterations per
@@ -1659,7 +1665,8 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
             (scores_c, t), stacked = jax.lax.scan(body, (scores_c, t0), keys)
             return scores_c, stacked
 
-        return jax.jit(multi, donate_argnums=(0,))
+        return instrumented_jit(multi, donate_argnums=(0,),
+                                name="lightgbm.multi_iter")
 
     multi_iter = _cached(("multi", sig, F, K, n, CH), _build_multi) if chunk_ok else None
 
@@ -1693,7 +1700,7 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
                 scores_v_c = scores_v_c.at[:, c].add(vals[c::K].sum(axis=0))
             return scores_v_c
 
-        return jax.jit(upd)
+        return instrumented_jit(upd, name="lightgbm.valid_update")
 
     valid_chunk_update = _cached(("validupd", D, K), _build_valid_update)
 

@@ -24,7 +24,6 @@ from __future__ import annotations
 
 import json
 import os
-from functools import partial
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -226,7 +225,9 @@ class GBDTBooster(Saveable):
 
         use_bitset = cbs is not None and bool(self._is_cat.any())
 
-        @partial(jax.jit, static_argnames=())
+        from ..observability.compute import instrumented_jit
+
+        @instrumented_jit(name="models.gbdt_walk")
         def walk(X, sf, th, lca, rca, cat, cbs_a):
             n = X.shape[0]
             Xn = jnp.nan_to_num(X, nan=-jnp.inf)  # missing routes left

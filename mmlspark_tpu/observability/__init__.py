@@ -26,15 +26,23 @@ breakers), ``lightgbm/core.train`` (per-iteration phase timings),
 """
 from .metrics import (Counter, DEFAULT_LATENCY_BUCKETS, Gauge, Histogram,
                       MetricsRegistry, get_registry, set_registry)
-from .tracing import (Span, TRACE_HEADER, current_span, current_trace_id,
-                      new_trace_id, trace_span)
+from .tracing import (Span, TRACE_HEADER, TRACEPARENT_HEADER, current_span,
+                      current_trace_id, format_traceparent, new_trace_id,
+                      parse_traceparent, trace_span)
 from .instruments import (BREAKER_STATE_CODES, instrument_breaker,
                           instrument_collector)
 from .collector import OTLP_ENDPOINT_ENV, SpanCollector, get_collector
+from .compute import (InstrumentedJit, compile_report, device_put,
+                      ensure_build_info, ensure_device_memory_gauges,
+                      instrumented_jit, transfer_nbytes)
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "DEFAULT_LATENCY_BUCKETS", "get_registry", "set_registry",
-           "Span", "TRACE_HEADER", "current_span", "current_trace_id",
-           "new_trace_id", "trace_span", "BREAKER_STATE_CODES",
+           "Span", "TRACE_HEADER", "TRACEPARENT_HEADER", "current_span",
+           "current_trace_id", "new_trace_id", "trace_span",
+           "parse_traceparent", "format_traceparent", "BREAKER_STATE_CODES",
            "instrument_breaker", "instrument_collector",
-           "OTLP_ENDPOINT_ENV", "SpanCollector", "get_collector"]
+           "OTLP_ENDPOINT_ENV", "SpanCollector", "get_collector",
+           "InstrumentedJit", "instrumented_jit", "compile_report",
+           "device_put", "transfer_nbytes", "ensure_build_info",
+           "ensure_device_memory_gauges"]

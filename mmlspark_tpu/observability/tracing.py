@@ -35,11 +35,64 @@ from typing import Any, Dict, Optional
 from ..utils.resilience import current_deadline, deadline_scope
 from .metrics import MetricsRegistry, get_registry
 
-__all__ = ["Span", "TRACE_HEADER", "current_span", "current_trace_id",
-           "new_trace_id", "trace_span", "export_span"]
+__all__ = ["Span", "TRACE_HEADER", "TRACEPARENT_HEADER", "current_span",
+           "current_trace_id", "new_trace_id", "trace_span", "export_span",
+           "parse_traceparent", "format_traceparent"]
 
 #: wire header carrying the trace id across HTTP hops
 TRACE_HEADER = "X-MMLSpark-Trace-Id"
+
+#: W3C Trace Context header (lowercase per spec); accepted on ingress (its
+#: trace id is adopted for spans/exemplars, winning over the legacy header)
+#: and injected on egress next to the legacy header, so an external frontend
+#: that speaks only W3C still gets end-to-end traces through the fleet
+TRACEPARENT_HEADER = "traceparent"
+
+_HEX = frozenset("0123456789abcdef")
+
+
+def _is_hex(s: str) -> bool:
+    return bool(s) and all(c in _HEX for c in s)
+
+
+def parse_traceparent(value) -> Optional[tuple]:
+    """``(trace_id, parent_span_id)`` from a W3C ``traceparent`` header, or
+    None when malformed (per spec, a malformed header is ignored and a new
+    trace starts).  Future versions (> 00) are accepted as long as the
+    00-compatible prefix parses; version ``ff`` is explicitly invalid."""
+    if not value:
+        return None
+    parts = str(value).strip().lower().split("-")
+    if len(parts) < 4:
+        return None
+    version, trace_id, span_id = parts[0], parts[1], parts[2]
+    if len(version) != 2 or not _is_hex(version) or version == "ff":
+        return None
+    if len(trace_id) != 32 or not _is_hex(trace_id) or trace_id == "0" * 32:
+        return None
+    if len(span_id) != 16 or not _is_hex(span_id) or span_id == "0" * 16:
+        return None
+    if version == "00" and len(parts) != 4:
+        return None
+    return trace_id, span_id
+
+
+def format_traceparent(trace_id: Optional[str] = None,
+                       span_id: Optional[str] = None,
+                       sampled: bool = True) -> str:
+    """A valid ``traceparent`` for this process's ids.  Native trace ids are
+    already 32 lowercase hex (process prefix + counter) and span ids 16 —
+    they pass through unchanged; a foreign id adopted from the legacy header
+    is deterministically re-encoded to hex so the wire value stays valid."""
+    tid = (trace_id or new_trace_id()).lower()
+    if len(tid) != 32 or not _is_hex(tid):
+        tid = tid.encode("utf-8", "replace").hex()[:32].ljust(32, "0")
+    if tid == "0" * 32:
+        tid = new_trace_id()
+    sid = (span_id or "").lower()
+    if len(sid) != 16 or not _is_hex(sid) or sid == "0" * 16:
+        sid = _new_span_id()
+    return f"00-{tid}-{sid}-{'01' if sampled else '00'}"
 
 
 # id generation sits on the serving hot path INSIDE the serialized scoring

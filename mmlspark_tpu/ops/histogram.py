@@ -16,11 +16,12 @@ instead of a separate pass.
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from ..observability.compute import instrumented_jit
 
 
 def build_histograms(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
@@ -91,7 +92,7 @@ def histogram_subtraction(parent_hist: jnp.ndarray, child_hist: jnp.ndarray) -> 
     return parent_hist - child_hist
 
 
-@partial(jax.jit, static_argnames=("num_bins",))
+@instrumented_jit(name="ops.bin_matrix", static_argnames=("num_bins",))
 def bin_matrix(x: jnp.ndarray, edges: jnp.ndarray, num_bins: int) -> jnp.ndarray:
     """Digitize raw features on device: bin = #edges < x.  edges:
     (F, num_bins-1) ascending with +inf padding.

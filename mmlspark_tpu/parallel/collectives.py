@@ -92,5 +92,7 @@ def shard_mapped(fn: Callable, mesh=None, in_specs=None, out_specs=None,
     mesh = mesh or get_active_mesh()
     in_specs = in_specs if in_specs is not None else P(AXIS_DATA)
     out_specs = out_specs if out_specs is not None else P()
+    # raw-jit: bare SPMD building block — callers jit (and instrument) the
+    # wrapped result; wrapping here would double-jit every composition
     return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                          check_vma=check_vma)

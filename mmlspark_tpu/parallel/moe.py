@@ -60,11 +60,14 @@ def shard_moe_params(params, mesh):
     """device_put expert-stacked leaves (leading dim == num_experts on the
     ``expert`` axis) and replicate the rest."""
     from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..observability.compute import device_put as _obs_device_put
     e_size = mesh.shape[AXIS_EXPERT]
 
     def place(leaf):
         if leaf.ndim >= 1 and leaf.shape[0] % e_size == 0 and leaf.ndim >= 3:
-            return jax.device_put(leaf, NamedSharding(mesh, P(AXIS_EXPERT)))
-        return jax.device_put(leaf, NamedSharding(mesh, P()))
+            return _obs_device_put(leaf, NamedSharding(mesh, P(AXIS_EXPERT)),
+                                   site="parallel.moe")
+        return _obs_device_put(leaf, NamedSharding(mesh, P()),
+                               site="parallel.moe")
 
     return jax.tree.map(place, params)

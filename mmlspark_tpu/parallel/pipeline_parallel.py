@@ -83,17 +83,23 @@ def make_pipeline_train_step(stage_apply: Callable, num_stages: int,
 
     p_spec = P(AXIS_PIPE)
     rep = P()
-    step_fn = jax.jit(jax.shard_map(
+    from ..observability.compute import device_put as _obs_device_put
+    from ..observability.compute import instrumented_jit
+    step_fn = instrumented_jit(jax.shard_map(
         local_step, mesh=mesh, in_specs=(p_spec, rep, rep),
-        out_specs=(p_spec, rep), check_vma=False))
-    forward_fn = jax.jit(jax.shard_map(
+        out_specs=(p_spec, rep), check_vma=False),
+        name="parallel.pipeline_step")
+    forward_fn = instrumented_jit(jax.shard_map(
         local_collect, mesh=mesh, in_specs=(p_spec, rep),
-        out_specs=rep, check_vma=False))
+        out_specs=rep, check_vma=False),
+        name="parallel.pipeline_forward")
 
     def init_fn(params_stacked):
         sh = NamedSharding(mesh, p_spec)
         return jax.tree.map(
-            lambda a: jax.device_put(np.asarray(a), sh), params_stacked)
+            lambda a: _obs_device_put(np.asarray(a), sh,
+                                      site="parallel.pipeline_init"),
+            params_stacked)
 
     return init_fn, step_fn, forward_fn
 

@@ -140,8 +140,8 @@ def make_ring_attention_fn(mesh=None, axis_name: str = AXIS_SEQ,
     mesh = mesh or get_active_mesh()
     spec = P(None, None, axis_name, None)
 
-    fn = jax.shard_map(
+    from ..observability.compute import instrumented_jit
+    return instrumented_jit(jax.shard_map(
         partial(ring_attention, axis_name=axis_name, causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
-    return jax.jit(fn)
+        check_vma=False), name="parallel.ring_attention")

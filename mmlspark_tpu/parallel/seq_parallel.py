@@ -47,16 +47,20 @@ def make_seq_parallel_train_step(module, learning_rate: float = 1e-3,
         params = jax.tree.map(lambda w, g: w - learning_rate * g, params, grads)
         return params, loss
 
-    step_fn = jax.jit(jax.shard_map(
+    from ..observability.compute import device_put as _obs_device_put
+    from ..observability.compute import instrumented_jit
+    step_fn = instrumented_jit(jax.shard_map(
         local_step, mesh=mesh,
         in_specs=(rep, tok_spec, tok_spec, tok_spec),
-        out_specs=(rep, rep), check_vma=False))
+        out_specs=(rep, rep), check_vma=False),
+        name="parallel.seq_step")
 
     def init_fn(rng, tokens, positions):
         variables = module.init(rng, tokens[:1, : tokens.shape[1] // mesh.shape[AXIS_SEQ]],
                                 positions=positions[:1, : tokens.shape[1] // mesh.shape[AXIS_SEQ]])
         params = variables["params"]
-        return jax.device_put(params, NamedSharding(mesh, rep))
+        return _obs_device_put(params, NamedSharding(mesh, rep),
+                               site="parallel.seq_init")
 
     return init_fn, step_fn
 

@@ -33,7 +33,7 @@ def shard_batch(x, mesh=None, axis: str = AXIS_DATA):
     """Device_put a host array with its leading dim split over `axis`.
     Pads the batch up to a multiple of the axis size (padding rows are
     repeated last rows; callers mask via the returned valid-count)."""
-    import jax
+    from ..observability.compute import device_put
     mesh = mesh or get_active_mesh()
     n_shards = mesh.shape[axis]
     x = np.asarray(x)
@@ -42,12 +42,14 @@ def shard_batch(x, mesh=None, axis: str = AXIS_DATA):
     if rem:
         pad = np.repeat(x[-1:], rem, axis=0)
         x = np.concatenate([x, pad], axis=0)
-    return jax.device_put(x, batch_sharded(mesh, axis)), n
+    return device_put(x, batch_sharded(mesh, axis),
+                      site="parallel.shard_batch"), n
 
 
 def replicate(x, mesh=None):
-    import jax
-    return jax.device_put(x, replicated(mesh or get_active_mesh()))
+    from ..observability.compute import device_put
+    return device_put(x, replicated(mesh or get_active_mesh()),
+                      site="parallel.replicate")
 
 
 def pad_to_multiple(x: np.ndarray, multiple: int, axis: int = 0,

@@ -70,7 +70,8 @@ class Trainer:
     def __init__(self, module, optimizer, loss_fn: Callable,
                  mesh=None, has_batch_stats: bool = False,
                  apply_kwargs: Optional[Dict[str, Any]] = None,
-                 min_shard_size: int = 2 ** 16):
+                 min_shard_size: int = 2 ** 16,
+                 device_time_every: int = 0):
         self.module = module
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -78,14 +79,27 @@ class Trainer:
         self.has_batch_stats = has_batch_stats
         self.apply_kwargs = dict(apply_kwargs or {})
         self.min_shard_size = min_shard_size
+        # every Nth step additionally measures on-device time by a
+        # block_until_ready after dispatch (0 = off: a forced sync breaks
+        # the async pipeline, so device sampling is strictly opt-in)
+        self.device_time_every = max(0, int(device_time_every))
+        self._step_count = 0
         self._train_step = None
         self._state_shardings = None
         from ..observability import get_registry
         from ..observability.tracing import current_trace_id
-        self._m_step = get_registry().histogram(
+        reg = get_registry()
+        self._m_step = reg.histogram(
             "mmlspark_parallel_train_step_seconds",
             "train_step dispatch+wait time on the host (async under jit: "
             "the device may still be running when the call returns)")
+        # compute-plane breakdown (labels: trace = first-call lower+compile;
+        # dispatch = host time to enqueue the program; device = extra
+        # block_until_ready wait on sampled steps)
+        self._m_phase = reg.histogram(
+            "mmlspark_parallel_train_step_phase_seconds",
+            "train_step breakdown: trace (compile), dispatch (host enqueue), "
+            "device (sampled block_until_ready wait)", labels=("phase",))
         # bound once: train_step runs per batch, no per-call import lookup
         self._current_trace_id = current_trace_id
 
@@ -112,11 +126,16 @@ class Trainer:
             jax.tree.map(lambda _: rep, state.batch_stats)
         self._state_shardings = TrainState(params=p_shard, opt_state=opt_shard,
                                            step=rep, batch_stats=bs_shard)
-        put = lambda x, s: jax.device_put(x, s)
+        # instrumented placement: mmlspark_device_transfer_bytes_total books
+        # the host->device feed per site (the out-of-core work needs this
+        # visible before it lands)
+        from ..observability.compute import device_put as _obs_device_put
+        put = lambda x, s: _obs_device_put(x, s,
+                                           site="parallel.trainer.shard_state")
         return TrainState(
             params=jax.tree.map(put, state.params, p_shard),
             opt_state=jax.tree.map(put, state.opt_state, opt_shard),
-            step=jax.device_put(state.step, rep),
+            step=put(state.step, rep),
             batch_stats=None if state.batch_stats is None else
             jax.tree.map(put, state.batch_stats, bs_shard))
 
@@ -154,23 +173,52 @@ class Trainer:
         sh = self._state_shardings
         state_in = TrainState(params=sh.params, opt_state=sh.opt_state,
                               step=sh.step, batch_stats=sh.batch_stats)
-        return jax.jit(
+        from ..observability.compute import instrumented_jit
+        return instrumented_jit(
             step_fn,
             in_shardings=(state_in, {"x": batch_sh, "y": batch_sh}),
             out_shardings=(state_in, rep),
-            donate_argnums=(0,))
+            donate_argnums=(0,), name="parallel.train_step")
 
     def train_step(self, state: TrainState, batch) -> Tuple[TrainState, Any]:
         if self._train_step is None:
             if self._state_shardings is None:
                 raise RuntimeError("call init_state/shard_state before train_step")
             self._train_step = self._build_train_step()
+        fn = self._train_step
+        trace_id = self._current_trace_id()
+        compiles_before = fn.compiles
         t0 = time.perf_counter()
-        out = self._train_step(state, batch)
+        out = fn(state, batch)
+        dispatch_s = time.perf_counter() - t0
         # exemplar when a span is active (e.g. a traced fit loop): a slow
         # step's histogram bucket keeps the trace id of the run that hit it
-        self._m_step.observe(time.perf_counter() - t0,
-                             self._current_trace_id())
+        self._m_step.observe(dispatch_s, trace_id)
+        # compute-plane breakdown: a first-signature call spent most of its
+        # host time in lower+compile — book it as the trace phase and keep
+        # dispatch comparable across steps
+        if fn.compiles != compiles_before:
+            self._m_phase.observe(fn.last_compile_s, trace_id, phase="trace")
+            dispatch_s = max(0.0, dispatch_s - fn.last_compile_s)
+        self._m_phase.observe(dispatch_s, trace_id, phase="dispatch")
+        self._step_count += 1
+        if self.device_time_every and \
+                self._step_count % self.device_time_every == 0:
+            # sampled only: the forced sync ends async pipelining for this
+            # step, so the device-time series costs 1/N of the overlap
+            t1 = time.perf_counter()
+            import jax
+            jax.block_until_ready(out)
+            device_s = time.perf_counter() - t1
+            self._m_phase.observe(device_s, trace_id, phase="device")
+            from ..observability.tracing import Span, export_span
+            span = Span("compute.train_step", trace_id=trace_id,
+                        start_s=t0,
+                        attributes={"dispatch_s": round(dispatch_s, 6),
+                                    "device_s": round(device_s, 6),
+                                    "step": self._step_count})
+            span.finish(time.perf_counter())
+            export_span(span)
         return out
 
 

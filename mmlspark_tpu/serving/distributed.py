@@ -28,7 +28,9 @@ from typing import Callable, Dict, List, Optional
 from .server import PipelineServer
 from ..observability import get_registry, instrument_breaker
 from ..observability.instruments import uninstrument_breaker
-from ..observability.tracing import TRACE_HEADER, current_trace_id
+from ..observability.tracing import (TRACE_HEADER, TRACEPARENT_HEADER,
+                                     current_span, current_trace_id,
+                                     format_traceparent)
 from ..utils.resilience import CircuitBreaker, Deadline, current_deadline
 
 
@@ -48,8 +50,11 @@ def _http_json(url: str, payload: Optional[dict] = None, timeout: float = 10.0,
     trace_id = current_trace_id()
     if trace_id is not None:
         # the ambient span's trace id rides the wire so worker-side spans
-        # join the caller's trace
+        # join the caller's trace — legacy header plus W3C traceparent
         headers[TRACE_HEADER] = trace_id
+        span = current_span()
+        headers[TRACEPARENT_HEADER] = format_traceparent(
+            trace_id, span.span_id if span is not None else None)
     req = urllib.request.Request(url, data=data, headers=headers)
     with urllib.request.urlopen(req, timeout=timeout) as r:
         return json.loads(r.read().decode() or "null")
@@ -84,13 +89,26 @@ class TopologyService:
                  probe_interval_s: Optional[float] = 5.0,
                  probe_timeout_s: float = 2.0, evict_after: int = 3,
                  prober: Optional[Callable[[Dict, float], bool]] = None,
-                 registry=None):
+                 registry=None, fleet_slow_deadline_s: float = 2.0,
+                 fleet_slow_k: int = 10,
+                 fleet_breaker_factory: Optional[
+                     Callable[[str], CircuitBreaker]] = None):
         self.host, self.port = host, port
         self.probe_interval_s = probe_interval_s
         self.probe_timeout_s = probe_timeout_s
         self.evict_after = max(1, evict_after)
         self.prober = prober or _default_prober
         self.registry = registry if registry is not None else get_registry()
+        # /fleet/slow fan-out: overall budget, default depth, per-worker
+        # breakers (a dead worker costs one probe per cooldown, never the
+        # whole fan-out's latency on every query)
+        self.fleet_slow_deadline_s = float(fleet_slow_deadline_s)
+        self.fleet_slow_k = int(fleet_slow_k)
+        self.fleet_breaker_factory = fleet_breaker_factory or (
+            lambda sid: CircuitBreaker(failure_threshold=3, window_s=30.0,
+                                       cooldown_s=10.0,
+                                       name=f"fleet-slow:{sid}"))
+        self._fleet_breakers: Dict[str, CircuitBreaker] = {}
         self._m_probes = self.registry.counter(
             "mmlspark_topology_probes_total",
             "health probes by worker and outcome",
@@ -157,6 +175,21 @@ class TopologyService:
                         self._json(200, {"value": svc._flags.get(self.path[6:])})
                 elif self.path == "/stats":
                     self._json(200, svc.aggregate_stats())
+                elif self.path.split("?", 1)[0] == "/fleet/slow":
+                    k, deadline_s = svc.fleet_slow_k, None
+                    for part in self.path.partition("?")[2].split("&"):
+                        if part.startswith("k="):
+                            try:
+                                k = int(part[2:])
+                            except ValueError:
+                                pass
+                        elif part.startswith("deadline_ms="):
+                            try:
+                                deadline_s = float(part[12:]) / 1000.0
+                            except ValueError:
+                                pass
+                    self._json(200, svc.fleet_slow(k=k,
+                                                   deadline_s=deadline_s))
                 elif self.path == "/health":
                     self._json(200, {"ok": True})
                 else:
@@ -254,6 +287,114 @@ class TopologyService:
             total["latency_avg_ms"] = lat_sum_ms / lat_count
             total["mean_latency_ms"] = total["latency_avg_ms"]
         return total
+
+    # ------------------------------------------------------------ /fleet/slow
+    def _fleet_breaker(self, sid: str) -> CircuitBreaker:
+        with self._lock:
+            b = self._fleet_breakers.get(sid)
+        if b is None:
+            fresh = self.fleet_breaker_factory(sid)
+            with self._lock:
+                b = self._fleet_breakers.setdefault(sid, fresh)
+            if b is fresh:
+                # only the setdefault WINNER is instrumented (outside our
+                # lock — it registers gauges): instrumenting a losing
+                # duplicate would rebind the shared gauge callbacks and
+                # listener record to a breaker nobody uses
+                instrument_breaker(b, self.registry)
+        return b
+
+    def _prune_fleet_breakers(self, live_ids) -> None:
+        """A worker gone from the routing table takes its fan-out breaker
+        and gauge series with it (same hygiene as RoutingClient's
+        per-worker breakers — fresh-id churn must not grow state)."""
+        with self._lock:
+            dead = [(sid, self._fleet_breakers.pop(sid))
+                    for sid in list(self._fleet_breakers)
+                    if sid not in live_ids]
+        for _sid, breaker in dead:
+            uninstrument_breaker(breaker, self.registry)
+
+    def fleet_slow(self, k: Optional[int] = None,
+                   deadline_s: Optional[float] = None) -> Dict:
+        """Fleet-wide slowest requests (``GET /fleet/slow?k=N``, PR 4
+        follow-up): fan out to every live worker's ``/debug/slow`` under one
+        overall deadline, merge to a global top-K with worker attribution.
+
+        Per-worker circuit breakers isolate dead workers: a worker that
+        keeps failing costs one probe per cooldown instead of a timeout per
+        query, and partial results are always served — one dead worker must
+        never blind the fleet view.  Skipped/failed workers are reported in
+        ``workers`` so a partial merge is visibly partial."""
+        k = self.fleet_slow_k if k is None else max(0, int(k))
+        deadline = Deadline.after(deadline_s if deadline_s is not None
+                                  else self.fleet_slow_deadline_s)
+        with self._lock:
+            workers = list(self._workers.items())
+        self._prune_fleet_breakers({sid for sid, _ in workers})
+        per_worker: Dict[str, Dict] = {}
+        results: Dict[str, tuple] = {}
+        results_lock = threading.Lock()
+
+        def fetch(sid: str, w: Dict, breaker: CircuitBreaker) -> None:
+            try:
+                got = _http_json(
+                    f"http://{w['host']}:{w['port']}/debug/slow?k={k}",
+                    timeout=self.probe_timeout_s, deadline=deadline)
+            except Exception as e:  # noqa: BLE001 — a dead worker is a row
+                if deadline.expired():
+                    # the budget ran out mid-exchange — that is the
+                    # caller's deadline, not the worker's health: no
+                    # breaker feed (PR 2 rule: client-side expiry must
+                    # never trip a healthy worker's breaker)
+                    with results_lock:
+                        results[sid] = (
+                            {"skipped": "deadline_exhausted"}, [])
+                    return
+                breaker.record_failure()
+                with results_lock:
+                    results[sid] = ({"error": str(e)}, [])
+                return
+            breaker.record_success()
+            rows = got.get("slowest", []) if isinstance(got, dict) else []
+            for row in rows:
+                row["worker"] = sid
+            with results_lock:
+                results[sid] = ({"count": len(rows)}, rows)
+
+        # genuinely concurrent fan-out: one slow worker costs the query its
+        # OWN latency, never every later worker's slice of the budget (the
+        # sequential version starved the tail of the worker list)
+        threads = []
+        for sid, w in workers:
+            breaker = self._fleet_breaker(sid)
+            if not breaker.allow():
+                per_worker[sid] = {"skipped": "circuit_open"}
+                continue
+            if deadline.expired():
+                per_worker[sid] = {"skipped": "deadline_exhausted"}
+                continue
+            t = threading.Thread(target=fetch, args=(sid, w, breaker),
+                                 daemon=True, name=f"fleet-slow-{sid}")
+            t.start()
+            threads.append((sid, t))
+        for sid, t in threads:
+            t.join(timeout=max(0.0, deadline.remaining()))
+        with results_lock:
+            done = dict(results)
+        merged: List[Dict] = []
+        for sid, _t in threads:
+            outcome = done.get(sid)
+            if outcome is None:
+                # still in flight when the budget ran out; its thread will
+                # finish the breaker bookkeeping in the background
+                per_worker[sid] = {"skipped": "deadline_exhausted"}
+                continue
+            verdict, rows = outcome
+            per_worker[sid] = verdict
+            merged.extend(rows)
+        merged.sort(key=lambda r: r.get("durationS", 0.0), reverse=True)
+        return {"k": k, "workers": per_worker, "slowest": merged[:k]}
 
 
 class WorkerServer:

@@ -32,8 +32,10 @@ import numpy as np
 from ..core import DataFrame, Transformer
 from ..observability import get_registry
 from ..observability.collector import get_collector
-from ..observability.tracing import (Span, TRACE_HEADER, export_span,
-                                     new_trace_id, trace_span)
+from ..observability.tracing import (Span, TRACE_HEADER, TRACEPARENT_HEADER,
+                                     export_span, format_traceparent,
+                                     new_trace_id, parse_traceparent,
+                                     trace_span)
 from ..utils.resilience import Deadline, deadline_scope
 
 # entry ids need uniqueness within the process, not entropy: uuid4's
@@ -58,6 +60,10 @@ class _Entry:
     t_enq: float = 0.0
     retry_after_s: Optional[float] = None
     trace_id: str = ""
+    # set when the request carried a W3C traceparent: the reply echoes one
+    # back with the server-side request span's id
+    echo_traceparent: bool = False
+    span_id: str = ""  # serving.request span id, filled by the scorer
 
 
 class ServingStats:
@@ -105,7 +111,9 @@ class PipelineServer:
     GET /trace/<id> -> assembled span tree for a recent trace;
     GET /debug/slow[?k=N] -> top-K slowest recent requests with phase
     breakdown and shed/deadline verdict (see docs/OBSERVABILITY.md,
-    "Debugging a slow request").
+    "Debugging a slow request");
+    GET /debug/compile -> compute-plane compile state (per-function compile
+    counts, abstract signatures, last cost analysis, recompile-storm trips).
 
     Graceful degradation: admission is bounded — once ``max_queue_depth``
     requests are in flight, further POSTs are shed immediately with 503 +
@@ -289,6 +297,13 @@ class PipelineServer:
                                                      "trace", "traceId": trace_id})
                     else:
                         self._respond(200, tree)
+                elif self.path == "/debug/compile":
+                    # compute-plane diagnostics: per-instrumented-function
+                    # compile counts, abstract signatures, last cost
+                    # analysis — the first stop when "score got slow" is
+                    # actually a recompile storm below the host timings
+                    from ..observability.compute import compile_report
+                    self._respond(200, compile_report(server.registry))
                 elif self.path.split("?", 1)[0] == "/debug/slow":
                     k = server.slow_k
                     query = self.path.partition("?")[2]
@@ -330,18 +345,32 @@ class PipelineServer:
                     parsed = Deadline.parse_budget_s(hdr)
                     if parsed is not None:
                         budget_s = min(budget_s, parsed)
-                # adopt the caller's trace id (X-MMLSpark-Trace-Id) so the
-                # worker-side spans of this request join the caller's trace
-                trace_id = self.headers.get(TRACE_HEADER) or new_trace_id()
+                # adopt the caller's trace id so the worker-side spans of
+                # this request join the caller's trace: a W3C `traceparent`
+                # wins (PR 4 follow-up — external frontends speak Trace
+                # Context), else the legacy X-MMLSpark-Trace-Id, else fresh
+                tp_in = self.headers.get(TRACEPARENT_HEADER)
+                parsed_tp = parse_traceparent(tp_in) if tp_in else None
+                if parsed_tp is not None:
+                    trace_id = parsed_tp[0]
+                else:
+                    trace_id = self.headers.get(TRACE_HEADER) or new_trace_id()
                 entry = _Entry(uid=f"e{next(_ENTRY_IDS):x}", payload=payload,
                                headers=dict(self.headers), t_enq=t_enq,
                                t_deadline=t_enq + budget_s,
-                               trace_id=trace_id)
+                               trace_id=trace_id,
+                               echo_traceparent=parsed_tp is not None)
                 # bounded admission: shedding beats queueing toward a
                 # certain timeout (503 tells the client to back off; 504
                 # would have cost it request_timeout_s of waiting first)
                 shed_reason = server._try_admit()
                 trace_hdr = {TRACE_HEADER: trace_id}
+                if entry.echo_traceparent:
+                    # echoed next to the legacy header; the request span's
+                    # id rides it once the scorer resolved the entry (the
+                    # pre-score shed/timeout replies carry a fresh span id)
+                    trace_hdr[TRACEPARENT_HEADER] = format_traceparent(
+                        trace_id, entry.span_id or None)
                 if shed_reason is not None:
                     self._respond(503, {"error": f"overloaded: {shed_reason}"},
                                   extra_headers={
@@ -373,6 +402,11 @@ class PipelineServer:
                 status = entry.status
                 stats = server.stats
                 extra = dict(trace_hdr)
+                if entry.echo_traceparent and entry.span_id:
+                    # the scorer resolved the request span: the echo now
+                    # names the exact server-side span of this request
+                    extra[TRACEPARENT_HEADER] = format_traceparent(
+                        trace_id, entry.span_id)
                 if status == 503:
                     extra["Retry-After"] = _retry_after(
                         entry.retry_after_s or server.shed_retry_after_s)
@@ -622,7 +656,8 @@ class PipelineServer:
             if e.status != 200:
                 span.status = f"http:{e.status}"
             span.finish()
-            export_span(span, self.registry)
+            e.span_id = span.span_id  # before done.set(): the handler may
+            export_span(span, self.registry)  # echo it in `traceparent`
             e.done.set()
 
     def _worker(self):
@@ -637,6 +672,20 @@ class PipelineServer:
 
     # ------------------------------------------------------------------ api
     def start(self) -> "PipelineServer":
+        # environment pivot + device-memory series for this registry (both
+        # idempotent; no-ops where jax or memory introspection is absent).
+        # Registered from a daemon thread: ensure_* may initialize the jax
+        # backend, and against a wedged TPU relay jax.local_devices() can
+        # block for hours — serving startup must never ride that, and a
+        # pure-python pipeline should pay no backend init at all on the
+        # start path (the registry is thread-safe by contract).
+        def _register_env_gauges():
+            from ..observability.compute import (ensure_build_info,
+                                                 ensure_device_memory_gauges)
+            ensure_build_info(self.registry)
+            ensure_device_memory_gauges(self.registry)
+        threading.Thread(target=_register_env_gauges, daemon=True,
+                         name="mmlspark-env-gauges").start()
         self._httpd = ThreadingHTTPServer((self.host, self.port), self._make_handler())
         self.port = self._httpd.server_port  # resolve port=0
         # label children per resolved address; callback gauges sample live

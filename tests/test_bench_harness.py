@@ -66,7 +66,7 @@ def test_trailing_line_without_newline_is_still_parsed():
 def test_health_gate_retries_once_then_succeeds():
     # BENCH_r05: one silent health child wrote off every TPU phase while
     # the relay was actually fine — the gate must give it a second chance
-    attempts = []
+    attempts, sleeps = [], []
 
     def spawn():
         attempts.append(1)
@@ -74,16 +74,47 @@ def test_health_gate_retries_once_then_succeeds():
             return _child("print('no marker here')")
         return _child("print('HEALTH_OK 256.0')")
 
-    ok, used = bench._health_gate(spawn=spawn, idle=10, hard=20)
+    ok, used = bench._health_gate(spawn=spawn, idle=10, hard=20,
+                                  sleep=sleeps.append)
     assert ok and used == 2 and len(attempts) == 2
+    assert sleeps == [15.0], "one failed attempt = one base backoff"
 
 
-def test_health_gate_gives_up_after_two_attempts():
+def test_health_gate_backs_off_exponentially_then_gives_up():
+    # PR 5's immediate retry still lost 2 of 5 rounds: a relay mid-recovery
+    # fails an instant retry the same way — each wait must double
+    sleeps = []
+
     def spawn():
         return _child("print('still no marker')")
 
-    ok, used = bench._health_gate(spawn=spawn, idle=10, hard=20)
-    assert not ok and used == 2
+    ok, used = bench._health_gate(spawn=spawn, idle=10, hard=20,
+                                  sleep=sleeps.append)
+    assert not ok and used == 3
+    assert sleeps == [15.0, 30.0], "backoff must double between attempts"
+
+
+def test_health_gate_respects_attempt_budget():
+    sleeps = []
+
+    def spawn():
+        return _child("print('still no marker')")
+
+    ok, used = bench._health_gate(spawn=spawn, attempts=2, idle=10, hard=20,
+                                  sleep=sleeps.append)
+    assert not ok and used == 2 and sleeps == [15.0]
+
+
+def test_warm_relay_holder_phase_exists():
+    # MMLSPARK_TPU_BENCH_WARM_RELAY spawns `--phase health --hold 1`; the
+    # phase body must accept the knob and the parent must kill the holder
+    # (a leaked held child would pin the relay past the bench)
+    import inspect
+
+    assert "hold" in inspect.signature(bench.phase_health).parameters
+    src = inspect.getsource(bench.main)
+    assert "MMLSPARK_TPU_BENCH_WARM_RELAY" in src
+    assert "warm_relay.kill()" in src, "holder must die with the bench"
 
 
 def test_hist_ab_markers_fold_into_extras():

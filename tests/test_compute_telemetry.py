@@ -1,0 +1,343 @@
+"""Compute-plane telemetry (ISSUE 6): instrumented_jit compile tracking,
+recompile-storm detection, cost-analysis capture, device-memory/transfer
+gauges, build info, /debug/compile, and W3C traceparent propagation."""
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mmlspark_tpu.observability import MetricsRegistry, set_registry
+from mmlspark_tpu.observability import compute as compute_mod
+from mmlspark_tpu.observability.compute import (
+    compile_report, device_put, ensure_build_info,
+    ensure_device_memory_gauges, instrumented_jit, transfer_nbytes)
+from mmlspark_tpu.observability.tracing import (format_traceparent,
+                                                parse_traceparent)
+from tests.serving_helpers import Doubler
+
+
+def _compiles(reg, fn):
+    return reg.counter("mmlspark_jit_compile_total",
+                       labels=("fn",)).value(fn=fn)
+
+
+# ---------------------------------------------------------------- wrapper
+
+def test_instrumented_jit_books_one_compile_per_signature():
+    reg = MetricsRegistry()
+
+    @instrumented_jit(name="t.double", registry=reg)
+    def f(x):
+        return x * 2
+
+    a = np.ones((4,), np.float32)
+    assert np.allclose(f(jnp.asarray(a)), 2 * a)
+    for _ in range(10):                       # steady state: dict hit only
+        f(jnp.asarray(a))
+    assert _compiles(reg, "t.double") == 1
+    f(jnp.ones((16,), jnp.float32))           # new shape: one more compile
+    assert _compiles(reg, "t.double") == 2
+    h = reg.histogram("mmlspark_jit_compile_seconds", labels=("fn",))
+    assert h.count(fn="t.double") == 2 and h.sum(fn="t.double") > 0.0
+
+
+def test_instrumented_jit_captures_cost_analysis():
+    reg = MetricsRegistry()
+
+    @instrumented_jit(name="t.mm", registry=reg)
+    def mm(a, b):
+        return a @ b
+
+    mm(jnp.ones((8, 8)), jnp.ones((8, 8)))
+    rep = compile_report(reg)["functions"]["t.mm"]
+    cost = rep["last_cost_analysis"]
+    assert cost is not None and cost["flops"] > 0
+    # the gauges mirror the last compile so dashboards can compute
+    # utilization without scraping /debug/compile
+    assert reg.gauge("mmlspark_jit_flops",
+                     labels=("fn",)).value(fn="t.mm") == cost["flops"]
+    sig = rep["signatures"][0]["signature"]
+    assert "f32[8,8]" in sig
+
+
+def test_python_scalar_values_do_not_churn_the_compile_counter():
+    reg = MetricsRegistry()
+
+    @instrumented_jit(name="t.scale", registry=reg)
+    def f(x, s):
+        return x * s
+
+    for s in (1.5, 2.5, 3.5, 4.5):
+        f(jnp.ones((3,)), s)
+    assert _compiles(reg, "t.scale") == 1
+
+
+def test_static_argnames_key_by_value_even_positionally():
+    reg = MetricsRegistry()
+
+    @instrumented_jit(name="t.head", registry=reg,
+                      static_argnames=("n",))
+    def head(x, n):
+        return x[:n].sum()
+
+    x = jnp.arange(8.0)
+    assert float(head(x, 4)) == 6.0
+    assert float(head(x, 4)) == 6.0           # hit
+    assert float(head(x, 2)) == 1.0           # new static value: compile
+    assert _compiles(reg, "t.head") == 2
+
+
+def test_donated_buffers_survive_the_aot_path():
+    reg = MetricsRegistry()
+
+    @instrumented_jit(name="t.donate", registry=reg, donate_argnums=(0,))
+    def step(s):
+        return s + 1
+
+    s = jnp.zeros((8,))
+    for _ in range(4):
+        s = step(s)
+    assert float(s.sum()) == 32.0
+    assert _compiles(reg, "t.donate") == 1
+
+
+def test_recompile_storm_trips_counter_and_report():
+    """Acceptance: deliberate shape churn must trip
+    ``mmlspark_jit_recompile_storm_total`` and /debug/compile (via
+    compile_report) must list the offending signatures."""
+    reg = MetricsRegistry()
+
+    @instrumented_jit(name="t.storm", registry=reg, storm_signatures=4)
+    def f(x):
+        return x + 1
+
+    for k in range(1, 8):                     # 7 distinct shapes
+        f(jnp.ones((k,)))
+    storms = reg.counter("mmlspark_jit_recompile_storm_total",
+                         labels=("fn",)).value(fn="t.storm")
+    assert storms == 4.0                      # signatures 4..7 each book one
+    rep = compile_report(reg)["functions"]["t.storm"]
+    assert rep["storm_tripped"] and rep["compiles"] == 7
+    assert len(rep["signatures"]) == 7
+    assert any("f32[7]" in s["signature"] for s in rep["signatures"])
+    # the warning event names the function and the signature count
+    from mmlspark_tpu.core.logging import recent_events
+    events = [e for e in recent_events()
+              if e.get("event") == "recompile_storm"
+              and e.get("fn") == "t.storm"]
+    assert events and events[0]["distinct_signatures"] == 4
+
+
+def test_sharding_changes_rekey_the_executable_cache(mesh8):
+    """Same shape, different placement must be a new cache entry — an AOT
+    executable is specialized to its inputs' shardings (the bug class the
+    sharded-grower test caught: a single-device compile fed sharded
+    arrays)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    reg = MetricsRegistry()
+
+    @instrumented_jit(name="t.shard", registry=reg)
+    def f(x):
+        return x * 2
+
+    x = jnp.ones((16,))
+    f(x)
+    xs = jax.device_put(np.ones((16,), np.float32),
+                        NamedSharding(mesh8, P("data")))
+    assert np.allclose(f(xs), 2.0)
+    assert _compiles(reg, "t.shard") == 2
+
+
+def test_gbdt_training_is_compile_stable_after_warmup():
+    """Acceptance: steady-shape training adds ZERO compile-counter churn
+    after warmup — run two identical-shape trainings and require the
+    second to compile nothing new anywhere."""
+    from mmlspark_tpu.lightgbm import GBDTParams, train
+    from mmlspark_tpu.observability import get_registry
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1500, 8)).astype(np.float32)
+
+    def y():
+        return (X[:, 0] + rng.normal(scale=0.1, size=len(X)) > 0).astype(
+            np.float32)
+
+    params = GBDTParams(num_iterations=4, objective="binary", max_depth=3)
+    train(X, y(), params)                     # warmup: compiles allowed
+    reg = get_registry()
+    fam = reg.counter("mmlspark_jit_compile_total", labels=("fn",))
+    before = {key: child.value
+              for key, child in fam._snapshot()}
+    train(X, y(), params)                     # same shapes: zero churn
+    after = {key: child.value for key, child in fam._snapshot()}
+    assert after == before, (
+        "steady-shape training recompiled: "
+        f"{ {k: (before.get(k), v) for k, v in after.items() if before.get(k) != v} }")
+
+
+# ------------------------------------------------------- device-plane gauges
+
+class _FakeDev:
+    def __init__(self, id, stats):
+        self.platform = "tpu"
+        self.id = id
+        self._stats = stats
+
+    def memory_stats(self):
+        return self._stats
+
+
+def test_device_memory_gauges_sample_memory_stats():
+    reg = MetricsRegistry()
+    dev = _FakeDev(0, {"bytes_in_use": 1234, "peak_bytes_in_use": 9999})
+    assert ensure_device_memory_gauges(reg, devices=[dev])
+    g = reg.gauge("mmlspark_device_bytes_in_use", labels=("device",))
+    assert g.value(device="tpu:0") == 1234
+    gp = reg.gauge("mmlspark_device_peak_bytes_in_use", labels=("device",))
+    assert gp.value(device="tpu:0") == 9999
+    dev._stats["bytes_in_use"] = 5678          # callback gauge: live sample
+    assert g.value(device="tpu:0") == 5678
+
+
+def test_device_memory_gauges_skip_platforms_without_introspection():
+    reg = MetricsRegistry()
+    assert not ensure_device_memory_gauges(reg, devices=[_FakeDev(0, None)])
+    assert reg.family("mmlspark_device_bytes_in_use") is None
+    # the cached negative verdict short-circuits the ambient path only;
+    # an explicit device list re-evaluates (late-attached accelerator)
+    assert ensure_device_memory_gauges(reg, devices=[_FakeDev(0, {"bytes_in_use": 1})])
+    assert reg.family("mmlspark_device_bytes_in_use") is not None
+
+
+def test_device_put_books_transfer_bytes_by_site():
+    reg = MetricsRegistry()
+    x = np.ones((10, 10), np.float32)
+    out = device_put(x, site="test.site", registry=reg)
+    assert np.allclose(np.asarray(out), x)
+    fam = reg.counter("mmlspark_device_transfer_bytes_total",
+                      labels=("site",))
+    assert fam.value(site="test.site") == 400.0
+    device_put({"a": x, "b": x}, site="test.site", registry=reg)  # pytree
+    assert fam.value(site="test.site") == 1200.0
+    assert transfer_nbytes([x, x]) == 800
+
+
+def test_build_info_gauge_carries_environment_labels():
+    reg = MetricsRegistry()
+    assert ensure_build_info(reg)
+    fam = reg.gauge("mmlspark_build_info",
+                    labels=("jax", "backend", "device_kind", "device_count"))
+    samples = reg.to_dict()["mmlspark_build_info"]["samples"]
+    assert len(samples) == 1
+    labels = samples[0]["labels"]
+    assert labels["jax"] == jax.__version__
+    assert labels["backend"] == jax.default_backend()
+    assert int(labels["device_count"]) == len(jax.local_devices())
+    assert samples[0]["value"] == 1.0
+    assert fam is not None
+
+
+# ----------------------------------------------------------- /debug/compile
+
+def test_debug_compile_endpoint_serves_the_report():
+    from mmlspark_tpu.serving import PipelineServer
+
+    reg = MetricsRegistry()
+
+    @instrumented_jit(name="t.served", registry=reg)
+    def f(x):
+        return x + 1
+
+    f(jnp.ones((4,)))
+    srv = PipelineServer(Doubler(), port=0, registry=reg).start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/debug/compile", timeout=5).read()
+        rep = json.loads(body.decode())
+        assert "t.served" in rep["functions"]
+        entry = rep["functions"]["t.served"]
+        assert entry["compiles"] == 1 and not entry["storm_tripped"]
+        assert entry["signatures"][0]["signature"] == "f32[4]"
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------------- traceparent
+
+def test_parse_traceparent_accepts_valid_and_rejects_malformed():
+    tid, sid = "0af7651916cd43dd8448eb211c80319c", "b7ad6b7169203331"
+    assert parse_traceparent(f"00-{tid}-{sid}-01") == (tid, sid)
+    assert parse_traceparent(f"00-{tid.upper()}-{sid}-01") == (tid, sid)
+    for bad in (None, "", "00-short-b7ad6b7169203331-01",
+                f"ff-{tid}-{sid}-01",                 # invalid version
+                f"00-{'0' * 32}-{sid}-01",            # all-zero trace id
+                f"00-{tid}-{'0' * 16}-01",            # all-zero span id
+                f"00-{tid}-{sid}",                    # missing flags
+                f"00-{tid}-{sid}-01-extra"):          # v00 forbids extras
+        assert parse_traceparent(bad) is None, bad
+
+
+def test_format_traceparent_round_trips_native_ids():
+    from mmlspark_tpu.observability.tracing import new_trace_id
+    tid = new_trace_id()
+    tp = format_traceparent(tid, "b7ad6b7169203331")
+    assert parse_traceparent(tp) == (tid, "b7ad6b7169203331")
+    # a foreign (non-hex) id adopted from the legacy header still renders
+    # a VALID traceparent, deterministically
+    tp2 = format_traceparent("my-custom-trace")
+    assert parse_traceparent(tp2) is not None
+    assert tp2.split("-")[1] == format_traceparent(
+        "my-custom-trace").split("-")[1]
+
+
+def test_server_adopts_and_echoes_traceparent():
+    """E2E over a real socket: an incoming ``traceparent`` sets the trace
+    id for the server-side spans (so /trace/<id> and exemplars join the
+    caller's W3C trace) and the reply echoes a valid traceparent next to
+    the legacy header."""
+    from mmlspark_tpu.observability.collector import get_collector
+    from mmlspark_tpu.serving import PipelineServer
+
+    reg = MetricsRegistry()
+    srv = PipelineServer(Doubler(), port=0, registry=reg).start()
+    tid = "0af7651916cd43dd8448eb211c80319c"
+    try:
+        req = urllib.request.Request(
+            srv.address, data=b"21",
+            headers={"Content-Type": "application/json",
+                     "traceparent": f"00-{tid}-b7ad6b7169203331-01"})
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert json.loads(r.read().decode()) == 42
+            assert r.headers["X-MMLSpark-Trace-Id"] == tid
+            echoed = parse_traceparent(r.headers["traceparent"])
+        assert echoed is not None and echoed[0] == tid
+        # the request span joined the W3C trace — resolvable by trace id
+        spans = get_collector(reg).trace(tid)
+        assert any(s.name == "serving.request" for s in spans)
+        # the echoed span id is the server-side request span's own id
+        assert echoed[1] in {s.span_id for s in spans}
+
+        # no traceparent in -> none out (legacy clients see no new header)
+        req2 = urllib.request.Request(
+            srv.address, data=b"2",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req2, timeout=5) as r2:
+            assert r2.headers["traceparent"] is None
+            assert r2.headers["X-MMLSpark-Trace-Id"]
+    finally:
+        srv.stop()
+
+
+def test_outbound_requests_carry_traceparent():
+    from mmlspark_tpu.io.http import HTTPRequestData, _with_trace_header
+    from mmlspark_tpu.observability.tracing import trace_span
+
+    reg = MetricsRegistry()
+    with trace_span("client.op", registry=reg) as span:
+        req = _with_trace_header(HTTPRequestData(url="http://x/"))
+        parsed = parse_traceparent(req.headers["traceparent"])
+        assert parsed == (span.trace_id, span.span_id)
+        assert req.headers["X-MMLSpark-Trace-Id"] == span.trace_id

@@ -108,6 +108,73 @@ def test_routing_client_prunes_breakers_for_departed_workers():
         svc.stop()
 
 
+def test_fleet_slow_merges_across_workers_with_attribution():
+    """ISSUE 6 acceptance: /fleet/slow returns a correctly merged,
+    worker-attributed top-K from >= 2 real-socket workers, and a dead
+    worker is isolated by its breaker while partial results still serve."""
+    from mmlspark_tpu.observability import MetricsRegistry
+
+    reg = MetricsRegistry()
+    svc = TopologyService(registry=reg, probe_interval_s=None,
+                          fleet_slow_deadline_s=5.0).start()
+    workers = [WorkerServer(Doubler(), server_id=f"w{i}",
+                            driver_address=svc.address, port=0,
+                            registry=reg).start() for i in range(2)]
+    try:
+        for i in range(4):          # real traffic on both workers' sockets
+            for w in workers:
+                assert _post(w.address, i) == 2 * i
+        got = json.loads(urllib.request.urlopen(
+            f"{svc.address}/fleet/slow?k=5", timeout=10).read().decode())
+        rows = got["slowest"]
+        assert 0 < len(rows) <= 5
+        assert {r["worker"] for r in rows} <= {"w0", "w1"}
+        assert {r["worker"] for r in rows} == {"w0", "w1"}, \
+            "both workers' requests must appear in the merged top-K"
+        durs = [r["durationS"] for r in rows]
+        assert durs == sorted(durs, reverse=True), "merge must be sorted"
+        assert got["workers"]["w0"]["count"] > 0
+        assert got["workers"]["w1"]["count"] > 0
+
+        # a registered-but-dead worker: error row first, breaker opens
+        # after its threshold, partial results always served
+        _post(f"{svc.address}/register",
+              {"server_id": "dead", "host": "127.0.0.1", "port": 9})
+        verdicts = []
+        for _ in range(4):
+            got = json.loads(urllib.request.urlopen(
+                f"{svc.address}/fleet/slow?k=3", timeout=10).read().decode())
+            assert len(got["slowest"]) > 0, \
+                "one dead worker must never blind the fleet view"
+            d = got["workers"]["dead"]
+            verdicts.append("error" if "error" in d else d.get("skipped"))
+        assert verdicts[0] == "error"
+        assert verdicts[-1] == "circuit_open", verdicts
+        assert "fleet-slow:dead" in reg.breakers
+    finally:
+        for w in workers:
+            w.stop()
+        svc.stop()
+
+
+def test_fleet_slow_prunes_breakers_for_departed_workers():
+    from mmlspark_tpu.observability import MetricsRegistry
+
+    reg = MetricsRegistry()
+    svc = TopologyService(registry=reg, probe_interval_s=None).start()
+    try:
+        _post(f"{svc.address}/register",
+              {"server_id": "ghost", "host": "127.0.0.1", "port": 9})
+        svc.fleet_slow(k=1)
+        assert "fleet-slow:ghost" in reg.breakers
+        _post(f"{svc.address}/deregister", {"server_id": "ghost"})
+        svc.fleet_slow(k=1)
+        assert "fleet-slow:ghost" not in reg.breakers, \
+            "departed worker must take its fan-out breaker with it"
+    finally:
+        svc.stop()
+
+
 def test_streaming_source_sink_round_trip():
     query = (read_stream()
              .server(port=0, api_path="/score")

@@ -10,8 +10,11 @@ shadows the public verb drops out of the event ring, the span trace, AND
 the ``mmlspark_span_seconds`` metrics at once, so this sweep is the only
 thing standing between a refactor and a silent observability hole.
 """
+import ast
 import inspect
+import pathlib
 
+import mmlspark_tpu
 from mmlspark_tpu.codegen import all_stage_classes
 from mmlspark_tpu.core.pipeline import Estimator, Transformer
 
@@ -80,6 +83,85 @@ def test_lightgbm_phase_histogram_carries_backend_and_quant_labels():
     # regardless of backend, so the only way to lose the packed phase is
     # to lose the labels above or the observation below
     assert src.count('_observe_phase("histogram_split_update"') >= 2
+
+
+#: hot-module directories whose jit entry points must carry compute-plane
+#: telemetry (ISSUE 6 contract)
+JIT_SWEEP_DIRS = ("lightgbm", "ops", "parallel", "serving")
+
+#: call targets that hand a function to the XLA compiler
+_JIT_TARGETS = {"jax.jit", "jax.pmap", "jax.shard_map", "shard_map",
+                "jax.experimental.shard_map.shard_map"}
+
+
+def _dotted(fn) -> str:
+    parts = []
+    while isinstance(fn, ast.Attribute):
+        parts.append(fn.attr)
+        fn = fn.value
+    if isinstance(fn, ast.Name):
+        parts.append(fn.id)
+    return ".".join(reversed(parts))
+
+
+def test_every_jit_call_site_is_instrumented_or_justified():
+    """Compute-plane coverage sweep: every ``jax.jit``/``jax.shard_map``
+    call site in the hot modules either routes through
+    ``observability.compute.instrumented_jit`` (lexically — the raw call
+    is an argument of an ``instrumented_jit(...)`` call) or carries a
+    ``# raw-jit: <why>`` pragma within two lines above it.  Otherwise a
+    refactor could silently reopen the below-jit observability hole this
+    PR closed: compiles, recompile storms, and cost analysis all vanish
+    for that site."""
+    root = pathlib.Path(mmlspark_tpu.__file__).parent
+    offenders = []
+    for sub in JIT_SWEEP_DIRS:
+        for path in sorted((root / sub).rglob("*.py")):
+            src = path.read_text()
+            lines = src.splitlines()
+            tree = ast.parse(src)
+            parents = {}
+            for node in ast.walk(tree):
+                for child in ast.iter_child_nodes(node):
+                    parents[child] = node
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call) or \
+                        _dotted(node.func) not in _JIT_TARGETS:
+                    continue
+                cur, routed = parents.get(node), False
+                while cur is not None:
+                    if isinstance(cur, ast.Call) and \
+                            _dotted(cur.func).endswith("instrumented_jit"):
+                        routed = True
+                        break
+                    cur = parents.get(cur)
+                if routed:
+                    continue
+                window = lines[max(0, node.lineno - 3):node.lineno]
+                if any("# raw-jit:" in ln for ln in window):
+                    continue
+                offenders.append(
+                    f"{path.relative_to(root)}:{node.lineno} "
+                    f"{_dotted(node.func)}")
+    assert not offenders, (
+        "raw jit/shard_map call sites outside instrumented_jit (route them "
+        "through observability.compute.instrumented_jit, or justify with a "
+        f"'# raw-jit: <why>' pragma): {offenders}")
+
+
+def test_trainer_books_compute_phase_breakdown():
+    """Source-level contract for the compute.train_step breakdown: the
+    trainer must book trace/dispatch phases into the labelled phase
+    histogram and gate the device-time sync behind the sampling knob."""
+    from mmlspark_tpu.parallel import trainer as trainer_mod
+
+    src = inspect.getsource(trainer_mod.Trainer.train_step)
+    assert 'phase="trace"' in src and 'phase="dispatch"' in src \
+        and 'phase="device"' in src
+    assert "device_time_every" in src and "block_until_ready" in src, \
+        "device-time sampling lost its opt-in gate"
+    init_src = inspect.getsource(trainer_mod.Trainer.__init__)
+    assert '"mmlspark_parallel_train_step_phase_seconds"' in init_src
 
 
 def test_every_stage_routes_verbs_through_log_verb():
