@@ -150,7 +150,32 @@ def phase_gbdt(n=1_000_000, f=200, iters_a=8, iters_b=24, reps=3) -> None:
         rates.append(n * (iters_b - iters_a) / max(t_b - t_a, 1e-9))
         _log(f"[bench] gbdt rep rate {rates[-1]:.0f}")
     rates.sort()
-    print(f"GBDT_RPS {rates[len(rates) // 2]} {n}", flush=True)
+    rate = rates[len(rates) // 2]
+    print(f"GBDT_RPS {rate} {n}", flush=True)
+
+    # achievable-utilization denominator (PR 6 follow-up): the instrumented
+    # jit captured cost_analysis for the per-iteration program — fold its
+    # bytes-accessed into an HBM-roofline utilization % so tile-size tuning
+    # (and the fused-kernel item) have a denominator, not just a rate.
+    try:
+        from mmlspark_tpu.observability.compute import compile_report
+        fns = compile_report()["functions"]
+        if "lightgbm.multi_iter" in fns:
+            cost = fns["lightgbm.multi_iter"].get("last_cost_analysis") or {}
+            ch = int(os.environ.get("MMLSPARK_TPU_GBDT_CHUNK") or 4)
+        else:
+            cost = (fns.get("lightgbm.iter") or {}).get(
+                "last_cost_analysis") or {}
+            ch = 1
+        bytes_prog = cost.get("bytes_accessed")
+        if bytes_prog:
+            bytes_per_iter = bytes_prog / max(1, ch)
+            peak = float(os.environ.get("MMLSPARK_TPU_PEAK_HBM_GBPS",
+                                        "819")) * 1e9
+            util_pct = 100.0 * bytes_per_iter * (rate / n) / peak
+            print(f"GBDT_UTIL {bytes_per_iter} {util_pct}", flush=True)
+    except Exception as e:  # noqa: BLE001 — cost analysis is best-effort
+        _log(f"[bench] gbdt util skipped: {e}")
 
 
 def phase_hist_ab(n=1_000_000, f=200, nodes=16, reps=3, proxy=0) -> None:
@@ -226,6 +251,57 @@ def phase_hist_ab(n=1_000_000, f=200, nodes=16, reps=3, proxy=0) -> None:
     print(f"HIST_AB_RATES {r_f32} {r_packed} {r_packed / max(r_f32, 1e-9)}", flush=True)
     print(f"HIST_AB_MODE {'cpu_scatter_proxy' if proxy else 'tpu_matmul'} "
           f"{n} {f}", flush=True)
+
+
+def phase_ooc(n=200_000, f=50, iters=8, tiles=4, reps=3) -> None:
+    """Out-of-core streamed-vs-in-memory A/B at a fits-in-memory shape —
+    the OVERHEAD bound for the chunked pipeline (ISSUE 7 acceptance:
+    streamed >= 0.9x in-memory when tiling buys nothing, with the
+    prefetch-overlap %% reported so a miss is attributable to transfer
+    stalls vs per-pass overhead).  Same trainer config both sides; the
+    streamed run forces ``tiles`` tiles through ``tile_rows``.  Labels
+    perturb per rep (relay result-cache busting, as phase_gbdt)."""
+    from __graft_entry__ import enable_compilation_cache
+    enable_compilation_cache()
+    import numpy as np
+    from mmlspark_tpu.lightgbm import GBDTParams, train, train_streamed
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y0 = (X[:, 0] + 0.5 * X[:, 1] + rng.normal(scale=0.3, size=n) > 0) \
+        .astype(np.float32)
+    nonce = [0]
+
+    def fresh_y():
+        nonce[0] += 1
+        y = y0.copy()
+        a = (37 * nonce[0]) % (n - 64)
+        y[a:a + 64] = 1.0 - y[a:a + 64]
+        return y
+
+    pkw = dict(num_iterations=iters, objective="binary", max_depth=5)
+    tile_rows = -(-n // max(1, tiles))
+    t0 = time.perf_counter()
+    train(X, fresh_y(), GBDTParams(**pkw))
+    train_streamed(X, fresh_y(), GBDTParams(**pkw), tile_rows=tile_rows)
+    _log(f"[bench] ooc warm(compile) {time.perf_counter() - t0:.0f}s")
+    r_mem, r_str, overlaps = [], [], []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        train(X, fresh_y(), GBDTParams(**pkw))
+        r_mem.append(n * iters / max(time.perf_counter() - t0, 1e-9))
+        t0 = time.perf_counter()
+        res = train_streamed(X, fresh_y(), GBDTParams(**pkw),
+                             tile_rows=tile_rows)
+        r_str.append(n * iters / max(time.perf_counter() - t0, 1e-9))
+        overlaps.append(res.extras["prefetch_overlap_pct"])
+        _log(f"[bench] ooc rep inmem {r_mem[-1]:.0f} streamed {r_str[-1]:.0f}"
+             f" overlap {overlaps[-1]:.1f}%")
+    r_mem.sort(), r_str.sort(), overlaps.sort()
+    mid = len(r_mem) // 2
+    print(f"OOC_AB {r_mem[mid]} {r_str[mid]} "
+          f"{r_str[mid] / max(r_mem[mid], 1e-9)} {overlaps[mid]} {tiles}",
+          flush=True)
 
 
 def phase_resnet(batch=256, steps=8, hw=224, reps=3) -> None:
@@ -563,6 +639,32 @@ def _record_hist_ab(got: dict) -> bool:
     return True
 
 
+def _record_ooc(got: dict) -> bool:
+    """Fold an ooc child's OOC_AB marker into extras; False when absent."""
+    vals = got.get("OOC_AB")
+    if isinstance(vals, str) or not vals or len(vals) < 4:
+        return False
+    ex = RESULT["extras"]
+    ex["ooc_inmemory_rows_per_sec"] = round(vals[0], 1)
+    ex["ooc_streamed_rows_per_sec"] = round(vals[1], 1)
+    ex["ooc_streamed_vs_inmemory"] = round(vals[2], 3)
+    ex["ooc_prefetch_overlap_pct"] = round(vals[3], 2)
+    if len(vals) >= 5:
+        ex["ooc_tiles"] = int(vals[4])
+    return True
+
+
+def _record_gbdt_util(got: dict) -> bool:
+    """Fold GBDT_UTIL (cost-analysis bytes/iter + HBM-roofline utilization
+    %) into extras; False when the child had no cost analysis."""
+    vals = got.get("GBDT_UTIL")
+    if isinstance(vals, str) or not vals or len(vals) < 2:
+        return False
+    RESULT["extras"]["gbdt_hbm_bytes_per_iter"] = round(vals[0], 1)
+    RESULT["extras"]["gbdt_achievable_util_pct"] = round(vals[1], 2)
+    return True
+
+
 def _health_gate(spawn=None, attempts: int = 3, idle: float = 150,
                  hard: float = 200, backoff_s: float = 15.0,
                  sleep=time.sleep):
@@ -649,26 +751,39 @@ def _run_measured_phases(tpu_ok: bool, cpu_rps: float) -> None:
     ``main`` so the warm-relay holder's kill rides one ``finally``."""
     tpu_rps = 0.0
     if tpu_ok:
-        # Phase 2 — headline metric: GBDT rows/sec on the real chip.
-        got = _collect(_spawn("gbdt", _tpu_env()), "GBDT_RPS", idle=600,
-                       hard=1200)
-        if got is None:  # degraded fallback: quarter-size, same trainer
+        # Phase 2 — headline metric: GBDT rows/sec on the real chip (the
+        # GBDT_UTIL marker rides along: cost-analysis bytes -> achievable-
+        # utilization %, the tile-size tuning denominator).
+        got = _collect_multi(_spawn("gbdt", _tpu_env()),
+                             ("GBDT_RPS", "GBDT_UTIL"), idle=600, hard=1200)
+        if got.get("GBDT_RPS") is None:
+            # degraded fallback: quarter-size, same trainer
             _note("gbdt", "1M run stalled/overran; retried quarter-size")
-            got = _collect(_spawn("gbdt", _tpu_env(),
-                                  ["--n", "250000", "--iters_b", "10",
-                                   "--reps", "1"]),
-                           "GBDT_RPS", idle=300, hard=500)
-            if got:
+            got = _collect_multi(_spawn("gbdt", _tpu_env(),
+                                        ["--n", "250000", "--iters_b", "10",
+                                         "--reps", "1"]),
+                                 ("GBDT_RPS", "GBDT_UTIL"), idle=300,
+                                 hard=500)
+            if got.get("GBDT_RPS"):
                 RESULT["extras"]["note"] = (
                     "measured at 250k x 200 (1M run exceeded its deadline); "
                     "rows/sec is the steady-state marginal rate, ~linear in rows")
-        if got:
-            tpu_rps = got[0]
+        _record_gbdt_util(got)
+        if got.get("GBDT_RPS"):
+            tpu_rps = got["GBDT_RPS"][0]
             RESULT["value"] = round(tpu_rps, 1)
             if cpu_rps:
                 RESULT["vs_baseline"] = round(tpu_rps / cpu_rps, 3)
         else:
             _note("gbdt", "both attempts failed; no TPU headline number")
+        _emit()
+
+        # Phase 2c — out-of-core streamed-vs-in-memory A/B on the chip
+        # (overhead bound at a fits-in-HBM shape + prefetch overlap %).
+        got = _collect_multi(_spawn("ooc", _tpu_env()), ("OOC_AB",),
+                             idle=600, hard=1100)
+        if not _record_ooc(got):
+            _note("ooc", "TPU streamed A/B stalled/failed; CPU proxy will run")
         _emit()
 
         # Phase 2b — packed-int vs f32 histogram build A/B at the bench
@@ -728,6 +843,16 @@ def _run_measured_phases(tpu_ok: bool, cpu_rps: float) -> None:
             _note("hist_ab", "CPU proxy A/B also failed; no packed number")
         _emit()
 
+    # Phase 4c — out-of-core A/B CPU proxy (relay-down cover, same as the
+    # hist_ab proxy): the round artifact always carries the streamed
+    # overhead bound + prefetch-overlap number for the chunked pipeline.
+    if "ooc_streamed_vs_inmemory" not in RESULT["extras"]:
+        got = _collect_multi(_spawn("ooc", _cpu_env()), ("OOC_AB",),
+                             idle=500, hard=900)
+        if not _record_ooc(got):
+            _note("ooc", "CPU proxy streamed A/B also failed; no ooc number")
+        _emit()
+
     # Phase 5 — serving latency + sustained load (pure host, CPU platform).
     sproc = _spawn("serving", _cpu_env())
     got = _collect_multi(sproc, ("SERVING_P50_MS", "SERVING_LOAD"),
@@ -750,6 +875,6 @@ if __name__ == "__main__":
             kw[rest[i].lstrip("-")] = int(rest[i + 1])
         {"health": phase_health, "gbdt": phase_gbdt, "ranker": phase_ranker,
          "resnet": phase_resnet, "cpu": phase_cpu, "hist_ab": phase_hist_ab,
-         "serving": phase_serving}[phase](**kw)
+         "ooc": phase_ooc, "serving": phase_serving}[phase](**kw)
     else:
         main()

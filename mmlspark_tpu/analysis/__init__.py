@@ -17,7 +17,7 @@ from .baseline import (BaselineEntry, load_baseline, save_baseline,
                        split_findings, update_baseline)
 from .checkers import (HotPathChecker, LockDisciplineChecker,
                        ResilienceCoverageChecker, TracerSafetyChecker,
-                       UndeadlinedRetryChecker)
+                       TransferDisciplineChecker, UndeadlinedRetryChecker)
 from .cli import default_checkers, main, rule_catalog, run_analysis
 from .engine import AnalysisEngine, Checker, Finding, iter_python_files
 from .stagecheck import StageContractChecker
@@ -25,7 +25,8 @@ from .stagecheck import StageContractChecker
 __all__ = [
     "AnalysisEngine", "BaselineEntry", "Checker", "Finding",
     "HotPathChecker", "LockDisciplineChecker", "ResilienceCoverageChecker",
-    "StageContractChecker", "TracerSafetyChecker", "UndeadlinedRetryChecker",
+    "StageContractChecker", "TracerSafetyChecker",
+    "TransferDisciplineChecker", "UndeadlinedRetryChecker",
     "default_checkers", "iter_python_files", "load_baseline", "main",
     "rule_catalog", "run_analysis", "save_baseline", "split_findings",
     "update_baseline",

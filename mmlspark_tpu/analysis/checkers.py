@@ -15,7 +15,7 @@ from .engine import Checker, Finding, ModuleContext, with_lock_items
 
 __all__ = ["TracerSafetyChecker", "ResilienceCoverageChecker",
            "UndeadlinedRetryChecker", "LockDisciplineChecker",
-           "HotPathChecker"]
+           "HotPathChecker", "TransferDisciplineChecker"]
 
 
 # ---------------------------------------------------------------------------
@@ -360,6 +360,41 @@ class UndeadlinedRetryChecker(Checker):
                    args.posonlyargs + args.args + args.kwonlyargs):
                 return True
         return False
+
+
+# ---------------------------------------------------------------------------
+# CMP — compute-plane transfer discipline
+# ---------------------------------------------------------------------------
+
+class TransferDisciplineChecker(Checker):
+    """CMP — every host->device placement must route through
+    ``observability.compute.device_put`` so
+    ``mmlspark_device_transfer_bytes_total{site}`` sees it.  The out-of-core
+    streaming pipeline tunes tile sizes against those counters: a raw
+    ``jax.device_put`` is a transfer that silently escapes the accounting,
+    making the prefetch-overlap numbers lie exactly where they matter."""
+
+    rules = {"CMP001": "raw jax.device_put outside observability/compute.py "
+                       "(bypasses the per-site transfer counters)"}
+
+    #: the instrumented wrapper itself is the one sanctioned call site
+    ALLOWED = ("observability/compute.py",)
+
+    def interested(self, relpath: str) -> bool:
+        norm = f"/{relpath}"
+        return not any(norm.endswith(f"/{a}") for a in self.ALLOWED)
+
+    def visit(self, node: ast.AST, ctx: ModuleContext) -> None:
+        if not isinstance(node, ast.Call):
+            return
+        dotted = ctx.dotted_name(node.func)
+        if dotted == "jax.device_put":
+            ctx.report(
+                "CMP001", node,
+                "jax.device_put() — untracked host->device transfer; route "
+                "through observability.compute.device_put(site=...) so the "
+                "transfer counters (and the out-of-core overlap tuning "
+                "built on them) stay truthful")
 
 
 # ---------------------------------------------------------------------------

@@ -21,7 +21,7 @@ from .baseline import (DEFAULT_BASELINE_NAME, load_baseline, split_findings,
                        update_baseline)
 from .checkers import (HotPathChecker, LockDisciplineChecker,
                        ResilienceCoverageChecker, TracerSafetyChecker,
-                       UndeadlinedRetryChecker)
+                       TransferDisciplineChecker, UndeadlinedRetryChecker)
 from .engine import AnalysisEngine, Checker, Finding, iter_python_files
 from .stagecheck import StageContractChecker
 
@@ -31,7 +31,8 @@ __all__ = ["default_checkers", "run_analysis", "main", "rule_catalog"]
 def default_checkers() -> List[Checker]:
     return [TracerSafetyChecker(), ResilienceCoverageChecker(),
             UndeadlinedRetryChecker(), LockDisciplineChecker(),
-            HotPathChecker(), StageContractChecker()]
+            HotPathChecker(), TransferDisciplineChecker(),
+            StageContractChecker()]
 
 
 def rule_catalog() -> dict:
@@ -78,7 +79,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         prog="graft-lint",
         description="AST invariant checker: tracer safety (TRC), resilience "
                     "coverage (RES), lock discipline (LCK), hot-path "
-                    "hygiene (HOT), stage contracts (STG).")
+                    "hygiene (HOT), transfer discipline (CMP), stage "
+                    "contracts (STG).")
     parser.add_argument("paths", nargs="*",
                         help="files/dirs to scan (default: the "
                              "mmlspark_tpu package)")

@@ -2,12 +2,15 @@ from .http import (HTTPRequestData, HTTPResponseData, HTTPClient,
                    AsyncHTTPClient, HTTPTransformer, SimpleHTTPTransformer,
                    REQUEST_BINDING, RESPONSE_BINDING)
 from .binary import read_binary_files, list_files, BinaryFileStream
+from .chunked import (ChunkedDataset, TilePrefetcher, resolve_tile_rows,
+                      pad_tile)
 from .image import read_images, decode_image, images_to_bytes_column
 from . import powerbi
 
 __all__ = ["HTTPRequestData", "HTTPResponseData", "HTTPClient",
            "AsyncHTTPClient", "HTTPTransformer", "SimpleHTTPTransformer",
            "REQUEST_BINDING", "RESPONSE_BINDING", "read_binary_files",
-           "BinaryFileStream",
+           "BinaryFileStream", "ChunkedDataset", "TilePrefetcher",
+           "resolve_tile_rows", "pad_tile",
            "list_files", "read_images", "decode_image",
            "images_to_bytes_column", "powerbi"]

@@ -1,10 +1,11 @@
-from .binning import BinMapper
-from .core import GBDTParams, train, TrainResult
+from .binning import BinMapper, StreamingQuantileSketch
+from .core import GBDTParams, train, train_streamed, TrainResult
 from .estimators import (LightGBMClassifier, LightGBMClassificationModel,
                          LightGBMRegressor, LightGBMRegressionModel,
                          LightGBMRanker, LightGBMRankerModel)
 
-__all__ = ["BinMapper", "GBDTParams", "train", "TrainResult",
+__all__ = ["BinMapper", "StreamingQuantileSketch", "GBDTParams", "train",
+           "train_streamed", "TrainResult",
            "LightGBMClassifier", "LightGBMClassificationModel",
            "LightGBMRegressor", "LightGBMRegressionModel",
            "LightGBMRanker", "LightGBMRankerModel"]

@@ -323,7 +323,11 @@ def _params_sig(p: "GBDTParams") -> tuple:
             p.bagging_fraction, p.bagging_freq,
             tuple(p.categorical_features or ()), tuple(p.cat_subset or ()),
             p.max_cat_to_onehot, p.cat_smooth, p.cat_l2, p.max_cat_threshold,
-            p.voting_k, p.use_quantized_grad, p.num_grad_quant_bins)
+            p.voting_k, p.use_quantized_grad, p.num_grad_quant_bins,
+            # the quantizer's stochastic-rounding seed is baked into every
+            # traced grower closure — without it in the key a second train()
+            # with a different seed would silently reuse the old noise
+            p.seed)
 
 
 def _cached(key, builder):
@@ -725,6 +729,39 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
     rc_const = jnp.asarray(rc_np)
     return grow
 
+def leafwise_store_dtype(n_bound, use_quant: bool, quant_bins: int,
+                         enabled: bool = True):
+    """Storage dtype for the leaf-wise grower's per-leaf histogram carry
+    (the ``(L, F, B, 3)`` buffer sibling subtraction reads from).
+
+    Quantized sums are bounded by the STATIC row bound: every cell holds at
+    most ``n_bound * (quant_bins - 1)`` (hess lane — the widest; ``|qg|``
+    sums and counts are smaller), so when that fits int16 the stored buffer
+    halves with zero information loss — the arithmetic (build, psum,
+    subtraction) stays int32 and only the carry narrows.  This is exactly
+    the regime out-of-core tiling creates: small per-tile row bounds make
+    the stored histograms the dominant resident tensor, and 2-bit gradients
+    (``num_grad_quant_bins=4``) stretch the int16 window to ~10.9k rows.
+    ``n_bound=None`` (sharded without a declared global bound) and float
+    mode keep the wide dtypes.  ``MMLSPARK_TPU_HIST_STORE16=0`` is the
+    operational escape hatch (read at trace time, keyed into the jit
+    caches via ``_resolve_hist_backend``).
+    """
+    import jax.numpy as jnp
+    if not use_quant:
+        return jnp.float32
+    qh_cap = max(1, quant_bins - 1)
+    if enabled and n_bound is not None and int(n_bound) * qh_cap < (1 << 15):
+        return jnp.int16
+    return jnp.int32
+
+
+def _store16_enabled() -> bool:
+    import os
+    raw = os.environ.get("MMLSPARK_TPU_HIST_STORE16", "").strip().lower()
+    return raw not in ("0", "false", "off", "no")
+
+
 def make_leafwise_grower(num_leaves: int, depth_cap: int, num_features: int,
                          num_bins: int, params: GBDTParams,
                          axis_name: str = None, backend: str = "auto",
@@ -758,6 +795,8 @@ def make_leafwise_grower(num_leaves: int, depth_cap: int, num_features: int,
     use_quant = bool(params.use_quantized_grad)
     quant_bins = params.num_grad_quant_bins
     _check_quant_psum_bound(use_quant, quant_bins, axis_name, psum_row_bound)
+    store16_ok = _store16_enabled()   # read OUTSIDE traced code; train()
+    #                                   keys its jit caches on the env knob
     L, M, F, B = num_leaves, num_leaves - 1, num_features, num_bins
     ct = _CatTools(params, F, B)
     cat_np, sub_np = ct.cat_np, ct.sub_np
@@ -943,6 +982,17 @@ def make_leafwise_grower(num_leaves: int, depth_cap: int, num_features: int,
         h_root = psum_maybe(local_hist(hist_mask))
         g0, f0, b0, lp0, tot0, m0 = best_of(h_root, feat_mask, depth_ok_of(0))
 
+        # stored-histogram carry dtype: int16 when the STATIC row bound
+        # keeps every quantized cell under 15 bits (sums stay exact; the
+        # arithmetic below is int32 and only the carry narrows).  The bound
+        # is this shard's n when stored histograms are local (single-shard
+        # or voting), the declared global psum bound when they are global.
+        stored_bound = n if (axis_name is None or use_voting) \
+            else psum_row_bound
+        st_dtype = leafwise_store_dtype(stored_bound, use_quant, quant_bins,
+                                        store16_ok) if use_quant \
+            else jnp.float32
+
         carry0 = dict(
             leaf_of_row=leaf_of_row,
             lc_arr=jnp.full((M,), -1, jnp.int32),
@@ -953,9 +1003,8 @@ def make_leafwise_grower(num_leaves: int, depth_cap: int, num_features: int,
             sg=jnp.zeros((M,), jnp.float32),
             iv=jnp.zeros((M,), jnp.float32),
             ic=jnp.zeros((M,), jnp.float32),
-            hists=jnp.zeros((L, F, B, 3),
-                            jnp.int32 if use_quant else jnp.float32)
-            .at[0].set(h_root),
+            hists=jnp.zeros((L, F, B, 3), st_dtype)
+            .at[0].set(h_root.astype(st_dtype)),
             best_gain=jnp.full((L,), -jnp.inf).at[0].set(g0),
             best_feat=jnp.zeros((L,), jnp.int32).at[0].set(f0),
             best_bin=jnp.zeros((L,), jnp.int32).at[0].set(b0),
@@ -1037,9 +1086,12 @@ def make_leafwise_grower(num_leaves: int, depth_cap: int, num_features: int,
             hl = local_hist(hist_mask & (c["leaf_of_row"] == j))
             if axis_name is not None and not use_voting:
                 hl = psum_hist(hl)
-            hr = c["hists"][j] - hl
-            c["hists"] = set_if(c["hists"], j, hl, do, L)
-            c["hists"] = set_if(c["hists"], new_leaf, hr, do, L)
+            # subtraction widens back to the build dtype: the int16 carry
+            # is storage-only, the integer arithmetic stays exact in int32
+            hr = c["hists"][j].astype(hl.dtype) - hl
+            c["hists"] = set_if(c["hists"], j, hl.astype(st_dtype), do, L)
+            c["hists"] = set_if(c["hists"], new_leaf, hr.astype(st_dtype),
+                                do, L)
 
             dok = depth_ok_of(d_new)
             gl, fl, bl, lpl, _, ml = best_of(hl, feat_mask, dok)
@@ -1246,7 +1298,8 @@ def _resolve_hist_backend() -> tuple:
             os.environ.get("MMLSPARK_TPU_HIST_LO", ""),
             os.environ.get("MMLSPARK_TPU_HIST_RESID", ""),
             os.environ.get("MMLSPARK_TPU_HIST_LAYOUT", ""),
-            os.environ.get("MMLSPARK_TPU_HIST_QUANT", ""))
+            os.environ.get("MMLSPARK_TPU_HIST_QUANT", ""),
+            os.environ.get("MMLSPARK_TPU_HIST_STORE16", ""))
 
 
 def _make_grower(p: GBDTParams, F: int, B: int, axis_name: str = None,
@@ -1265,6 +1318,9 @@ class TrainResult:
     booster: GBDTBooster
     evals: List[Dict[str, float]]
     bin_mapper: BinMapper
+    # out-of-core runs attach streaming diagnostics (tile geometry +
+    # prefetch-overlap accounting); in-memory train() leaves it None
+    extras: Optional[Dict[str, float]] = None
 
 
 def _content_fingerprint(arr: np.ndarray) -> int:
@@ -1922,3 +1978,636 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
     _train_span.set_attribute("growth", p.growth)
     export_span(_train_span)
     return TrainResult(booster=booster, evals=evals, bin_mapper=mapper)
+
+
+# ---------------------------------------------------------------------------
+# out-of-core streamed training (ISSUE 7): host-RAM tiles -> device HBM
+# ---------------------------------------------------------------------------
+
+def _check_quant_tile_bound(use_quant: bool, quant_bins: int,
+                            total_rows: int) -> None:
+    """Tile-accumulation twin of ``_check_quant_psum_bound``: each per-tile
+    build guards int32 overflow against its OWN tile's rows, but the driver
+    accumulates decoded partials across every tile — a root-level cell can
+    hold the full dataset's sums, so the guard must see the total."""
+    if not use_quant:
+        return
+    qh_cap = max(1, quant_bins - 1)
+    if int(total_rows) * qh_cap >= (1 << 31):
+        raise ValueError(
+            "quantized histograms overflow int32 when accumulated across "
+            f"tiles above {(1 << 31) // qh_cap} total rows at {quant_bins} "
+            "quantization bins — lower num_grad_quant_bins or disable "
+            "use_quantized_grad")
+
+
+def _np_leaf_output(G, H, l1: float, l2: float, max_delta: float):
+    """Host-side twin of the growers' leaf_output (f32 in, f32 out).
+    Empty nodes (G=H=0, l2=0) yield NaN exactly like the device version —
+    callers mask them behind a count check, so the numpy warning is
+    suppressed rather than papered over with a fake value."""
+    with np.errstate(invalid="ignore", divide="ignore"):
+        t = np.sign(G) * np.maximum(np.abs(G) - l1, 0.0)
+        v = (-t / (H + l2)).astype(np.float32)
+    if max_delta > 0:
+        v = np.clip(v, -max_delta, max_delta)
+    return v
+
+
+def train_streamed(X, y: Optional[np.ndarray] = None, params: GBDTParams = None,
+                   sample_weight: Optional[np.ndarray] = None,
+                   valid: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+                   tile_rows: Optional[int] = None,
+                   memory_budget_bytes: Optional[int] = None,
+                   feature_names: Optional[List[str]] = None) -> TrainResult:
+    """Out-of-core boosting: the dataset lives in host RAM and streams
+    through the device in fixed-shape tiles with double-buffered prefetch
+    (Snap ML's host->HBM hierarchy, ``io.chunked``).  Nothing row-sized is
+    ever resident on the device except the two live tiles, so the trainable
+    dataset is bounded by host RAM, not HBM.
+
+    Numerics contract (tested): bin edges come from a streaming quantile
+    sketch (identical to the in-memory fit whenever the stream fits the
+    sample budget); quantization scales come from a global max first pass
+    over every tile, so each tile quantizes in IDENTICAL units and the
+    per-tile int32 histogram partials accumulate bit-exactly to the
+    monolithic build; split decisions therefore see the same integer sums
+    either way.  The only divergence from ``train`` is the stochastic
+    rounding noise (keyed per tile instead of per dataset), which is
+    unbiased — end-to-end parity holds within the committed accuracy-gate
+    precisions.
+
+    Both grower families stream: ``growth="level"`` runs one accumulate ->
+    decide -> route cycle per level (D passes over the tiles per tree);
+    ``growth="leaf"`` rebuilds the split leaf's left child per step and
+    derives the sibling by exact integer subtraction from a host-resident
+    stored-histogram table (``num_leaves - 1`` passes per tree).
+
+    ``X`` may be a raw ``(n, F)`` array or a prebuilt
+    :class:`~mmlspark_tpu.io.chunked.ChunkedDataset` (then ``y``/``w`` ride
+    its columns).  Tile size resolves from ``tile_rows`` /
+    ``memory_budget_bytes`` / ``MMLSPARK_TPU_TILE_ROWS`` (see
+    ``io.chunked.resolve_tile_rows``); prefetch overlap books into
+    ``mmlspark_prefetch_wait_seconds`` / ``mmlspark_tile_compute_seconds``
+    and is returned in ``TrainResult.extras``.
+
+    Not (yet) streamed: multiclass, lambdarank, dart/goss/rf, categorical
+    features, and ``shard_rows`` (the multi-host composition — per-tile
+    accumulation under ``collectives.histogram_psum(num_tiles=)`` — is
+    exercised at the collective level; see docs/out_of_core.md).
+    """
+    import jax
+    import jax.numpy as jnp
+    from ..io.chunked import ChunkedDataset, TilePrefetcher, pad_tile
+    from ..observability.compute import device_put as _obs_device_put
+    from ..observability.tracing import Span, current_span, export_span
+    from ..ops import histogram as hist_ops
+
+    if params is None:
+        raise ValueError("params is required")
+    p = params.resolve()
+    if p.objective in ("lambdarank", "multiclass"):
+        raise ValueError(f"streamed training does not support objective="
+                         f"{p.objective!r} yet (see docs/out_of_core.md)")
+    if p.boosting_type != "gbdt":
+        raise ValueError("streamed training supports boosting_type='gbdt' "
+                         f"only (got {p.boosting_type!r})")
+    if p.categorical_features:
+        raise ValueError("streamed training does not support categorical "
+                         "features yet (see docs/out_of_core.md)")
+
+    # ---- dataset geometry
+    if isinstance(X, ChunkedDataset):
+        cd = X
+        if tile_rows is not None or memory_budget_bytes is not None:
+            raise ValueError("tile sizing belongs to the ChunkedDataset "
+                             "when one is passed directly")
+        y = cd.columns.get("y") if y is None else np.asarray(y, np.float32)
+        w = cd.columns.get("w")
+        if w is not None and sample_weight is not None:
+            raise ValueError("sample weights belong to the ChunkedDataset "
+                             "('w' column) when one is passed directly")
+    else:
+        cd = ChunkedDataset(np.asarray(X, np.float32), tile_rows=tile_rows,
+                            memory_budget_bytes=memory_budget_bytes)
+        w = None
+    if y is None:
+        raise ValueError("labels are required (y= or a 'y' dataset column)")
+    y = np.asarray(y, np.float32)
+    n, F = cd.n_rows, cd.num_features
+    T = cd.tile_rows
+    if w is None:
+        w = np.ones(n, np.float32) if sample_weight is None \
+            else np.asarray(sample_weight, np.float32)
+    if len(y) != n or len(w) != n:
+        raise ValueError("X, y and sample_weight row counts disagree")
+    if p.objective in ("poisson", "tweedie") and (y < 0).any():
+        raise ValueError(f"objective {p.objective!r} requires non-negative "
+                         "labels")
+    if p.objective == "gamma" and (y <= 0).any():
+        raise ValueError("objective 'gamma' requires strictly positive "
+                         "labels")
+
+    # ---- backend / quantization resolution (same contract as train())
+    hist_cfg = _resolve_hist_backend()
+    hist_backend = hist_cfg[0]
+    _uq = p.use_quantized_grad
+    if hist_cfg[5].strip():
+        _uq = hist_cfg[5].strip().lower() not in ("0", "false", "off", "no")
+    if _uq is None:
+        _uq = jax.default_backend() != "cpu"
+    p = dataclasses.replace(p, use_quantized_grad=bool(_uq))
+    use_quant = p.use_quantized_grad
+    qb = p.num_grad_quant_bins
+    qg_cap = max(1, qb // 2)
+    qh_cap = max(1, qb - 1)
+    _check_quant_tile_bound(use_quant, qb, n)
+    sig = _params_sig(p) + (hist_cfg,)
+
+    _parent = current_span()
+    _span = Span("lightgbm.train_streamed",
+                 trace_id=_parent.trace_id if _parent else None,
+                 parent_id=_parent.span_id if _parent else None)
+
+    # ---- streamed binning: sketch pass (host), then host uint8 tiles
+    def _tile_chunks():
+        for i in range(cd.num_tiles):
+            lo, hi = cd.tile_slice(i)
+            yield cd.X[lo:hi]
+
+    mapper = BinMapper(p.max_bin).fit_streaming(_tile_chunks())
+    B = mapper.num_bins
+    binned_h = np.empty((n, F), np.uint8)
+    for i in range(cd.num_tiles):
+        lo, hi = cd.tile_slice(i)
+        binned_h[lo:hi] = mapper.transform(cd.X[lo:hi])
+    edges_np = mapper.edges
+    edge_ok = np.concatenate(
+        [np.isfinite(edges_np), np.zeros((F, 1), bool)], axis=1)
+    edge_ok_dev = jnp.asarray(edge_ok)
+
+    l1, l2 = p.lambda_l1, p.lambda_l2
+    min_data = float(p.min_data_in_leaf)
+    min_hess = p.min_sum_hessian_in_leaf
+    min_gain = p.min_gain_to_split
+    max_delta = p.max_delta_step
+    lr = p.learning_rate
+    objective = make_objective(p)
+    D = p.depth_bound
+    rng = np.random.default_rng(p.seed)
+
+    def thresh(G):
+        return jnp.sign(G) * jnp.maximum(jnp.abs(G) - l1, 0.0)
+
+    def leaf_score(G, H):
+        return thresh(G) ** 2 / (H + l2)
+
+    def dehist(h_, gsc, hsc):
+        if not use_quant:
+            return h_
+        return hist_ops.dequantize_histogram(h_, gsc, hsc)
+
+    # ---- jitted per-tile kernels (ONE signature across all tiles: the
+    # static tile shape is the point of ChunkedDataset)
+    def _build_grad():
+        def grad_tile(scores_t, y_t, w_t):
+            g, h = objective(scores_t[:, None], y_t, w_t)
+            return (g[:, 0], h[:, 0],
+                    jnp.max(jnp.abs(g)), jnp.max(h))
+        return instrumented_jit(grad_tile, name="lightgbm.ooc_grad")
+
+    grad_fn = _cached(("ooc_grad", sig, T), _build_grad)
+
+    def _build_accum():
+        def accum(acc, b_t, g_t, h_t, node_t, gsc, hsc):
+            nodes_d = acc.shape[0]          # static at trace time
+            if use_quant:
+                qg, qh, _, _ = hist_ops.quantize_gradients(
+                    g_t, h_t, qb, seed=p.seed, g_scale=gsc, h_scale=hsc)
+                part = hist_ops.build_quantized(
+                    b_t, qg, qh, node_t, nodes_d, B, quant_bins=qb,
+                    backend=hist_backend, node_rows_bound=T)
+            else:
+                part = hist_ops.build(b_t, g_t, h_t, node_t, nodes_d, B,
+                                      backend=hist_backend)
+            return acc + part
+        # level growth legitimately compiles one signature per level (the
+        # acc node axis doubles: nodes_d = 1..2^(D-1)), so the storm
+        # threshold scales with depth — the default 8 would book a false
+        # recompile-storm on any healthy max_depth>=8 run
+        return instrumented_jit(accum, donate_argnums=(0,),
+                                name="lightgbm.ooc_tile_hist",
+                                storm_signatures=D + 8)
+
+    accum_fn = _cached(("ooc_accum", sig, F, B, T), _build_accum)
+
+    def _build_decide():
+        def decide(acc, gsc, hsc, fmask, eok):
+            hist = dehist(acc, gsc, hsc)              # (nodes, F, B, 3)
+            nodes_d = hist.shape[0]
+            cum = jnp.cumsum(hist, axis=2)
+            tot = cum[:, :1, -1, :]                   # (nodes, 1, 3)
+            GL, HL, CL = cum[..., 0], cum[..., 1], cum[..., 2]
+            Gp, Hp, Cp = tot[..., 0], tot[..., 1], tot[..., 2]
+            GR, HR, CR = (Gp[:, :, None] - GL, Hp[:, :, None] - HL,
+                          Cp[:, :, None] - CL)
+            gain = (leaf_score(GL, HL) + leaf_score(GR, HR)
+                    - leaf_score(Gp, Hp)[:, :, None])
+            valid = ((CL >= min_data) & (CR >= min_data)
+                     & (HL >= min_hess) & (HR >= min_hess)
+                     & fmask[None, :, None] & eok[None])
+            gain = jnp.where(valid, gain, -jnp.inf)
+            flat = gain.reshape(nodes_d, F * B)
+            best = jnp.argmax(flat, axis=1)
+            best_gain = jnp.take_along_axis(flat, best[:, None],
+                                            axis=1)[:, 0]
+            bf = (best // B).astype(jnp.int32)
+            bb = (best % B).astype(jnp.int32)
+            do = best_gain > min_gain
+            pick = jnp.stack([GL, HL, CL], axis=-1)
+            left = pick[jnp.arange(nodes_d), bf, bb, :]
+            tot3 = jnp.stack([Gp[:, 0], Hp[:, 0], Cp[:, 0]], axis=-1)
+            left_stats = jnp.where(do[:, None], left, tot3)
+            return bf, bb, do, best_gain, left_stats, tot3 - left_stats, tot3
+        # one signature per level, like the accumulator above
+        return instrumented_jit(decide, name="lightgbm.ooc_level_decide",
+                                storm_signatures=D + 8)
+
+    decide_fn = _cached(("ooc_decide", sig, F, B), _build_decide)
+
+    def _build_leaf_best():
+        def leaf_best(hist_f3, gsc, hsc, fmask, depth_ok, eok):
+            hist = dehist(hist_f3, gsc, hsc)          # (F, B, 3)
+            cum = jnp.cumsum(hist, axis=1)
+            tot = cum[0, -1, :]
+            GL, HL, CL = cum[..., 0], cum[..., 1], cum[..., 2]
+            GR, HR, CR = tot[0] - GL, tot[1] - HL, tot[2] - CL
+            gain = (leaf_score(GL, HL) + leaf_score(GR, HR)
+                    - leaf_score(tot[0], tot[1]))
+            valid = ((CL >= min_data) & (CR >= min_data)
+                     & (HL >= min_hess) & (HR >= min_hess)
+                     & fmask[:, None] & depth_ok & eok)
+            gain = jnp.where(valid, gain, -jnp.inf)
+            flat = gain.reshape(-1)
+            best = jnp.argmax(flat)
+            bf = (best // B).astype(jnp.int32)
+            bb = (best % B).astype(jnp.int32)
+            left = jnp.stack([GL, HL, CL], axis=-1)[bf, bb]
+            return flat[best], bf, bb, left, tot
+        return instrumented_jit(leaf_best, name="lightgbm.ooc_leaf_best")
+
+    leaf_best_fn = _cached(("ooc_leaf_best", sig, F, B), _build_leaf_best)
+
+    # ---- prefetch plumbing: payloads built AND placed on the worker
+    # thread (routing for the next tile rides there too, overlapped with
+    # the consumer's histogram dispatch on the current tile)
+    OOC_SITE = "lightgbm.ooc_tile"
+    stream_totals = {"wait_s": 0.0, "compute_s": 0.0, "tiles": 0.0}
+
+    def _stream(make_tile):
+        def load(i):
+            lo, hi = cd.tile_slice(i)
+            host = make_tile(i, lo, hi)
+            return (i, lo, hi, _obs_device_put(host, site=OOC_SITE))
+        return TilePrefetcher(range(cd.num_tiles), load, site=OOC_SITE)
+
+    def _finish_stream(pf):
+        st = pf.overlap_stats()
+        stream_totals["wait_s"] += st["wait_s"]
+        stream_totals["compute_s"] += st["compute_s"]
+        stream_totals["tiles"] += st["tiles"]
+
+    # ---- init score (same as train())
+    init_score = 0.0
+    if p.objective == "binary":
+        pbar = float(np.clip(np.average(y, weights=w), 1e-6, 1 - 1e-6))
+        init_score = math.log(pbar / (1 - pbar)) / p.sigmoid
+    elif p.objective in ("regression", "huber"):
+        init_score = float(np.average(y, weights=w))
+    elif p.objective in ("poisson", "tweedie", "gamma"):
+        init_score = float(np.log(max(np.average(y, weights=w), 1e-9)))
+    elif p.objective == "regression_l1":
+        init_score = float(np.median(y))
+    scores_h = np.full((n,), init_score, np.float32)
+    g_host = np.empty((n,), np.float32)
+    h_host = np.empty((n,), np.float32)
+
+    # ---- valid set (in-memory: the heldout set is driver-sized)
+    metric_name = p.metric or default_metric(p.objective)
+    metric_fn, larger_better = resolve_metric(metric_name, p)
+    evals: List[Dict[str, float]] = []
+    has_valid = valid is not None
+    if has_valid:
+        Xv = np.asarray(valid[0], np.float32)
+        yv = np.asarray(valid[1], np.float32)
+        binned_v = jnp.asarray(mapper.transform(Xv))
+        scores_v = np.full((Xv.shape[0], 1), init_score, np.float32)
+        walker = _cached(("walker", D, ()), lambda: make_binned_walker(D))
+    best_metric = -np.inf if larger_better else np.inf
+    best_iter = -1
+    rounds_no_improve = 0
+
+    level_growth = p.growth == "level"
+    L = p.num_leaves                      # leaf slots
+    I = L - 1                             # internal nodes
+    if level_growth:
+        from ..models.gbdt import perfect_tree_children
+        lc_const, rc_const = perfect_tree_children(D)
+
+    trees: Dict[str, List[np.ndarray]] = {k: [] for k in (
+        "left_child", "right_child", "split_feature", "threshold",
+        "threshold_bin", "split_gain", "internal_value", "internal_count",
+        "leaf_value", "leaf_count")}
+    tree_weights: List[float] = []
+    bag_on = p.bagging_freq > 0 and p.bagging_fraction < 1.0
+    ff_on = p.feature_fraction < 1.0
+    mask_h = np.ones((n,), bool)
+    bag_mask = None
+
+    def _grad_pass():
+        """First pass: gradients per tile (device), stored host-side, plus
+        the GLOBAL grad/hess maxima every tile's quantization shares — the
+        tile-level twin of the sharded pmax."""
+        pf = _stream(lambda i, lo, hi: (pad_tile(scores_h, lo, hi, T),
+                                        pad_tile(y, lo, hi, T),
+                                        pad_tile(w, lo, hi, T)))
+        gmax = hmax = 0.0
+        for i, lo, hi, (sc_t, y_t, w_t) in pf:
+            g_t, h_t, gm, hm = grad_fn(sc_t, y_t, w_t)
+            g_host[lo:hi] = np.asarray(g_t)[: hi - lo]
+            h_host[lo:hi] = np.asarray(h_t)[: hi - lo]
+            gmax = max(gmax, float(gm))
+            hmax = max(hmax, float(hm))
+        _finish_stream(pf)
+        g_scale = max(gmax, 1e-12) / qg_cap
+        h_scale = max(hmax, 1e-12) / qh_cap
+        return float(g_scale), float(h_scale)
+
+    def _route(lo, hi, bf, bb, do):
+        """Host-side row routing (numerical splits): node -> 2*node + right,
+        matching the level-wise grower's gather bit for bit."""
+        node = node_h[lo:hi]
+        f = np.maximum(bf[node], 0)
+        rb = binned_h[lo:hi][np.arange(hi - lo), f].astype(np.int32)
+        go_right = do[node] & (rb > bb[node])
+        node_h[lo:hi] = 2 * node + go_right
+
+    def _hist_pass(nodes_d, gsc, hsc, decisions, node_of):
+        """One accumulate pass over every tile: routing for this level
+        (when ``decisions`` carries the previous level's splits) happens on
+        the PREFETCH worker, then the consumer folds the tile's quantized
+        partial into the int32 accumulator."""
+        def make_tile(i, lo, hi):
+            if decisions is not None:
+                _route(lo, hi, *decisions)
+            node_t = np.where(mask_h[lo:hi], node_of(lo, hi),
+                              -1).astype(np.int32)
+            return (pad_tile(binned_h, lo, hi, T),
+                    pad_tile(g_host, lo, hi, T),
+                    pad_tile(h_host, lo, hi, T),
+                    # node_t is already the slice: pad from its own origin
+                    pad_tile(node_t, 0, hi - lo, T, fill=-1))
+        acc = jnp.zeros((nodes_d, F, B, 3),
+                        jnp.int32 if use_quant else jnp.float32)
+        pf = _stream(make_tile)
+        for i, lo, hi, (b_t, g_t, h_t, n_t) in pf:
+            acc = accum_fn(acc, b_t, g_t, h_t, n_t, gsc, hsc)
+        _finish_stream(pf)
+        return acc
+
+    for it in range(p.num_iterations):
+        # ---- per-iteration host randomness (same semantics as train())
+        feat_mask = np.ones((F,), bool)
+        if ff_on:
+            keep = max(1, int(round(p.feature_fraction * F)))
+            feat_mask[:] = False
+            feat_mask[rng.choice(F, size=keep, replace=False)] = True
+        if bag_on and (it % p.bagging_freq == 0 or bag_mask is None):
+            bag_mask = rng.random(n) < p.bagging_fraction
+        mask_h = bag_mask if bag_on else np.ones((n,), bool)
+        fm_dev = jnp.asarray(feat_mask)
+
+        gsc, hsc = _grad_pass()
+        node_h = np.zeros((n,), np.int32)
+
+        sf = np.full((I,), -1, np.int32)
+        tb = np.zeros((I,), np.int32)
+        th = np.zeros((I,), np.float32)
+        sg = np.zeros((I,), np.float32)
+        iv = np.zeros((I,), np.float32)
+        ic = np.zeros((I,), np.float32)
+
+        if level_growth:
+            decisions = None
+            for d in range(D):
+                nodes_d = 2 ** d
+                off = nodes_d - 1
+                acc = _hist_pass(nodes_d, gsc, hsc, decisions,
+                                 lambda lo, hi: node_h[lo:hi])
+                bf_d, bb_d, do_d, gain_d, left_d, right_d, tot_d = [
+                    np.asarray(a) for a in decide_fn(acc, gsc, hsc, fm_dev,
+                                                     edge_ok_dev)]
+                idx = off + np.arange(nodes_d)
+                sf[idx] = np.where(do_d, bf_d, -1)
+                tb[idx] = bb_d
+                th[idx] = edges_np[bf_d, np.clip(bb_d, 0, B - 2)]
+                sg[idx] = np.where(do_d, gain_d, 0.0)
+                iv[idx] = _np_leaf_output(tot_d[:, 0], tot_d[:, 1], l1, l2,
+                                          max_delta)
+                ic[idx] = tot_d[:, 2]
+                decisions = (bf_d, bb_d, do_d)
+            # final routing (level D decisions) over the whole host array
+            _route(0, n, *decisions)
+            lv2 = np.stack([_np_leaf_output(left_d[:, 0], left_d[:, 1], l1,
+                                            l2, max_delta),
+                            _np_leaf_output(right_d[:, 0], right_d[:, 1],
+                                            l1, l2, max_delta)],
+                           axis=1).reshape(L)
+            lc2 = np.stack([left_d[:, 2], right_d[:, 2]], axis=1).reshape(L)
+            leaf_value = np.where(lc2 > 0, lv2, 0.0).astype(np.float32)
+            leaf_count = lc2.astype(np.float32)
+            leaf_of_row = node_h
+            lch, rch = lc_const, rc_const
+        else:
+            (sf, tb, th, sg, iv, ic, leaf_value, leaf_count, lch, rch,
+             leaf_of_row) = _grow_leafwise_streamed(
+                p, n, F, B, T, D, gsc, hsc, fm_dev, edge_ok_dev, node_h,
+                mask_h, binned_h, edges_np, _hist_pass, leaf_best_fn, l1,
+                l2, max_delta)
+
+        lv_s = (leaf_value * lr).astype(np.float32)
+        scores_h += lv_s[leaf_of_row]
+        for k_name, arr in zip(
+                ("left_child", "right_child", "split_feature", "threshold",
+                 "threshold_bin", "split_gain", "internal_value",
+                 "internal_count", "leaf_value", "leaf_count"),
+                (lch, rch, sf, th, tb, sg, iv, ic, lv_s, leaf_count)):
+            trees[k_name].append(np.asarray(arr))
+        tree_weights.append(1.0)
+
+        if has_valid:
+            leaf_v = np.asarray(walker(
+                binned_v, jnp.asarray(sf), jnp.asarray(tb),
+                jnp.asarray(np.asarray(lch, np.int32)),
+                jnp.asarray(np.asarray(rch, np.int32))))
+            scores_v[:, 0] += lv_s[leaf_v]
+            m = metric_fn(yv, scores_v.astype(np.float64))
+            evals.append({metric_name: m, "iteration": it})
+            improved = m > best_metric if larger_better else m < best_metric
+            if improved:
+                best_metric, best_iter, rounds_no_improve = m, it, 0
+            else:
+                rounds_no_improve += 1
+            if p.early_stopping_round > 0 and \
+                    rounds_no_improve >= p.early_stopping_round:
+                break
+
+    if p.growth == "leaf":
+        from ..models.gbdt import children_depth_bound
+        D = children_depth_bound(np.stack(trees["left_child"]),
+                                 np.stack(trees["right_child"]))
+    booster = GBDTBooster(
+        np.stack(trees["split_feature"]), np.stack(trees["threshold"]),
+        np.stack(trees["threshold_bin"]), np.stack(trees["split_gain"]),
+        np.stack(trees["internal_value"]),
+        np.stack(trees["internal_count"]),
+        np.stack(trees["leaf_value"]), np.stack(trees["leaf_count"]),
+        np.asarray(tree_weights, np.float32),
+        left_child=np.stack(trees["left_child"]),
+        right_child=np.stack(trees["right_child"]),
+        max_depth=D, num_features=F, objective=p.objective, num_class=1,
+        init_score=init_score, feature_names=feature_names,
+        best_iteration=best_iter, sigmoid=p.sigmoid)
+
+    busy = stream_totals["wait_s"] + stream_totals["compute_s"]
+    extras = {
+        "num_tiles": float(cd.num_tiles), "tile_rows": float(T),
+        "prefetch_wait_s": round(stream_totals["wait_s"], 6),
+        "tile_compute_s": round(stream_totals["compute_s"], 6),
+        "tiles_streamed": stream_totals["tiles"],
+        "prefetch_overlap_pct": round(
+            100.0 * stream_totals["compute_s"] / busy, 2) if busy > 0
+        else 100.0,
+        "quantized": float(use_quant),
+    }
+    for k, v in extras.items():
+        _span.set_attribute(f"ooc.{k}", v)
+    _span.set_attribute("rows", n)
+    _span.set_attribute("features", F)
+    _span.set_attribute("iterations", len(tree_weights))
+    export_span(_span)
+    return TrainResult(booster=booster, evals=evals, bin_mapper=mapper,
+                       extras=extras)
+
+
+def _grow_leafwise_streamed(p, n, F, B, T, depth_bound, gsc, hsc, fm_dev,
+                            edge_ok_dev, node_h, mask_h, binned_h, edges_np,
+                            hist_pass, leaf_best_fn, l1, l2, max_delta):
+    """One leaf-wise tree over the tile stream: LightGBM's best-first
+    growth with the histogram passes streamed.  Per split step the LEFT
+    child's histogram is rebuilt with one accumulate pass over every tile
+    (``hist_pass`` with a single node) and the sibling comes from exact
+    integer subtraction against a host-resident stored-histogram table —
+    the same histogram-halving the in-memory grower runs, with the storage
+    moved off-device (out-of-core all the way down).  Bookkeeping mirrors
+    ``make_leafwise_grower.step`` in host numpy; a step whose best gain
+    fails ``min_gain_to_split`` ends the tree (later steps could only see
+    smaller global-best gains)."""
+    import jax.numpy as jnp
+
+    L, M = p.num_leaves, p.num_leaves - 1
+    depth_cap = p.max_depth
+    min_gain = p.min_gain_to_split
+    stored = np.zeros((L, F, B, 3),
+                      np.int32 if p.use_quantized_grad else np.float32)
+
+    lc_arr = np.full((M,), -1, np.int32)
+    rc_arr = np.full((M,), -1, np.int32)
+    sf = np.full((M,), -1, np.int32)
+    tb = np.zeros((M,), np.int32)
+    th = np.zeros((M,), np.float32)
+    sg = np.zeros((M,), np.float32)
+    iv = np.zeros((M,), np.float32)
+    ic = np.zeros((M,), np.float32)
+    leaf_tot = np.zeros((L, 3), np.float32)
+    leaf_depth = np.zeros((L,), np.int32)
+    created = np.zeros((L,), bool)
+    created[0] = True
+    leaf_parent = np.full((L,), -1, np.int32)
+    leaf_side = np.zeros((L,), np.int32)
+    best_gain = np.full((L,), -np.inf, np.float32)
+    best_feat = np.zeros((L,), np.int32)
+    best_bin = np.zeros((L,), np.int32)
+    best_left = np.zeros((L, 3), np.float32)
+
+    def depth_ok_of(d):
+        return True if depth_cap <= 0 else bool(d < depth_cap)
+
+    def candidates(hist_np, slot, dok):
+        g, f, b, left, tot = leaf_best_fn(jnp.asarray(hist_np), gsc, hsc,
+                                          fm_dev, dok, edge_ok_dev)
+        best_gain[slot] = float(g)
+        best_feat[slot] = int(f)
+        best_bin[slot] = int(b)
+        best_left[slot] = np.asarray(left)
+        return np.asarray(tot)
+
+    # root: one streamed pass with a single node id
+    h_root = np.asarray(hist_pass(1, gsc, hsc, None,
+                                  lambda lo, hi: np.zeros((hi - lo,),
+                                                          np.int32)))[0]
+    stored[0] = h_root
+    leaf_tot[0] = candidates(h_root, 0, depth_ok_of(0))
+
+    for s in range(M):
+        j = int(np.argmax(best_gain))
+        if not best_gain[j] > min_gain:
+            break
+        new_leaf = s + 1
+        f, b = int(best_feat[j]), int(best_bin[j])
+        tot = leaf_tot[j].copy()
+
+        sf[s] = f
+        tb[s] = b
+        th[s] = edges_np[f, min(max(b, 0), B - 2)]
+        sg[s] = best_gain[j]
+        iv[s] = _np_leaf_output(tot[0:1], tot[1:2], l1, l2, max_delta)[0]
+        ic[s] = tot[2]
+
+        pn, side = leaf_parent[j], leaf_side[j]
+        if pn >= 0:
+            (lc_arr if side == 0 else rc_arr)[pn] = s
+        lc_arr[s] = -(j + 1)
+        rc_arr[s] = -(new_leaf + 1)
+        leaf_parent[j], leaf_side[j] = s, 0
+        leaf_parent[new_leaf], leaf_side[new_leaf] = s, 1
+        created[new_leaf] = True
+
+        # route leaf j's rows (whole host array: one vectorized pass)
+        in_j = node_h == j
+        go_right = in_j & (binned_h[:, f].astype(np.int32) > b)
+        node_h[go_right] = new_leaf
+
+        left_stats = best_left[j].copy()
+        leaf_tot[j] = left_stats
+        leaf_tot[new_leaf] = tot - left_stats
+        d_new = leaf_depth[j] + 1
+        leaf_depth[j] = leaf_depth[new_leaf] = d_new
+
+        # left child rebuilt over the stream; sibling by exact subtraction
+        hl = np.asarray(hist_pass(
+            1, gsc, hsc, None,
+            lambda lo, hi: np.where(node_h[lo:hi] == j, 0, -1)
+            .astype(np.int32)))[0]
+        hr = stored[j] - hl
+        stored[j], stored[new_leaf] = hl, hr
+
+        dok = depth_ok_of(d_new)
+        candidates(hl, j, dok)
+        candidates(hr, new_leaf, dok)
+
+    lv = _np_leaf_output(leaf_tot[:, 0], leaf_tot[:, 1], l1, l2, max_delta)
+    leaf_value = np.where(created, lv, 0.0).astype(np.float32)
+    leaf_count = np.where(created, leaf_tot[:, 2], 0.0).astype(np.float32)
+    return (sf, tb, th, sg, iv, ic, leaf_value, leaf_count, lc_arr, rc_arr,
+            node_h.copy())

@@ -337,7 +337,8 @@ def build_histograms_matmul(binned: jnp.ndarray, grad: jnp.ndarray,
 # integer space — no f32 cancellation drift between levels.
 
 def quantize_gradients(grad, hess, quant_bins: int, seed: int = 0,
-                       axis_name: Optional[str] = None):
+                       axis_name: Optional[str] = None,
+                       g_scale=None, h_scale=None):
     """Stochastically round per-row grad/hess to small signed/unsigned ints.
 
     Returns ``(qg, qh, g_scale, h_scale)`` with ``qg`` in
@@ -348,6 +349,14 @@ def quantize_gradients(grad, hess, quant_bins: int, seed: int = 0,
     iteration); with ``axis_name`` they are ``pmax``'d over the mesh so
     every shard quantizes in the SAME units and the psum'd integer
     histograms stay meaningful.
+
+    Passing ``g_scale``/``h_scale`` (both or neither) skips the max pass
+    and quantizes in the CALLER's units — the out-of-core tile stream
+    computes global maxima in a first pass over every tile, then hands
+    each tile the same scales so per-tile integer partial histograms
+    accumulate exactly (the tile-level twin of the ``pmax`` contract).
+    The values are clipped to the integer caps either way, so a stale
+    (too-small) scale degrades resolution, never correctness.
 
     The rounding noise needs no host RNG plumbing: the PRNG key folds in a
     bitcast of the gradient sum, which changes every iteration (the scores
@@ -362,13 +371,19 @@ def quantize_gradients(grad, hess, quant_bins: int, seed: int = 0,
     h = hess.astype(jnp.float32)
     qg_cap = max(1, quant_bins // 2)
     qh_cap = max(1, quant_bins - 1)
-    gmax = jnp.max(jnp.abs(g))
-    hmax = jnp.max(h)
-    if axis_name is not None:
-        gmax = jax.lax.pmax(gmax, axis_name)
-        hmax = jax.lax.pmax(hmax, axis_name)
-    g_scale = jnp.maximum(gmax, 1e-12) / qg_cap
-    h_scale = jnp.maximum(hmax, 1e-12) / qh_cap
+    if (g_scale is None) != (h_scale is None):
+        raise ValueError("pass both g_scale and h_scale or neither")
+    if g_scale is None:
+        gmax = jnp.max(jnp.abs(g))
+        hmax = jnp.max(h)
+        if axis_name is not None:
+            gmax = jax.lax.pmax(gmax, axis_name)
+            hmax = jax.lax.pmax(hmax, axis_name)
+        g_scale = jnp.maximum(gmax, 1e-12) / qg_cap
+        h_scale = jnp.maximum(hmax, 1e-12) / qh_cap
+    else:
+        g_scale = jnp.maximum(jnp.asarray(g_scale, jnp.float32), 1e-30)
+        h_scale = jnp.maximum(jnp.asarray(h_scale, jnp.float32), 1e-30)
     mix = jax.lax.bitcast_convert_type(
         jnp.sum(g) + 3.0 * jnp.sum(h), jnp.int32)
     key = jrandom.fold_in(jrandom.PRNGKey(seed), mix)

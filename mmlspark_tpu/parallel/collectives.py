@@ -30,23 +30,28 @@ def pmax(x, axis: str = AXIS_DATA):
 
 
 def histogram_psum(hist_i32, axis: str = AXIS_DATA, row_bound: int = None,
-                   quant_bins: int = None):
+                   quant_bins: int = None, num_tiles: int = 1):
     """Allreduce for quantized GBDT histograms — ``(..., 3)`` int32
     ``[sum_qg, sum_qh, count]`` tensors (``ops.histogram`` quantized
     builders).
 
     When the STATIC global row bound keeps both integer lanes under 14 bits
-    (``row_bound * max(quant level) < 2**14`` — signed 16/16 lanes with
-    carry margin), the grad and hess sums pack into ONE int32 channel for
-    the transfer: the allreduce moves 2 channels instead of 3 f32/int32
-    ones — a third off the per-level ICI volume on top of the exactness the
-    integer psum already buys (f32 psums of large histograms are
-    reduction-order dependent; int32 sums are not).  Above the bound the
-    tensor psums as-is, still exact.
+    (``row_bound * num_tiles * max(quant level) < 2**14`` — signed 16/16
+    lanes with carry margin), the grad and hess sums pack into ONE int32
+    channel for the transfer: the allreduce moves 2 channels instead of 3
+    f32/int32 ones — a third off the per-level ICI volume on top of the
+    exactness the integer psum already buys (f32 psums of large histograms
+    are reduction-order dependent; int32 sums are not).  Above the bound
+    the tensor psums as-is, still exact.
 
     ``row_bound`` is a trace-time contract like ``max_rows`` in
     ``ops.histogram``: callers pass the TOTAL row count across shards (the
-    padded global n), never a guess.
+    padded global n), never a guess.  ``num_tiles`` extends the contract to
+    the out-of-core pipeline: a shard that ACCUMULATES per-tile int32
+    partials before (or after) the allreduce holds cells bounded by
+    ``row_bound * num_tiles`` — the global row bound is the sum over
+    shards AND tiles, and both statics are baked into the caller's jit
+    cache key exactly like ``row_bound`` alone was.
     """
     import jax
     import jax.numpy as jnp
@@ -54,7 +59,7 @@ def histogram_psum(hist_i32, axis: str = AXIS_DATA, row_bound: int = None,
             or quant_bins is None):
         return jax.lax.psum(hist_i32, axis_name=axis)
     qcap = max(1, quant_bins - 1)              # worst lane magnitude
-    if int(row_bound) * qcap >= (1 << 14):
+    if int(row_bound) * max(1, int(num_tiles)) * qcap >= (1 << 14):
         return jax.lax.psum(hist_i32, axis_name=axis)
     packed = hist_i32[..., 0] * 65536 + hist_i32[..., 1]
     two = jax.lax.psum(

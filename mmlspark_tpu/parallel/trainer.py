@@ -67,11 +67,18 @@ class Trainer:
     ``batch["x"]``.  BatchNorm modules (mutable batch_stats) are supported.
     """
 
+    #: default on-device time sampling period: the out-of-core streaming
+    #: loop tunes tile sizes against transfer/compute overlap numbers, so
+    #: the device series must exist by default — one forced sync per 32
+    #: steps costs ~3% of the pipeline overlap, and 0 stays available to
+    #: switch it off entirely
+    DEVICE_TIME_EVERY_DEFAULT = 32
+
     def __init__(self, module, optimizer, loss_fn: Callable,
                  mesh=None, has_batch_stats: bool = False,
                  apply_kwargs: Optional[Dict[str, Any]] = None,
                  min_shard_size: int = 2 ** 16,
-                 device_time_every: int = 0):
+                 device_time_every: Optional[int] = None):
         self.module = module
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -81,7 +88,11 @@ class Trainer:
         self.min_shard_size = min_shard_size
         # every Nth step additionally measures on-device time by a
         # block_until_ready after dispatch (0 = off: a forced sync breaks
-        # the async pipeline, so device sampling is strictly opt-in)
+        # the async pipeline).  None resolves to the sampled default — the
+        # PR 6 follow-up: overlap tuning needs the device series without
+        # every caller remembering to opt in.
+        if device_time_every is None:
+            device_time_every = self.DEVICE_TIME_EVERY_DEFAULT
         self.device_time_every = max(0, int(device_time_every))
         self._step_count = 0
         self._train_step = None
@@ -220,6 +231,40 @@ class Trainer:
             span.finish(time.perf_counter())
             export_span(span)
         return out
+
+    def train_stream(self, state: TrainState, batches,
+                     site: str = "parallel.trainer.stream"):
+        """Out-of-core training loop: iterate host batches through a
+        double-buffered prefetcher — batch ``k+1`` is ``device_put`` (row
+        sharded over the mesh's data axis, through the instrumented
+        transfer counter) on a background thread while ``train_step`` runs
+        on batch ``k``.  ``batches`` is any iterable of host pytrees (e.g.
+        ``{"x": ..., "y": ...}``); the stream's overlap efficiency books
+        into ``mmlspark_prefetch_wait_seconds`` /
+        ``mmlspark_tile_compute_seconds`` under ``site``.
+
+        Returns ``(state, losses, overlap_stats)``.
+        """
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..io.chunked import TilePrefetcher
+        from ..observability.compute import device_put as _obs_device_put
+        batch_sh = NamedSharding(self.mesh, P(AXIS_DATA))
+
+        def _load(batch):
+            return jax.tree.map(
+                lambda leaf: _obs_device_put(leaf, batch_sh, site=site),
+                batch)
+
+        prefetcher = TilePrefetcher(batches, _load, site=site)
+        losses = []
+        for batch in prefetcher:
+            state, loss = self.train_step(state, batch)
+            losses.append(loss)
+        # losses fetched AFTER the loop: per-step float() syncs would
+        # serialize the very pipeline the prefetcher exists to overlap
+        losses = [float(l) for l in losses]
+        return state, losses, prefetcher.overlap_stats()
 
 
 def _accepts_train(module) -> bool:

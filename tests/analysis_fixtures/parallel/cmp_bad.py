@@ -1,0 +1,16 @@
+"""CMP001 fixture: raw host->device placements that bypass the transfer
+counters (must trip once per site)."""
+import jax
+from jax import device_put as raw_put
+
+import numpy as np
+
+
+def ship_batch(batch, sharding):
+    # raw call through the module path
+    return jax.device_put(batch, sharding)
+
+
+def ship_params(params):
+    # raw call through a from-import alias
+    return raw_put(np.asarray(params))

@@ -1,0 +1,13 @@
+"""CMP001 near-miss fixture: placements routed through the instrumented
+wrapper (and lookalikes that must NOT trip)."""
+from mmlspark_tpu.observability.compute import device_put
+
+
+def ship_batch(batch, sharding):
+    # the sanctioned path: bytes booked per site before the transfer
+    return device_put(batch, sharding, site="parallel.fixture")
+
+
+def ship_other(backend, batch):
+    # attribute named device_put on a non-jax object is not a raw transfer
+    return backend.device_put(batch)

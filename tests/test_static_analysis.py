@@ -15,6 +15,7 @@ from mmlspark_tpu.analysis import (AnalysisEngine, BaselineEntry, Finding,
                                    HotPathChecker, LockDisciplineChecker,
                                    ResilienceCoverageChecker,
                                    StageContractChecker, TracerSafetyChecker,
+                                   TransferDisciplineChecker,
                                    UndeadlinedRetryChecker,
                                    load_baseline, main, rule_catalog,
                                    run_analysis, save_baseline,
@@ -45,6 +46,8 @@ PAIRS = [
      "observability/lck_ok.py", {"LCK001", "LCK002", "LCK003"}),
     (HotPathChecker, "serving/hot_bad.py", "serving/hot_ok.py",
      {"HOT001", "HOT002"}),
+    (TransferDisciplineChecker, "parallel/cmp_bad.py", "parallel/cmp_ok.py",
+     {"CMP001"}),
 ]
 
 

@@ -164,6 +164,38 @@ def test_trainer_books_compute_phase_breakdown():
     assert '"mmlspark_parallel_train_step_phase_seconds"' in init_src
 
 
+def test_prefetch_seam_books_overlap_histograms():
+    """Out-of-core coverage: the overlap metrics the tile-size tuning loop
+    reads (docs/out_of_core.md) must stay wired.  Source-level like the
+    stage sweep — TilePrefetcher's consumer loop must observe BOTH
+    histograms (a refactor that books only one makes overlap % a lie) —
+    plus a live check that construction registers the families, and that
+    both streaming drivers actually ride the prefetcher rather than a
+    bare loop the metrics never see."""
+    from mmlspark_tpu.io import chunked
+    from mmlspark_tpu.lightgbm import core as gbdt_core
+    from mmlspark_tpu.observability import MetricsRegistry
+    from mmlspark_tpu.parallel import trainer as trainer_mod
+
+    init_src = inspect.getsource(chunked.TilePrefetcher.__init__)
+    assert '"mmlspark_prefetch_wait_seconds"' in init_src
+    assert '"mmlspark_tile_compute_seconds"' in init_src
+    iter_src = inspect.getsource(chunked.TilePrefetcher.__iter__)
+    assert "_h_wait.observe" in iter_src, "consumer loop lost the stall obs"
+    assert "_h_tile.observe" in iter_src, "consumer loop lost the compute obs"
+
+    reg = MetricsRegistry()
+    chunked.TilePrefetcher(iter(()), lambda t: t, registry=reg)
+    for family in ("mmlspark_prefetch_wait_seconds",
+                   "mmlspark_tile_compute_seconds"):
+        assert reg.family(family) is not None, \
+            f"TilePrefetcher no longer registers {family}"
+
+    assert "TilePrefetcher" in inspect.getsource(gbdt_core.train_streamed)
+    assert "TilePrefetcher" in inspect.getsource(
+        trainer_mod.Trainer.train_stream)
+
+
 def test_every_stage_routes_verbs_through_log_verb():
     classes = all_stage_classes()
     assert len(classes) >= 80, f"only {len(classes)} stages discovered"
