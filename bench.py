@@ -234,13 +234,15 @@ def phase_hist_ab(n=1_000_000, f=200, nodes=16, reps=3, proxy=0) -> None:
                 binned, qg, qh, node, nodes, B, quant_bins=16)
 
     def timed(fn, tag):
-        fn(g0, h0).block_until_ready()          # compile warm
+        # jax.block_until_ready handles arrays AND tuples — one timing
+        # protocol for the build arms and the fused-frontier arm below
+        jax.block_until_ready(fn(g0, h0))       # compile warm
         _log(f"[bench] hist_ab {tag} warm done")
         rates = []
         for r in range(1, reps + 1):
             g = g0 + 0.001 * r                  # first-sight args per rep
             t0 = time.perf_counter()
-            fn(g, h0).block_until_ready()
+            jax.block_until_ready(fn(g, h0))
             rates.append(n / (time.perf_counter() - t0))
             _log(f"[bench] hist_ab {tag} rep rows/s {rates[-1]:.0f}")
         rates.sort()
@@ -251,6 +253,73 @@ def phase_hist_ab(n=1_000_000, f=200, nodes=16, reps=3, proxy=0) -> None:
     print(f"HIST_AB_RATES {r_f32} {r_packed} {r_packed / max(r_f32, 1e-9)}", flush=True)
     print(f"HIST_AB_MODE {'cpu_scatter_proxy' if proxy else 'tpu_matmul'} "
           f"{n} {f}", flush=True)
+
+    # ---- fused-vs-separate frontier arm (ISSUE 8): one VMEM-resident
+    # Pallas kernel (smaller-child build + integer sibling subtraction +
+    # split-gain scan -> best (feature, bin, gain) per node) against the
+    # SAME work as separate XLA dispatches (packed build, subtract,
+    # dequantize/cumsum/argmax).  Frontier shape: P parents' smaller
+    # children (~half the rows scattered), the level-wise grower's
+    # steady-state step.  proxy=1 runs the kernel under the Pallas
+    # interpreter (plain XLA on CPU); on TPU the compiled Mosaic kernel
+    # runs — that number is the ROADMAP's on-chip gate.
+    from mmlspark_tpu.observability.compute import instrumented_jit
+    from mmlspark_tpu.ops import pallas_histogram as plh
+    P = 8  # 16 frontier children
+    interp = bool(proxy) or jax.default_backend() != "tpu"
+    sep_backend = "scatter" if interp else "matmul"
+    node_parent = jnp.asarray((np.arange(n) % P).astype(np.int32))
+    in_small = jnp.asarray(((np.arange(n) // P) % 2 == 0))
+    node_small = jnp.where(in_small, node_parent, -1)
+    sl = jnp.ones((P,), bool)
+    fmask = jnp.ones((f,), bool)
+    edge_ok = jnp.asarray(np.concatenate(
+        [np.ones((f, B - 1), bool), np.zeros((f, 1), bool)], axis=1))
+    qg0, qh0, _, _ = hist_ops.quantize_gradients(g0, h0, 16)
+    parent = hist_ops.build_quantized(binned, qg0, qh0, node_parent, P, B,
+                                      quant_bins=16, backend=sep_backend)
+    gain_kw = dict(quant_bins=16, l1=0.0, l2=1.0, min_data=20.0,
+                   min_hess=1e-3)
+
+    @instrumented_jit(name="ops.pallas_frontier")
+    def fused_step(g, h):
+        qg, qh, gs, hs = hist_ops.quantize_gradients(g, h, 16)
+        hist, best = plh.fused_frontier(
+            binned, qg, qh, node_small, P, B, gs, hs, fmask, edge_ok,
+            parent_hist=parent, small_left=sl, interpret=interp, **gain_kw)
+        return hist, best[0], best[1], best[2]
+
+    @instrumented_jit(name="ops.hist_separate")
+    def sep_step(g, h):
+        qg, qh, gs, hs = hist_ops.quantize_gradients(g, h, 16)
+        hsm = hist_ops.build_quantized(binned, qg, qh, node_small, P, B,
+                                       quant_bins=16, backend=sep_backend)
+        sib = parent - hsm
+        sl4 = sl[:, None, None, None]
+        hist_d = jnp.stack([jnp.where(sl4, hsm, sib),
+                            jnp.where(sl4, sib, hsm)],
+                           axis=1).reshape(2 * P, f, B, 3)
+        hd = hist_ops.dequantize_histogram(hist_d, gs, hs)
+        cum = jnp.cumsum(hd, axis=2)
+        tot = cum[:, :1, -1, :]
+        GL, HL, CL = cum[..., 0], cum[..., 1], cum[..., 2]
+        Gp, Hp, Cp = tot[..., 0], tot[..., 1], tot[..., 2]
+        GR, HR = Gp[:, :, None] - GL, Hp[:, :, None] - HL
+        CR = Cp[:, :, None] - CL
+        score = lambda G, H: G ** 2 / (H + 1.0)  # l1=0, l2=1 as fused
+        gain = score(GL, HL) + score(GR, HR) - score(Gp, Hp)[:, :, None]
+        ok = ((CL >= 20.0) & (CR >= 20.0) & (HL >= 1e-3) & (HR >= 1e-3)
+              & fmask[None, :, None] & edge_ok[None])
+        gain = jnp.where(ok, gain, -jnp.inf)
+        flat = gain.reshape(2 * P, f * B)
+        am = jnp.argmax(flat, axis=1)
+        bg = jnp.take_along_axis(flat, am[:, None], axis=1)[:, 0]
+        return hist_d, bg, am // B, am % B
+
+    r_sep = timed(sep_step, "separate")
+    r_fused = timed(fused_step, "fused")
+    print(f"HIST_AB_FUSED {r_sep} {r_fused} {r_fused / max(r_sep, 1e-9)}",
+          flush=True)
 
 
 def phase_ooc(n=200_000, f=50, iters=8, tiles=4, reps=3) -> None:
@@ -630,6 +699,13 @@ def _record_hist_ab(got: dict) -> bool:
     ex["hist_ab_f32_rows_per_sec"] = round(vals[0], 1)
     ex["hist_ab_packed_rows_per_sec"] = round(vals[1], 1)
     ex["hist_ab_packed_speedup"] = round(vals[2], 3)
+    fused = got.get("HIST_AB_FUSED")
+    if fused and len(fused) >= 3:
+        # fused Pallas frontier vs the separate packed pipeline (ISSUE 8):
+        # same frontier work, one kernel vs four XLA dispatches
+        ex["hist_ab_separate_rows_per_sec"] = round(fused[0], 1)
+        ex["hist_ab_fused_rows_per_sec"] = round(fused[1], 1)
+        ex["hist_ab_fused_speedup"] = round(fused[2], 3)
     mode = got.get("HIST_AB_MODE")
     if isinstance(mode, str) and mode.split():
         parts = mode.split()
@@ -790,7 +866,8 @@ def _run_measured_phases(tpu_ok: bool, cpu_rps: float) -> None:
         # shape (quantized-gradient acceptance: packed >= 1.5x the
         # 3-channel f32 build; ISSUE 5).
         got = _collect_multi(_spawn("hist_ab", _tpu_env()),
-                             ("HIST_AB_RATES", "HIST_AB_MODE"), idle=600,
+                             ("HIST_AB_RATES", "HIST_AB_MODE", "HIST_AB_FUSED"),
+                             idle=600,
                              hard=1100)
         if not _record_hist_ab(got):
             _note("hist_ab", "TPU A/B stalled/failed; CPU proxy will run")
@@ -838,7 +915,8 @@ def _run_measured_phases(tpu_ok: bool, cpu_rps: float) -> None:
     # attribution number for the quantized pipeline.
     if "hist_ab_packed_speedup" not in RESULT["extras"]:
         got = _collect_multi(_spawn("hist_ab", _cpu_env(), ["--proxy", "1"]),
-                             ("HIST_AB_RATES", "HIST_AB_MODE"), idle=300, hard=600)
+                             ("HIST_AB_RATES", "HIST_AB_MODE", "HIST_AB_FUSED"),
+                             idle=300, hard=600)
         if not _record_hist_ab(got):
             _note("hist_ab", "CPU proxy A/B also failed; no packed number")
         _emit()

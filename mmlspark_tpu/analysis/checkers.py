@@ -38,6 +38,11 @@ _TRACING_ENTRY_POINTS = {
     "instrumented_jit", "compute.instrumented_jit",
     "observability.compute.instrumented_jit",
     "mmlspark_tpu.observability.compute.instrumented_jit",
+    # Pallas kernel bodies are traced exactly like jitted functions — a
+    # host clock/RNG/print inside one either constant-folds or breaks the
+    # Mosaic lowering outright (ISSUE 8: ops/pallas_histogram.py kernels)
+    "pallas_call", "pl.pallas_call", "pallas.pallas_call",
+    "jax.experimental.pallas.pallas_call",
 }
 
 #: host-side calls that must never run under a tracer
@@ -124,6 +129,11 @@ class TracerSafetyChecker(Checker):
             elif isinstance(arg, ast.Attribute):
                 # self._step / cls.step — root by attribute name
                 ctx._trc_roots.add(arg.attr)
+            elif isinstance(arg, ast.Call) and ctx.dotted_name(arg.func) in \
+                    ("functools.partial", "partial"):
+                # pallas_call(partial(_kernel, cfg), ...) — the partial's
+                # function argument is what gets traced
+                self._mark_function_args(arg, ctx)
 
     # ------------------------------------------------------------- events
     def visit(self, node: ast.AST, ctx: ModuleContext) -> None:

@@ -360,6 +360,25 @@ def _check_quant_psum_bound(use_quant: bool, quant_bins: int,
             f"{quant_bins} quantization bins — lower num_grad_quant_bins "
             "or disable use_quantized_grad")
 
+def _use_fused_frontier(use_quant: bool, axis_name, has_cat: bool,
+                        backend: str, num_bins: int,
+                        quant_bins: int) -> bool:
+    """ONE eligibility predicate for the fused Pallas frontier (ISSUE 8),
+    shared by both growers so they can never silently disagree on when the
+    kernel engages.  Single-shard quantized numerical-split path only —
+    sharded gains must run on the POST-psum global histogram, voting needs
+    the per-feature local gain table, and categorical candidates need the
+    sorted-subset scan; those paths keep the XLA split_gains (the pallas
+    BUILDER still serves them through ``build_quantized``'s dispatcher).
+    Resolved at trace time; ``train()`` keys its jit caches on every
+    histogram env knob."""
+    from ..ops import histogram as hist_ops
+    from ..ops import pallas_histogram as pl_hist
+    return (use_quant and axis_name is None and not has_cat
+            and hist_ops.resolve_quantized_backend(backend) == "pallas"
+            and pl_hist.pallas_supported(num_bins, quant_bins))
+
+
 class _CatTools:
     """Categorical split machinery shared by both growers: static masks, the
     cat_l2-regularised score, ratio-sorted prefix stats (the many-vs-many
@@ -452,6 +471,7 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
     import jax.numpy as jnp
     from ..models.gbdt import perfect_tree_children
     from ..ops import histogram as hist_ops
+    from ..ops import pallas_histogram as pl_hist
     from ..parallel.collectives import histogram_psum
 
     use_quant = bool(params.use_quantized_grad)
@@ -462,6 +482,11 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
     I = 2 ** D - 1     # internal nodes
     L = 2 ** D         # leaves
     ct = _CatTools(params, F, B)
+    # fused Pallas frontier (ISSUE 8): build + sibling subtraction +
+    # split-gain scan in one VMEM-resident kernel (eligibility:
+    # _use_fused_frontier)
+    use_fused = _use_fused_frontier(use_quant, axis_name, ct.has_cat,
+                                    backend, B, quant_bins)
     cat_np, sub_np = ct.cat_np, ct.sub_np
     has_cat, has_subset = ct.has_cat, ct.has_subset
     sorted_prefix, winner_member = ct.sorted_prefix, ct.winner_member
@@ -533,8 +558,9 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
 
         cat_b = jnp.asarray(cat_np)
         sub_b = jnp.asarray(sub_np)
-        edge_finite = jnp.concatenate(
-            [jnp.isfinite(edges), jnp.zeros((F, 1), bool)], axis=1)[None, :, :]
+        edge_ok2 = jnp.concatenate(
+            [jnp.isfinite(edges), jnp.zeros((F, 1), bool)], axis=1)
+        edge_finite = edge_ok2[None, :, :]
         if has_cat:
             # every bin of a categorical feature is a candidate code EXCEPT
             # the last: BinMapper reserves bin max_bin-1 for NaN/overflow,
@@ -589,6 +615,15 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
         for d in range(D):
             nodes_d = 2 ** d
             off = nodes_d - 1                       # BFS offset of this level
+            if d > 0 and not use_voting:
+                # LightGBM's SMALLER-child rule (by the previous level's
+                # split counts): rebuild only each parent's smaller child,
+                # sibling = parent - small.  One definition serving both
+                # the fused-kernel and XLA frontier paths below.
+                is_left = node % 2 == 0
+                in_small = is_left == small_left[node // 2]
+                small_node = jnp.where(hist_mask & in_small, node // 2, -1)
+            fused_d = False        # set by the fused branch when it engages
             if use_voting:
                 # voting-parallel (reference voting_parallel + topK): each
                 # shard ranks features by LOCAL gain, shards vote, and only
@@ -632,22 +667,45 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
                     sel_hist, feat_mask[sel], edge3, cat_b[sel], sub_b[sel])
                 hist_for_win = sel_hist
                 Fs = k2
+            elif use_fused and max(1, nodes_d // 2) <= \
+                    pl_hist.FUSED_MAX_NODES:
+                # fused Pallas frontier: the smaller-child build, the exact
+                # integer sibling subtraction AND the split-gain scan run
+                # in one VMEM-resident kernel; only the assembled child
+                # histograms (the next level's parent) and the per-node
+                # best-split record reach HBM.  Static per-level gate:
+                # past FUSED_MAX_NODES frontier parents the kernel's
+                # VMEM-resident blocks outgrow the tile-sizing budget, so
+                # deeper levels take the XLA branch below (bit-exact
+                # histograms; gains differ only by f32 cumsum rounding)
+                fused_d = True
+                if d == 0:
+                    hist_d, fused_best = pl_hist.fused_frontier(
+                        binned, qg, qh, jnp.where(hist_mask, node, -1), 1,
+                        B, g_scale, h_scale, feat_mask, edge_ok2,
+                        quant_bins=quant_bins, l1=l1, l2=l2,
+                        min_data=min_data, min_hess=min_hess)
+                else:
+                    hist_d, fused_best = pl_hist.fused_frontier(
+                        binned, qg, qh, small_node, nodes_d // 2, B,
+                        g_scale, h_scale, feat_mask, edge_ok2,
+                        quant_bins=quant_bins, l1=l1, l2=l2,
+                        min_data=min_data, min_hess=min_hess,
+                        parent_hist=prev_hist, small_left=small_left,
+                        node_rows_bound=n // 2 + nodes_d)
+                prev_hist = hist_d
+                best_gain, bf, bb, bsel, tot3f = fused_best
+                Gp0, Hp0, Cp0 = tot3f[:, 0], tot3f[:, 1], tot3f[:, 2]
             else:
                 if d == 0:
                     hist_d = hist(jnp.where(hist_mask, node, -1), 1)
                 else:
-                    # sibling-subtraction with LightGBM's SMALLER-child rule:
-                    # scatter only each parent's smaller child (by the
-                    # previous level's split counts), sibling = parent -
-                    # small.  At most floor(n/2) rows are ever scattered,
-                    # which — single-shard — is a STATIC bound that truncates
-                    # the matmul backend's block scan to half the blocks
-                    # (sharded: a shard's rows may concentrate in globally
-                    # smaller children, so no bound is claimed there).
-                    is_left = node % 2 == 0
-                    in_small = is_left == small_left[node // 2]
-                    small_node = jnp.where(hist_mask & in_small,
-                                           node // 2, -1)
+                    # smaller-child scatter (small_node above): at most
+                    # floor(n/2) rows are ever scattered, which — single-
+                    # shard — is a STATIC bound that truncates the matmul
+                    # backend's block scan to half the blocks (sharded: a
+                    # shard's rows may concentrate in globally smaller
+                    # children, so no bound is claimed there).
                     cap = None if axis_name is not None else n // 2 + nodes_d
                     hist_small = hist(small_node, nodes_d // 2, max_rows=cap)
                     hist_sib = prev_hist - hist_small
@@ -664,12 +722,16 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
                 sel = None
                 Fs = F
 
-            flat = gain.reshape(nodes_d, Fs * B)
-            best = jnp.argmax(flat, axis=1)
-            best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
-            bf_local = (best // B).astype(jnp.int32)
-            bb = (best % B).astype(jnp.int32)
-            bf = sel[jnp.arange(nodes_d), bf_local] if sel is not None else bf_local
+            if not fused_d:
+                flat = gain.reshape(nodes_d, Fs * B)
+                best = jnp.argmax(flat, axis=1)
+                best_gain = jnp.take_along_axis(flat, best[:, None],
+                                                axis=1)[:, 0]
+                bf_local = (best // B).astype(jnp.int32)
+                bb = (best % B).astype(jnp.int32)
+                bf = sel[jnp.arange(nodes_d), bf_local] \
+                    if sel is not None else bf_local
+                bsel = pick[jnp.arange(nodes_d), bf_local, bb, :]  # left
             do_split = best_gain > min_gain
 
             idx = off + jnp.arange(nodes_d)
@@ -690,7 +752,6 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
 
             # left/right child stats at the chosen split -> leaf values at the
             # last level come straight from here (no extra leaf pass)
-            bsel = pick[jnp.arange(nodes_d), bf_local, bb, :]  # (nodes,3) left
             tot3 = jnp.stack([Gp0, Hp0, Cp0], axis=-1)
             left_stats = jnp.where(do_split[:, None], bsel, tot3)
             right_stats = tot3 - left_stats
@@ -790,6 +851,7 @@ def make_leafwise_grower(num_leaves: int, depth_cap: int, num_features: int,
     import jax
     import jax.numpy as jnp
     from ..ops import histogram as hist_ops
+    from ..ops import pallas_histogram as pl_hist
     from ..parallel.collectives import histogram_psum
 
     use_quant = bool(params.use_quantized_grad)
@@ -799,6 +861,12 @@ def make_leafwise_grower(num_leaves: int, depth_cap: int, num_features: int,
     #                                   keys its jit caches on the env knob
     L, M, F, B = num_leaves, num_leaves - 1, num_features, num_bins
     ct = _CatTools(params, F, B)
+    # fused Pallas frontier (ISSUE 8): per split step the left-child
+    # rebuild, the exact integer sibling subtraction against the stored
+    # carry and BOTH children's split-gain scans run in one VMEM-resident
+    # kernel (shared eligibility: _use_fused_frontier)
+    use_fused = _use_fused_frontier(use_quant, axis_name, ct.has_cat,
+                                    backend, B, quant_bins)
     cat_np, sub_np = ct.cat_np, ct.sub_np
     has_cat, has_subset = ct.has_cat, ct.has_subset
     l1, l2 = params.lambda_l1, params.lambda_l2
@@ -979,8 +1047,19 @@ def make_leafwise_grower(num_leaves: int, depth_cap: int, num_features: int,
 
         # ---- root
         leaf_of_row = jnp.zeros((n,), jnp.int32)
-        h_root = psum_maybe(local_hist(hist_mask))
-        g0, f0, b0, lp0, tot0, m0 = best_of(h_root, feat_mask, depth_ok_of(0))
+        if use_fused:
+            h_root1, fb_root = pl_hist.fused_frontier(
+                binned, qg, qh, jnp.where(hist_mask, 0, -1), 1, B,
+                g_scale, h_scale, feat_mask, edge_ok,
+                quant_bins=quant_bins, l1=l1, l2=l2, min_data=min_data,
+                min_hess=min_hess, depth_ok=depth_ok_of(0))
+            h_root = h_root1[0]
+            g0, f0, b0 = fb_root[0][0], fb_root[1][0], fb_root[2][0]
+            lp0, tot0, m0 = fb_root[3][0], fb_root[4][0], None
+        else:
+            h_root = psum_maybe(local_hist(hist_mask))
+            g0, f0, b0, lp0, tot0, m0 = best_of(h_root, feat_mask,
+                                                depth_ok_of(0))
 
         # stored-histogram carry dtype: int16 when the STATIC row bound
         # keeps every quantized cell under 15 bits (sums stay exact; the
@@ -1083,19 +1162,36 @@ def make_leafwise_grower(num_leaves: int, depth_cap: int, num_features: int,
             c["leaf_depth"] = set_if(c["leaf_depth"], j, d_new, do, L)
             c["leaf_depth"] = set_if(c["leaf_depth"], new_leaf, d_new, do, L)
 
-            hl = local_hist(hist_mask & (c["leaf_of_row"] == j))
-            if axis_name is not None and not use_voting:
-                hl = psum_hist(hl)
-            # subtraction widens back to the build dtype: the int16 carry
-            # is storage-only, the integer arithmetic stays exact in int32
-            hr = c["hists"][j].astype(hl.dtype) - hl
+            dok = depth_ok_of(d_new)
+            if use_fused:
+                # one fused kernel: left-child rebuild, exact integer
+                # sibling subtraction against the stored carry (widened
+                # from the int16 storage dtype — arithmetic stays int32),
+                # and both children's split-gain scans
+                pair, fb2 = pl_hist.fused_frontier(
+                    binned, qg, qh,
+                    jnp.where(hist_mask & (c["leaf_of_row"] == j), 0, -1),
+                    1, B, g_scale, h_scale, feat_mask, edge_ok,
+                    quant_bins=quant_bins, l1=l1, l2=l2,
+                    min_data=min_data, min_hess=min_hess,
+                    parent_hist=c["hists"][j].astype(jnp.int32)[None],
+                    small_left=jnp.ones((1,), bool), depth_ok=dok)
+                hl, hr = pair[0], pair[1]
+                gl, fl, bl, lpl = fb2[0][0], fb2[1][0], fb2[2][0], fb2[3][0]
+                gr, fr, br, lpr = fb2[0][1], fb2[1][1], fb2[2][1], fb2[3][1]
+                ml = mr = None
+            else:
+                hl = local_hist(hist_mask & (c["leaf_of_row"] == j))
+                if axis_name is not None and not use_voting:
+                    hl = psum_hist(hl)
+                # subtraction widens back to the build dtype: the int16
+                # carry is storage-only, the arithmetic stays exact int32
+                hr = c["hists"][j].astype(hl.dtype) - hl
+                gl, fl, bl, lpl, _, ml = best_of(hl, feat_mask, dok)
+                gr, fr, br, lpr, _, mr = best_of(hr, feat_mask, dok)
             c["hists"] = set_if(c["hists"], j, hl.astype(st_dtype), do, L)
             c["hists"] = set_if(c["hists"], new_leaf, hr.astype(st_dtype),
                                 do, L)
-
-            dok = depth_ok_of(d_new)
-            gl, fl, bl, lpl, _, ml = best_of(hl, feat_mask, dok)
-            gr, fr, br, lpr, _, mr = best_of(hr, feat_mask, dok)
             if has_cat:
                 c["best_member"] = set_if(c["best_member"], j, ml, do, L)
                 c["best_member"] = set_if(c["best_member"], new_leaf, mr,
@@ -1299,7 +1395,8 @@ def _resolve_hist_backend() -> tuple:
             os.environ.get("MMLSPARK_TPU_HIST_RESID", ""),
             os.environ.get("MMLSPARK_TPU_HIST_LAYOUT", ""),
             os.environ.get("MMLSPARK_TPU_HIST_QUANT", ""),
-            os.environ.get("MMLSPARK_TPU_HIST_STORE16", ""))
+            os.environ.get("MMLSPARK_TPU_HIST_STORE16", ""),
+            os.environ.get("MMLSPARK_TPU_HIST_PALLAS", ""))
 
 
 def _make_grower(p: GBDTParams, F: int, B: int, axis_name: str = None,
@@ -1397,8 +1494,6 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
     # All env knobs are read at trace time and key the jit caches.
     hist_cfg = _resolve_hist_backend()
     hist_backend = hist_cfg[0]
-    _eff_backend = hist_backend if hist_backend != "auto" else \
-        ("scatter" if jax.default_backend() == "cpu" else "matmul")
     _uq = p.use_quantized_grad
     if hist_cfg[5].strip():              # MMLSPARK_TPU_HIST_QUANT=0/1
         # case-insensitive: an operator's QUANT=OFF during an incident must
@@ -1407,6 +1502,20 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
     if _uq is None:                      # auto: packed ints on accelerators
         _uq = jax.default_backend() != "cpu"
     p = dataclasses.replace(p, use_quantized_grad=bool(_uq))
+    if hist_backend != "auto" and (p.use_quantized_grad
+                                   or hist_backend != "pallas"):
+        _eff_backend = hist_backend
+    elif p.use_quantized_grad:
+        # quantized auto may resolve to the fused Pallas kernel (TPU, or
+        # MMLSPARK_TPU_HIST_PALLAS=1 anywhere) — label what actually runs
+        from ..ops.histogram import resolve_quantized_backend
+        _eff_backend = resolve_quantized_backend("auto")
+    else:
+        # float path — an explicit 'pallas' request falls back here too
+        # (the fused kernel is integer-only; build() maps it to the float
+        # builders), so the phase label must name what actually ran
+        _eff_backend = "scatter" if jax.default_backend() == "cpu" \
+            else "matmul"
     rng = np.random.default_rng(p.seed)
     X = np.asarray(X, np.float32)
     y = np.asarray(y, np.float32)

@@ -430,6 +430,43 @@ def _packed_layout(bound: int, quant_bins: int):
     return "wide", cbits, hbits
 
 
+def _pack_lanes(qg, qh, mode: str, cbits: int, hbits: int):
+    """Per-row packed int32 weight channels for a ``_packed_layout`` plan.
+    ONE definition shared by the XLA scatter builder and the Pallas kernel
+    — the cross-backend bit-exactness contract depends on both sides
+    packing (and ``_unpack_lanes`` decoding) identically."""
+    import jax.numpy as jnp
+    KC, KH = 1 << cbits, 1 << hbits
+    qg = qg.astype(jnp.int32)
+    qh = qh.astype(jnp.int32)
+    if mode == "all3":
+        return [((qg * KH) + qh) * KC + 1]
+    if mode == "2ch":
+        return [qg, qh * KC + 1]
+    return [qg, qh, jnp.ones_like(qg)]
+
+
+def _unpack_lanes(acc, mode: str, cbits: int, hbits: int):
+    """Decode accumulated packed-lane sums -> ``(qg_sum, qh_sum, count)``.
+    Elementwise, so it serves any channel shape.  The lane terms are
+    multiples of KC/KH, so floor mod/div decode exactly — negative sums
+    included."""
+    KC, KH = 1 << cbits, 1 << hbits
+    if mode == "all3":
+        s = acc[0]
+        count = s % KC
+        s2 = (s - count) // KC
+        qh_s = s2 % KH
+        qg_s = (s2 - qh_s) // KH
+    elif mode == "2ch":
+        qg_s = acc[0]
+        count = acc[1] % KC
+        qh_s = (acc[1] - count) // KC
+    else:
+        qg_s, qh_s, count = acc[0], acc[1], acc[2]
+    return qg_s, qh_s, count
+
+
 def build_histograms_quantized(binned: jnp.ndarray, qg: jnp.ndarray,
                                qh: jnp.ndarray, node_ids: jnp.ndarray,
                                num_nodes: int, num_bins: int,
@@ -463,13 +500,7 @@ def build_histograms_quantized(binned: jnp.ndarray, qg: jnp.ndarray,
         raise ValueError("quantized histograms overflow int32 above "
                          f"{(1 << 31) // qh_cap} rows at {quant_bins} bins")
     mode, cbits, hbits = _packed_layout(bound, quant_bins)
-    KC, KH = 1 << cbits, 1 << hbits
-    if mode == "all3":
-        chans = [((qg * KH) + qh) * KC + 1]
-    elif mode == "2ch":
-        chans = [qg, qh * KC + 1]
-    else:
-        chans = [qg, qh, jnp.ones_like(qg)]
+    chans = _pack_lanes(qg, qh, mode, cbits, hbits)
 
     chunk = max(1024, min(n, (1 << 23) // max(F, 1)))
     n_pad = -n % chunk
@@ -498,18 +529,7 @@ def build_histograms_quantized(binned: jnp.ndarray, qg: jnp.ndarray,
         (b_mat.reshape(R, chunk, F),
          *[c.reshape(R, chunk) for c in chans],
          node.reshape(R, chunk)))
-    if mode == "all3":
-        s = acc[0]
-        count = s % KC                   # lane terms above are multiples of
-        s2 = (s - count) // KC           # KC/KH, so floor mod/div decode
-        qh_s = s2 % KH                   # exactly (negative sums included)
-        qg_s = (s2 - qh_s) // KH
-    elif mode == "2ch":
-        qg_s = acc[0]
-        count = acc[1] % KC
-        qh_s = (acc[1] - count) // KC
-    else:
-        qg_s, qh_s, count = acc
+    qg_s, qh_s, count = _unpack_lanes(acc, mode, cbits, hbits)
     return jnp.stack([qg_s, qh_s, count], axis=-1).reshape(
         num_nodes, F, B, 3)
 
@@ -586,22 +606,66 @@ def build_histograms_matmul_quantized(binned: jnp.ndarray, qg: jnp.ndarray,
     return jnp.moveaxis(hist, 0, -1)                                   # (P,F,B,3)
 
 
+def _pallas_pref():
+    """``MMLSPARK_TPU_HIST_PALLAS`` hatch: 1/true forces the fused Pallas
+    backend into the auto choice on ANY platform (interpret mode off-TPU),
+    0/false keeps auto off it, unset = auto-select on TPU only.  Explicit
+    ``backend=``/``MMLSPARK_TPU_HIST_BACKEND`` settings always win."""
+    import os
+    raw = os.environ.get("MMLSPARK_TPU_HIST_PALLAS", "").strip().lower()
+    if raw in ("0", "false", "off", "no"):
+        return False
+    if raw in ("1", "true", "on", "yes"):
+        return True
+    return None
+
+
+def resolve_quantized_backend(backend: str = "auto") -> str:
+    """Resolve the quantized-build backend the way ``build_quantized``
+    will: explicit caller choice > ``MMLSPARK_TPU_HIST_BACKEND`` env >
+    platform auto (TPU -> the fused Pallas kernel unless the
+    ``MMLSPARK_TPU_HIST_PALLAS=0`` hatch says otherwise; CPU -> scatter;
+    other accelerators -> matmul).  The growers call this at trace time to
+    decide whether the fused frontier path engages — the env knobs are part
+    of every jit cache key (``lightgbm.core._resolve_hist_backend``)."""
+    import os
+    if backend == "auto":
+        backend = os.environ.get("MMLSPARK_TPU_HIST_BACKEND", "auto")
+    if backend != "auto":
+        return backend
+    pref = _pallas_pref()
+    if pref is True:
+        return "pallas"
+    plat = jax.default_backend()
+    if plat == "cpu":
+        return "scatter"
+    if plat == "tpu" and pref is not False:
+        return "pallas"
+    return "matmul"
+
+
 def build_quantized(binned, qg, qh, node_ids, num_nodes, num_bins,
                     quant_bins: int = 16, backend: str = "auto",
                     max_rows=None, node_rows_bound=None):
     """Quantized-path backend dispatcher, mirroring ``build``: 'auto' picks
-    the int8 MXU build on accelerators and the packed int32 scatter on CPU;
+    the fused Pallas kernel on TPU (``MMLSPARK_TPU_HIST_PALLAS=0/1``
+    hatch; interpret mode everywhere else), the int8 MXU build on other
+    accelerators and the packed int32 scatter on CPU;
     ``MMLSPARK_TPU_HIST_BACKEND`` overrides only when the caller did not
     request a specific backend.  Returns int32 (nodes, F, B, 3)
     [sum_qg, sum_qh, count] — rescale with ``dequantize_histogram``."""
     import os
-    if backend == "auto":
-        backend = os.environ.get("MMLSPARK_TPU_HIST_BACKEND", backend)
+    backend = resolve_quantized_backend(backend)
     if backend == "pallas":
-        raise ValueError(
-            "the Pallas histogram backend was retired in round 5 (see "
-            "PARITY.md) — use backend='matmul' or 'scatter'")
-    if backend == "auto":
+        from . import pallas_histogram as _plh
+        if _plh.pallas_supported(num_bins, quant_bins, num_nodes=num_nodes):
+            return _plh.build_histograms_pallas(
+                binned, qg, qh, node_ids, num_nodes, num_bins,
+                quant_bins=quant_bins, node_rows_bound=node_rows_bound,
+                max_rows=max_rows)
+        # clean fallback: unsupported shape (bins/quant range, or a node
+        # frontier wider than the kernel's VMEM node cap — deep-level/
+        # sharded/streamed builds) -> the XLA builders
         backend = "scatter" if jax.default_backend() == "cpu" else "matmul"
     if backend == "matmul":
         kw = {}
@@ -624,22 +688,18 @@ def build(binned, grad, hess, node_ids, num_nodes, num_bins,
           sample_weight=None, backend: str = "auto", max_rows=None):
     """Backend dispatcher.  'auto' picks the MXU matmul build on accelerator
     platforms (13x faster than scatter on v5e, measured) and the scatter
-    build on CPU (where one-hot matmuls lose).  A hand-written Pallas VMEM
-    kernel was evaluated in rounds 3-4 and RETIRED in round 5 — it lost the
-    end-to-end shootout 3.5x to this XLA matmul formulation and carried a
-    ~1%% grad-channel deviation under Mosaic lowering (decision recorded in
-    PARITY.md); override the surviving backends via
+    build on CPU (where one-hot matmuls lose).  The round-3/4 FLOAT Pallas
+    kernel was retired in round 5 (lost the shootout 3.5x, Mosaic
+    grad-channel drift — PARITY.md); its ISSUE-8 successor
+    (``ops.pallas_histogram``) is integer-only and lives on the QUANTIZED
+    path (``build_quantized``), so a 'pallas' request here falls back
+    cleanly to the surviving float builders.  Override via
     MMLSPARK_TPU_HIST_BACKEND=matmul|scatter."""
     import os
     if backend == "auto":  # env override only applies when the caller did
         backend = os.environ.get("MMLSPARK_TPU_HIST_BACKEND", backend)
         # not request a specific backend (ADVICE r2)
-    if backend == "pallas":
-        raise ValueError(
-            "the Pallas histogram backend was retired in round 5 (lost the "
-            "end-to-end shootout to the XLA matmul build; see PARITY.md) — "
-            "use backend='matmul' or 'scatter'")
-    if backend == "auto":
+    if backend in ("auto", "pallas"):
         backend = "scatter" if jax.default_backend() == "cpu" else "matmul"
     # MXU tuning knobs (read at trace time; train() keys its jit caches on
     # them): block size, lo one-hot width, residual channels on/off

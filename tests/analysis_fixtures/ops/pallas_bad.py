@@ -1,0 +1,36 @@
+"""TRC true-positive fixture: Pallas kernel bodies are traced, so the same
+host-clock/RNG/print bans apply inside them (ISSUE 8).  Parsed by
+graft-lint only — never imported or executed."""
+import threading
+import time
+from functools import partial
+
+import jax
+import numpy as np
+from jax.experimental import pallas as pl
+
+_LOCK = threading.Lock()
+
+
+def _clocked_kernel(x_ref, o_ref):
+    t = time.time()                      # TRC001: host clock in a kernel
+    print("tile at", t)                  # TRC002
+    o_ref[...] = x_ref[...] * np.random.rand()   # TRC001: host RNG
+
+
+def _locked_kernel(x_ref, o_ref):
+    with _LOCK:                          # TRC003: lock inside traced code
+        o_ref[...] = x_ref[...]
+
+
+def _partial_kernel(cfg, x_ref, o_ref):
+    # rooted through pallas_call(partial(...)) — the partial's function
+    # argument is what gets traced
+    o_ref[...] = x_ref[...] + x_ref[...].sum().item()   # TRC004
+
+
+def run(x):
+    double = pl.pallas_call(_clocked_kernel, out_shape=x)
+    locked = pl.pallas_call(_locked_kernel, out_shape=x)
+    via_partial = pl.pallas_call(partial(_partial_kernel, 3), out_shape=x)
+    return double(x), locked(x), via_partial(x)

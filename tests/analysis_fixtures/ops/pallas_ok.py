@@ -1,0 +1,25 @@
+"""TRC near-miss fixture: a clean Pallas kernel (pure jnp body, host work
+outside the traced graph) must produce zero findings.  Parsed by
+graft-lint only — never imported or executed."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+
+def _scale_kernel(x_ref, o_ref):
+    # pure traced compute: iota indexing, masked select, no host calls
+    rows = jax.lax.broadcasted_iota(jnp.int32, x_ref.shape, 0)
+    o_ref[...] = jnp.where(rows < 8, x_ref[...] * 2.0, x_ref[...])
+
+
+def staged_run(x_host):
+    # host-side staging around the kernel is fine: clocks/RNG/print live
+    # OUTSIDE the traced body
+    t0 = time.time()
+    noisy = np.asarray(x_host) + np.random.rand(*x_host.shape)
+    out = pl.pallas_call(_scale_kernel, out_shape=noisy)(jnp.asarray(noisy))
+    print("kernel round trip in", time.time() - t0)
+    return float(np.asarray(out).sum())

@@ -120,8 +120,10 @@ def test_warm_relay_holder_phase_exists():
 def test_hist_ab_markers_fold_into_extras():
     proc = _child(
         "print('HIST_AB_RATES 1000.0 2500.0 2.5')\n"
-        "print('HIST_AB_MODE cpu_scatter_proxy 120000 50')\n")
-    got = bench._collect_multi(proc, ("HIST_AB_RATES", "HIST_AB_MODE"),
+        "print('HIST_AB_MODE cpu_scatter_proxy 120000 50')\n"
+        "print('HIST_AB_FUSED 1800.0 2100.0 1.167')\n")
+    got = bench._collect_multi(proc, ("HIST_AB_RATES", "HIST_AB_MODE",
+                                      "HIST_AB_FUSED"),
                                idle=10, hard=20)
     bench.RESULT["extras"].clear()
     try:
@@ -131,6 +133,28 @@ def test_hist_ab_markers_fold_into_extras():
         assert ex["hist_ab_f32_rows_per_sec"] == 1000.0
         assert ex["hist_ab_mode"] == "cpu_scatter_proxy"
         assert ex["hist_ab_shape"] == "120000x50"
+        # fused frontier arm (ISSUE 8) rides the same child
+        assert ex["hist_ab_separate_rows_per_sec"] == 1800.0
+        assert ex["hist_ab_fused_rows_per_sec"] == 2100.0
+        assert ex["hist_ab_fused_speedup"] == 1.167
         assert not bench._record_hist_ab({})   # absent markers -> False
+    finally:
+        bench.RESULT["extras"].clear()
+
+
+def test_hist_ab_fused_markers_are_optional():
+    """An older child (or a fused arm that crashed after the packed A/B)
+    must still fold the packed numbers — the fused extras are additive."""
+    proc = _child(
+        "print('HIST_AB_RATES 1000.0 2500.0 2.5')\n"
+        "print('HIST_AB_MODE cpu_scatter_proxy 120000 50')\n")
+    got = bench._collect_multi(proc, ("HIST_AB_RATES", "HIST_AB_MODE"),
+                               idle=10, hard=20)
+    bench.RESULT["extras"].clear()
+    try:
+        assert bench._record_hist_ab(got)
+        ex = bench.RESULT["extras"]
+        assert ex["hist_ab_packed_speedup"] == 2.5
+        assert "hist_ab_fused_speedup" not in ex
     finally:
         bench.RESULT["extras"].clear()

@@ -38,6 +38,8 @@ def _scan(checker, *relpaths, root=FIXTURES):
 PAIRS = [
     (TracerSafetyChecker, "parallel/trc_bad.py", "parallel/trc_ok.py",
      {"TRC001", "TRC002", "TRC003", "TRC004"}),
+    (TracerSafetyChecker, "ops/pallas_bad.py", "ops/pallas_ok.py",
+     {"TRC001", "TRC002", "TRC003", "TRC004"}),
     (ResilienceCoverageChecker, "cognitive/res_bad.py",
      "cognitive/res_ok.py", {"RES001"}),
     (UndeadlinedRetryChecker, "cognitive/res_deadline_bad.py",
@@ -70,6 +72,18 @@ def test_trc_reaches_through_call_edges_and_module_level_roots():
     assert "_shard_fn" in symbols
     # _scan_body is rooted by being passed to lax.scan inside run()
     assert "_scan_body" in symbols
+
+
+def test_trc_pallas_kernels_are_tracing_roots():
+    """pl.pallas_call-wrapped kernel bodies are traced code (ISSUE 8):
+    kernels passed directly AND through functools.partial must root the
+    reachability walk, and host work AROUND a pallas_call stays exempt."""
+    findings = _scan(TracerSafetyChecker(), "ops/pallas_bad.py")
+    symbols = {f.symbol for f in findings}
+    assert "_clocked_kernel" in symbols
+    assert "_locked_kernel" in symbols
+    # rooted through pallas_call(partial(_partial_kernel, 3), ...)
+    assert "_partial_kernel" in symbols
 
 
 def test_res002_fires_once_per_unbudgeted_site():

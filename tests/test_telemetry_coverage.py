@@ -149,6 +149,46 @@ def test_every_jit_call_site_is_instrumented_or_justified():
         f"'# raw-jit: <why>' pragma): {offenders}")
 
 
+#: call targets that hand a kernel body to the Pallas/Mosaic compiler —
+#: compile booking cannot wrap these lexically (they run INSIDE already
+#: instrumented jit programs), so each site must say where its compile
+#: accounting rides via a ``# pallas-site: <why>`` pragma
+_PALLAS_TARGETS = {"pl.pallas_call", "pallas.pallas_call", "pallas_call",
+                   "jax.experimental.pallas.pallas_call"}
+
+
+def test_every_pallas_site_is_instrumented_or_justified():
+    """ISSUE 8 twin of the raw-jit sweep: every ``pl.pallas_call`` site in
+    the hot modules carries a ``# pallas-site: <where compile booking
+    rides>`` pragma within two lines above it.  A pallas kernel compiles
+    inside its caller's jit program, so the compile counters see it only
+    through that wrapper — an unpragma'd site is a kernel whose compile
+    cost is silently unattributable."""
+    root = pathlib.Path(mmlspark_tpu.__file__).parent
+    offenders = []
+    for sub in JIT_SWEEP_DIRS:
+        for path in sorted((root / sub).rglob("*.py")):
+            src = path.read_text()
+            lines = src.splitlines()
+            tree = ast.parse(src)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call) or \
+                        _dotted(node.func) not in _PALLAS_TARGETS:
+                    continue
+                window = lines[max(0, node.lineno - 3):node.lineno]
+                if any("# pallas-site:" in ln for ln in window):
+                    continue
+                offenders.append(
+                    f"{path.relative_to(root)}:{node.lineno}")
+    assert not offenders, (
+        "pallas_call sites without a '# pallas-site: <why>' pragma (state "
+        "which instrumented_jit wrapper books their compiles): "
+        f"{offenders}")
+    # the sweep must actually cover the shipped kernel module
+    assert "# pallas-site:" in (root / "ops" / "pallas_histogram.py"
+                                ).read_text()
+
+
 def test_trainer_books_compute_phase_breakdown():
     """Source-level contract for the compute.train_step breakdown: the
     trainer must book trace/dispatch phases into the labelled phase
