@@ -322,6 +322,102 @@ def phase_hist_ab(n=1_000_000, f=200, nodes=16, reps=3, proxy=0) -> None:
           flush=True)
 
 
+def phase_runner(n=2000, hw=32, batch=128, reps=3, vocab=512, dec_batch=8,
+                 prompt=16, new_tokens=32, proxy=0) -> None:
+    """Unified-runner A/B (ISSUE 9): batch featurize throughput through
+    ``ModelRunner.apply_batch`` vs the legacy hand-rolled glue the runner
+    replaced (per-bucket ``jax.jit`` + pad, inlined here verbatim since the
+    library copy is gone) — same model, same buckets, same ragged row count,
+    so the ratio isolates the runner's host-side overhead (acceptance:
+    runner >= 0.9x legacy).  A decode arm then measures KV-cached batched
+    generation (prefill + one compiled step re-dispatched per token) and
+    reports tokens/sec — the ROADMAP's generative-serving number.  Inputs
+    perturb per rep (relay result-cache busting, as phase_gbdt)."""
+    from __graft_entry__ import enable_compilation_cache
+    enable_compilation_cache()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from mmlspark_tpu.models import ModelRunner, TransformerEncoder, resnet18
+    from mmlspark_tpu.models.runner import bucket_rows
+
+    if proxy:
+        n, batch, new_tokens = min(n, 600), min(batch, 64), min(new_tokens, 16)
+    module = resnet18(num_classes=64, dtype=jnp.float32)
+    variables = module.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, hw, hw, 3), jnp.float32))
+    x0 = np.asarray(jax.random.uniform(jax.random.PRNGKey(1), (n, hw, hw, 3),
+                                       jnp.float32))
+
+    def pure(vs, chunk):
+        return module.apply(vs, chunk, features=True)
+
+    # --- legacy arm: the pre-runner JaxModel glue, one jit per bucket
+    legacy_cache = {}
+
+    def legacy_apply(x):
+        outs = []
+        for start in range(0, x.shape[0], batch):
+            chunk = x[start:start + batch]
+            m = chunk.shape[0]
+            bucket = bucket_rows(m, batch)
+            if m < bucket:
+                chunk = np.concatenate(
+                    [chunk, np.repeat(chunk[-1:], bucket - m, axis=0)])
+            fn = legacy_cache.get(bucket)
+            if fn is None:
+                fn = legacy_cache[bucket] = jax.jit(pure)
+            outs.append(np.asarray(fn(variables, chunk))[:m])
+        return np.concatenate(outs)
+
+    runner = ModelRunner(module=module, variables=variables,
+                         apply_kwargs={"features": True},
+                         name="bench.resnet", batch_size=batch)
+
+    def timed(fn, tag):
+        fn(x0)                                   # compile warm, all buckets
+        _log(f"[bench] runner {tag} warm done")
+        rates = []
+        for r in range(1, reps + 1):
+            x = x0 + np.float32(0.001 * r)       # first-sight args per rep
+            t0 = time.perf_counter()
+            fn(x)
+            rates.append(n / (time.perf_counter() - t0))
+            _log(f"[bench] runner {tag} rep rows/s {rates[-1]:.0f}")
+        rates.sort()
+        return rates[len(rates) // 2]
+
+    r_legacy = timed(legacy_apply, "legacy")
+    r_runner = timed(runner.apply_batch, "runner")
+    print(f"RUNNER_AB {r_legacy} {r_runner} "
+          f"{r_runner / max(r_legacy, 1e-9)}", flush=True)
+
+    # --- decode arm: KV-cached batched generation tokens/sec
+    lm = TransformerEncoder(vocab_size=vocab, num_classes=vocab,
+                            embed_dim=256, num_heads=4, num_layers=4,
+                            mlp_dim=512, max_len=4096, causal=True,
+                            pool="none", dtype=jnp.float32)
+    lm_vars = lm.init(jax.random.PRNGKey(2),
+                      jnp.zeros((1, prompt), jnp.int32))
+    dec = ModelRunner(module=lm, variables=lm_vars, name="bench.lm",
+                      batch_size=dec_batch)
+    rng = np.random.default_rng(0)
+    prompts0 = rng.integers(0, vocab, (dec_batch, prompt)).astype(np.int32)
+    dec.decode(prompts0, max_new_tokens=new_tokens)    # compile warm
+    _log("[bench] runner decode warm done")
+    rates = []
+    for r in range(1, reps + 1):
+        prompts = (prompts0 + r) % vocab               # first-sight args
+        t0 = time.perf_counter()
+        res = dec.decode(prompts, max_new_tokens=new_tokens)
+        tps = res.tokens.size / (time.perf_counter() - t0)
+        rates.append(tps)
+        _log(f"[bench] runner decode rep tokens/s {tps:.1f}")
+    rates.sort()
+    print(f"RUNNER_DECODE {rates[len(rates) // 2]} {dec_batch} {new_tokens}",
+          flush=True)
+
+
 def phase_ooc(n=200_000, f=50, iters=8, tiles=4, reps=3) -> None:
     """Out-of-core streamed-vs-in-memory A/B at a fits-in-memory shape —
     the OVERHEAD bound for the chunked pipeline (ISSUE 7 acceptance:
@@ -730,6 +826,28 @@ def _record_ooc(got: dict) -> bool:
     return True
 
 
+def _record_runner(got: dict) -> bool:
+    """Fold a runner child's markers into extras; False when absent."""
+    ok = False
+    ex = RESULT["extras"]
+    vals = got.get("RUNNER_AB")
+    if vals and not isinstance(vals, str) and len(vals) >= 3:
+        ex["runner_ab_legacy_rows_per_sec"] = round(vals[0], 1)
+        ex["runner_ab_runner_rows_per_sec"] = round(vals[1], 1)
+        ex["runner_vs_legacy"] = round(vals[2], 3)
+        if vals[2] < 0.9:
+            _note("runner", f"runner/legacy {vals[2]:.3f} below the 0.9x "
+                            "overhead gate")
+        ok = True
+    dec = got.get("RUNNER_DECODE")
+    if dec and not isinstance(dec, str) and len(dec) >= 1:
+        ex["runner_decode_tokens_per_sec"] = round(dec[0], 1)
+        if len(dec) >= 3:
+            ex["runner_decode_shape"] = f"b{int(dec[1])}xt{int(dec[2])}"
+        ok = True
+    return ok
+
+
 def _record_gbdt_util(got: dict) -> bool:
     """Fold GBDT_UTIL (cost-analysis bytes/iter + HBM-roofline utilization
     %) into extras; False when the child had no cost analysis."""
@@ -910,6 +1028,16 @@ def _run_measured_phases(tpu_ok: bool, cpu_rps: float) -> None:
             _note("resnet", "both attempts failed; no featurize number")
         _emit()
 
+        # Phase 4d — unified-runner A/B + KV-cached decode tokens/sec on the
+        # chip (ISSUE 9: runner >= 0.9x the legacy glue it replaced, plus
+        # the generative-serving number).
+        got = _collect_multi(_spawn("runner", _tpu_env()),
+                             ("RUNNER_AB", "RUNNER_DECODE"),
+                             idle=600, hard=1100)
+        if not _record_runner(got):
+            _note("runner", "TPU runner A/B stalled/failed; CPU proxy will run")
+        _emit()
+
     # Phase 4b — packed-histogram A/B CPU proxy: covers the relay-down case
     # (and a failed TPU attempt) so the round artifact always carries an
     # attribution number for the quantized pipeline.
@@ -929,6 +1057,16 @@ def _run_measured_phases(tpu_ok: bool, cpu_rps: float) -> None:
                              idle=500, hard=900)
         if not _record_ooc(got):
             _note("ooc", "CPU proxy streamed A/B also failed; no ooc number")
+        _emit()
+
+    # Phase 4e — runner A/B CPU proxy (relay-down cover): the round artifact
+    # always carries the runner-overhead ratio + a decode tokens/sec number.
+    if "runner_vs_legacy" not in RESULT["extras"]:
+        got = _collect_multi(_spawn("runner", _cpu_env(), ["--proxy", "1"]),
+                             ("RUNNER_AB", "RUNNER_DECODE"),
+                             idle=500, hard=900)
+        if not _record_runner(got):
+            _note("runner", "CPU proxy runner A/B also failed; no runner number")
         _emit()
 
     # Phase 5 — serving latency + sustained load (pure host, CPU platform).
@@ -953,6 +1091,7 @@ if __name__ == "__main__":
             kw[rest[i].lstrip("-")] = int(rest[i + 1])
         {"health": phase_health, "gbdt": phase_gbdt, "ranker": phase_ranker,
          "resnet": phase_resnet, "cpu": phase_cpu, "hist_ab": phase_hist_ab,
-         "ooc": phase_ooc, "serving": phase_serving}[phase](**kw)
+         "ooc": phase_ooc, "serving": phase_serving,
+         "runner": phase_runner}[phase](**kw)
     else:
         main()

@@ -69,13 +69,17 @@ def _dotted_prefix_hit(dotted: str, table: Dict[str, str]) -> Optional[Tuple[str
 
 
 class _FnInfo:
-    __slots__ = ("node", "qualname", "calls", "banned", "param_names")
+    __slots__ = ("node", "qualname", "calls", "ext_calls", "banned",
+                 "param_names")
 
     def __init__(self, node: ast.AST, qualname: str):
         self.node = node
         self.qualname = qualname
         #: local names this function calls (intra-module edges)
         self.calls: Set[str] = set()
+        #: dotted call targets resolved through the import table — the
+        #: cross-module edge candidates (``transformer.decode_step``)
+        self.ext_calls: Set[str] = set()
         #: (node, message) banned sites found inside this function
         self.banned: List[Tuple[ast.AST, str, str]] = []
         args = node.args
@@ -83,13 +87,39 @@ class _FnInfo:
                             args.posonlyargs + args.args + args.kwonlyargs}
 
 
+class _ModRecord:
+    """One scanned module's TRC state, held until the cross-module pass."""
+
+    __slots__ = ("functions", "roots", "ext_roots", "imports")
+
+    def __init__(self, functions, roots, ext_roots, imports):
+        self.functions: Dict[str, _FnInfo] = functions
+        self.roots: Set[str] = roots
+        self.ext_roots: Set[str] = ext_roots
+        self.imports: Dict[str, str] = imports
+
+
+def _module_dotted(relpath: str) -> str:
+    """``mmlspark_tpu/models/transformer.py`` -> the dotted module path the
+    import table speaks (``__init__.py`` collapses to its package)."""
+    path = relpath[:-3] if relpath.endswith(".py") else relpath
+    if path.endswith("/__init__"):
+        path = path[: -len("/__init__")]
+    return path.replace("/", ".")
+
+
 class TracerSafetyChecker(Checker):
     """TRC — functions reachable from jit/shard_map/pmap/scan call sites
     must stay traceable: no host clocks/RNG/entropy, no print, no locks,
     no ``.item()``/``float()`` host syncs on array arguments.
 
-    Reachability is module-local: roots are functions decorated with (or
-    passed to) a tracing entry point; edges are same-module calls by name.
+    Reachability is CROSS-MODULE over the scanned scope (ISSUE 9 carried
+    follow-up; it was module-local through PR 8): roots are functions
+    decorated with (or passed to) a tracing entry point — including
+    imported functions, resolved through each module's import table — and
+    edges are calls by name, local or through an import.  An apply fn
+    defined in ``models/transformer.py`` and jitted by
+    ``models/runner.py`` is swept exactly like a locally-jitted one.
     """
 
     rules = {
@@ -102,12 +132,17 @@ class TracerSafetyChecker(Checker):
 
     SCOPE_DIRS = ("parallel/", "ops/", "models/", "lightgbm/")
 
+    def __init__(self):
+        #: relpath -> _ModRecord, consumed by the finalize cross-module BFS
+        self._records: Dict[str, _ModRecord] = {}
+
     def interested(self, relpath: str) -> bool:
         return any(f"/{d}" in f"/{relpath}" for d in self.SCOPE_DIRS)
 
     def begin_module(self, ctx: ModuleContext) -> None:
         ctx._trc_functions: Dict[str, _FnInfo] = {}
         ctx._trc_roots: Set[str] = set()
+        ctx._trc_ext_roots: Set[str] = set()
         ctx._trc_stack: List[_FnInfo] = []
 
     # ------------------------------------------------------------- helpers
@@ -122,13 +157,23 @@ class TracerSafetyChecker(Checker):
         return False
 
     def _mark_function_args(self, node: ast.Call, ctx: ModuleContext) -> None:
-        """Names passed into a tracing entry point become roots."""
+        """Names passed into a tracing entry point become roots — local
+        short names AND, when the name resolves through the import table,
+        the dotted target in its defining module (cross-module roots)."""
         for arg in list(node.args) + [kw.value for kw in node.keywords]:
             if isinstance(arg, ast.Name):
                 ctx._trc_roots.add(arg.id)
+                dotted = ctx.imports.get(arg.id)
+                if dotted and dotted != arg.id:
+                    ctx._trc_ext_roots.add(dotted)
             elif isinstance(arg, ast.Attribute):
-                # self._step / cls.step — root by attribute name
+                # self._step / cls.step — root by attribute name; an
+                # imported-module attribute (transformer.decode_step) also
+                # roots the target module's function
                 ctx._trc_roots.add(arg.attr)
+                dotted = ctx.dotted_name(arg)
+                if dotted and "." in dotted:
+                    ctx._trc_ext_roots.add(dotted)
             elif isinstance(arg, ast.Call) and ctx.dotted_name(arg.func) in \
                     ("functools.partial", "partial"):
                 # pallas_call(partial(_kernel, cfg), ...) — the partial's
@@ -193,8 +238,13 @@ class TracerSafetyChecker(Checker):
                                   "lock.acquire() inside traced code"))
             elif isinstance(node.func.value, ast.Name):
                 fn.calls.add(node.func.attr)  # self.method / mod.func edge
+                if dotted and "." in dotted:
+                    fn.ext_calls.add(dotted)  # imported-module call edge
         elif isinstance(node.func, ast.Name):
             fn.calls.add(node.func.id)
+            imported = ctx.imports.get(node.func.id)
+            if imported and imported != node.func.id:
+                fn.ext_calls.add(imported)  # from-imported call edge
 
     def _enclosing(self, ctx: ModuleContext) -> Optional[_FnInfo]:
         fnode = ctx.enclosing_function()
@@ -206,24 +256,70 @@ class TracerSafetyChecker(Checker):
         return None
 
     def end_module(self, ctx: ModuleContext) -> None:
-        functions: Dict[str, _FnInfo] = ctx._trc_functions
-        # BFS over intra-module call edges from the traced roots
-        traced: Set[str] = set()
-        frontier = [r for r in ctx._trc_roots if r in functions]
+        # emission moves to finalize: the reachability walk is global, so a
+        # module's verdict isn't known until every module has been parsed
+        self._records[ctx.relpath] = _ModRecord(
+            ctx._trc_functions, ctx._trc_roots, ctx._trc_ext_roots,
+            dict(ctx.imports))
+
+    # --------------------------------------------------- cross-module pass
+    def _resolve(self, dotted: str, by_dotted: Dict[str, str]
+                 ) -> Optional[Tuple[str, str]]:
+        """``models.transformer.decode_step`` -> (relpath, fn name) when the
+        defining module is in the scanned set.  Relative imports drop their
+        leading package segments, so modules match by dotted-path suffix."""
+        mod_path, _, leaf = dotted.rpartition(".")
+        if not mod_path:
+            return None
+        for scanned, relpath in by_dotted.items():
+            if scanned == mod_path or scanned.endswith("." + mod_path):
+                if leaf in self._records[relpath].functions:
+                    return relpath, leaf
+        return None
+
+    def finalize(self, engine) -> List[Finding]:
+        by_dotted = {_module_dotted(rel): rel for rel in self._records}
+        # roots: locally rooted names + imported names rooted elsewhere
+        frontier: List[Tuple[str, str]] = []
+        for rel, rec in self._records.items():
+            frontier.extend((rel, r) for r in rec.roots
+                            if r in rec.functions)
+            for dotted in rec.ext_roots:
+                target = self._resolve(dotted, by_dotted)
+                if target is not None:
+                    frontier.append(target)
+        # BFS over local short-name edges + import-resolved edges
+        traced: Set[Tuple[str, str]] = set()
         while frontier:
-            name = frontier.pop()
-            if name in traced:
+            node = frontier.pop()
+            if node in traced:
                 continue
-            traced.add(name)
-            for callee in functions[name].calls:
-                if callee in functions and callee not in traced:
-                    frontier.append(callee)
-        for name in sorted(traced):
-            info = functions[name]
+            traced.add(node)
+            rel, name = node
+            rec = self._records[rel]
+            info = rec.functions[name]
+            for callee in info.calls:
+                if callee in rec.functions:
+                    frontier.append((rel, callee))
+                else:
+                    # a from-imported short name: resolve via the table
+                    dotted = rec.imports.get(callee)
+                    if dotted and dotted != callee:
+                        target = self._resolve(dotted, by_dotted)
+                        if target is not None:
+                            frontier.append(target)
+            for dotted in info.ext_calls:
+                target = self._resolve(dotted, by_dotted)
+                if target is not None:
+                    frontier.append(target)
+        findings: List[Finding] = []
+        for rel, name in sorted(traced):
+            info = self._records[rel].functions[name]
             for node, rule, message in info.banned:
-                ctx._findings.append(Finding(
-                    rule=rule, file=ctx.relpath, line=node.lineno,
+                findings.append(Finding(
+                    rule=rule, file=rel, line=node.lineno,
                     message=message, symbol=info.qualname))
+        return findings
 
 
 # ---------------------------------------------------------------------------

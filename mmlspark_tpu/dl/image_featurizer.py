@@ -32,8 +32,16 @@ class ImageFeaturizer(Model, HasInputCol, HasOutputCol):
 
     def __init__(self, uid: Optional[str] = None, **kwargs):
         super().__init__(uid)
+        #: (config key, scoring JaxModel) — kept across transform calls so
+        #: the runner's lower-once executable cache is actually hit on the
+        #: second transform (rebuilding the scorer per call recompiled every
+        #: bucket every time; ISSUE 9)
+        self._scorer_cache = None
         if kwargs:
             self.set_params(**kwargs)
+
+    def _post_load(self):
+        self._scorer_cache = None
 
     def set_model(self, module=None, variables=None, apply_fn=None, apply_kwargs=None,
                   payload=None):
@@ -44,6 +52,9 @@ class ImageFeaturizer(Model, HasInputCol, HasOutputCol):
         if payload is None:
             payload = FlaxModelPayload(module, variables, apply_fn, apply_kwargs)
         self.set("model", payload)
+        # the cache key uses id(payload): a freed payload's id can be reused
+        # by a NEW payload, so replacement must invalidate explicitly
+        self._scorer_cache = None
         return self
 
     def _build_runner(self) -> JaxModel:
@@ -52,6 +63,10 @@ class ImageFeaturizer(Model, HasInputCol, HasOutputCol):
         h, w = self.get("height"), self.get("width")
         cut = self.get("cut_output_layers")
         norm = self.get("auto_convert")
+        key = (id(payload), h, w, cut, norm, self.get("batch_size"),
+               self.get_or_fail("input_col"), self.get_or_fail("output_col"))
+        if self._scorer_cache is not None and self._scorer_cache[0] == key:
+            return self._scorer_cache[1]
         is_onnx = isinstance(payload, OnnxModelPayload)
         if is_onnx and cut > 0 and not payload.cut_layers \
                 and not payload.output_names:
@@ -84,6 +99,7 @@ class ImageFeaturizer(Model, HasInputCol, HasOutputCol):
         runner.set("batch_size", self.get("batch_size"))
         runner.set("input_col", self.get_or_fail("input_col"))
         runner.set("output_col", self.get_or_fail("output_col"))
+        self._scorer_cache = (key, runner)
         return runner
 
     def _transform(self, df: DataFrame) -> DataFrame:

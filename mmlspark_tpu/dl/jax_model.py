@@ -17,6 +17,12 @@ TPU-native redesign:
   over ``data`` and params replicated (inference DP, SURVEY.md §2.11);
 - dtype coercion (reference ``coerceDFAndFeedDict`` :450-466) maps numeric /
   vector / image columns onto the model's input dtype.
+
+Since ISSUE 9 the jit/pad/bucket machinery itself lives in
+``models/runner.py``: ``JaxModel`` holds the payload and the column
+semantics, and ``_transform`` scores through a lazily-bound ``ModelRunner``
+(rebuilt by ``_post_load`` after deserialization, so a loaded model re-binds
+through the runner instead of rebuilding private jit state).
 """
 from __future__ import annotations
 
@@ -30,7 +36,6 @@ import numpy as np
 from ..core import (ComplexParam, DataFrame, HasInputCol, HasOutputCol, Model,
                     Param, Saveable)
 from ..core.schema import ColumnType, stack_vector_column
-from ..parallel import get_active_mesh, batch_sharded, replicated
 
 
 class FlaxModelPayload(Saveable):
@@ -109,49 +114,34 @@ class JaxModel(Model, HasInputCol, HasOutputCol):
 
     def __init__(self, uid: Optional[str] = None, **kwargs):
         super().__init__(uid)
-        self._jit_cache: Dict[Any, Callable] = {}
+        self._runner = None
         if kwargs:
             self.set_params(**kwargs)
 
     def _post_load(self):
-        self._jit_cache = {}
+        # the runner handle is live jit state and never serializes; a loaded
+        # model re-binds through a fresh ModelRunner on first use (ISSUE 9
+        # small fix: no private jit state to rebuild)
+        self._runner = None
 
     # ------------------------------------------------------------ helpers
     def set_model(self, module=None, variables=None, apply_fn=None, apply_kwargs=None):
         self.set("model", FlaxModelPayload(module, variables, apply_fn, apply_kwargs))
+        self._runner = None
         return self
 
-    def _jitted(self, payload: FlaxModelPayload, padded_n: int, feat_shape):
-        key = (padded_n, tuple(feat_shape))
-        fn = self._jit_cache.get(key)
-        if fn is None:
-            import jax
-            from ..observability.compute import instrumented_jit
-            mesh = get_active_mesh()
-            n_dev = mesh.devices.size
-            pure = payload.pure_apply
-            if n_dev > 1 and padded_n % n_dev == 0:
-                sharded = instrumented_jit(
-                    pure, name="dl.jax_model",
-                    in_shardings=(replicated(mesh), batch_sharded(mesh)),
-                    out_shardings=replicated(mesh))
-                if jax.process_count() > 1:
-                    # multi-host: jit refuses host-local numpy for
-                    # non-replicated shardings; every process holds the SAME
-                    # batch (executor model: identical partition per call),
-                    # so stage it as a global array explicitly
-                    bsh = batch_sharded(mesh)
-
-                    def fn(variables, chunk, _inner=sharded, _s=bsh):
-                        garr = jax.make_array_from_callback(
-                            chunk.shape, _s, lambda idx: chunk[idx])
-                        return _inner(variables, garr)
-                else:
-                    fn = sharded
-            else:
-                fn = instrumented_jit(pure, name="dl.jax_model")
-            self._jit_cache[key] = fn
-        return fn
+    def runner(self):
+        """The lazily-bound ``ModelRunner`` scoring this payload — built on
+        first use (and after every load/set_model), shared across transform
+        calls so the lower-once executable cache survives the stage's whole
+        life.  Exposed so serving glue can reuse the SAME runner (and its
+        compiled buckets) this stage scores batch transforms through."""
+        if self._runner is None:
+            from ..models.runner import ModelRunner
+            self._runner = ModelRunner(self.get_or_fail("model"),
+                                       name="dl.jax_model",
+                                       batch_size=self.get("batch_size"))
+        return self._runner
 
     def _stack_input(self, col: np.ndarray) -> np.ndarray:
         shape = self.get("input_shape")
@@ -167,9 +157,9 @@ class JaxModel(Model, HasInputCol, HasOutputCol):
         return x.astype(dtype, copy=False)
 
     def _transform(self, df: DataFrame) -> DataFrame:
-        payload: FlaxModelPayload = self.get_or_fail("model")
         bs = self.get("batch_size")
         in_col, out_col = self.get_or_fail("input_col"), self.get_or_fail("output_col")
+        runner = self.runner()
 
         def per_part(p):
             col = p[in_col]
@@ -177,22 +167,10 @@ class JaxModel(Model, HasInputCol, HasOutputCol):
             if n == 0:
                 return {**p, out_col: np.empty(0, dtype=object)}
             x = self._stack_input(col)
-            outs = []
-            variables = payload.variables
-            for start in range(0, n, bs):
-                chunk = x[start:start + bs]
-                m = chunk.shape[0]
-                # power-of-two latency buckets: a 1-row serving request pads
-                # to 1, not batch_size (round-1 weak item 9: 64 forwards for
-                # one row).  Each bucket compiles once and is cached.
-                bucket = bs if m == bs else min(bs, 1 << (m - 1).bit_length())
-                if m < bucket:
-                    pad = np.repeat(chunk[-1:], bucket - m, axis=0)
-                    chunk = np.concatenate([chunk, pad], axis=0)
-                fn = self._jitted(payload, bucket, chunk.shape[1:])
-                y = np.asarray(fn(variables, chunk))[:m]
-                outs.append(y)
-            y = np.concatenate(outs, axis=0)
+            # pad/bucket/shard and the lower-once executable cache all live
+            # in the runner now (ISSUE 9) — one copy of the glue for batch
+            # transform, serving, and decode alike
+            y = runner.apply_batch(x, front="transform", batch_size=bs)
             if self.get("output_mode") == "dense" and y.ndim == 2:
                 out_val = y
             else:

@@ -143,7 +143,8 @@ class Word2Vec(Estimator, _W2VParams):
             params, _ = jax.lax.scan(step, params, sl)
             return params
 
-        epoch_jit = jax.jit(one_epoch)
+        from ..observability.compute import instrumented_jit
+        epoch_jit = instrumented_jit(one_epoch, name="featurize.word2vec_epoch")
         scale = 0.5 / D
         params = (jnp.asarray(rng.uniform(-scale, scale, (V, D))
                               .astype(np.float32)),

@@ -1,0 +1,456 @@
+"""Unified model runner — lower-once StableHLO execution for every model.
+
+The paper's second capability pillar (ROADMAP "Unified StableHLO model
+runner"): one subsystem that takes an in-tree model (resnet, transformer,
+bilstm), an ONNX import (``dl/onnx_import.py``), or any pure
+``apply(variables, batch)`` callable, lowers it **once per (local device
+set, bucketed batch shape)** through ``instrumented_jit`` into a cached
+executable, and serves it behind two fronts:
+
+- **batch transform** — :meth:`ModelRunner.apply_batch` owns the padding/
+  bucketing/unpadding that ``dl/jax_model.py``, ``dl/image_featurizer.py``
+  and the serving scorers each hand-rolled before this PR (power-of-two
+  latency buckets: a 1-row request pads to 1, not ``batch_size``);
+- **low-latency serving** — :meth:`ModelRunner.scorer` returns a
+  ``Transformer`` that ``PipelineServer`` (and the streaming facade) score
+  through: the server's continuous-mode drain admits requests into one
+  in-flight batch, and the runner buckets that batch onto an already-lowered
+  executable, so steady-state latency never pays a compile.
+
+On top of it, generative scoring is a first-class workload:
+:meth:`ModelRunner.decode` runs a KV-cached batched decode loop — one
+prefill executable per (batch bucket, prompt bucket, cache length) plus ONE
+single-token step executable re-dispatched every token, with per-sequence
+lengths so ragged prompts decode exactly (``models/transformer.py`` owns
+the cache math; docs/runner.md states the correctness argument).
+
+Lowering contract (the lower-once/execute-many precedent is the Julia→TPU
+full-compilation work, PAPERS arxiv 1810.09868): every executable is keyed
+by (device set, bucket shape) and built exactly once; compile counts ride
+``mmlspark_jit_compile_total{fn="runner.<name>*"}`` so a recompile storm
+across ragged batch sizes is impossible by construction and visible on
+``/debug/compile`` if an input ever escapes the buckets.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..core import DataFrame, Transformer
+from ..core.schema import ColumnType
+
+__all__ = ["ModelRunner", "DecodeResult", "bucket_rows"]
+
+#: fronts a batch can arrive through; metric label values
+FRONTS = ("transform", "serving", "decode")
+
+
+def bucket_rows(m: int, batch_size: int) -> int:
+    """Power-of-two latency bucket for an ``m``-row chunk: a 1-row serving
+    request pads to 1, not ``batch_size``; full chunks use ``batch_size``
+    itself.  Each bucket lowers once and is cached."""
+    if m >= batch_size:
+        return batch_size
+    return min(batch_size, 1 << (max(1, m) - 1).bit_length())
+
+
+def _pad_rows(x: np.ndarray, target: int) -> np.ndarray:
+    """Pad the leading dim to ``target`` by repeating the last row (cheap,
+    and keeps the padded rows numerically tame for any model)."""
+    m = x.shape[0]
+    if m == target:
+        return x
+    pad = np.repeat(x[-1:], target - m, axis=0)
+    return np.concatenate([x, pad], axis=0)
+
+
+@dataclass
+class DecodeResult:
+    """One batched decode: ``tokens[b, t]`` is the t-th generated token of
+    sequence b; ``logits`` (collect_logits=True) holds the distribution
+    that produced each token; ``steps`` counts device dispatches (prefill
+    excluded); ``lengths`` echoes the prompt lengths the loop honoured."""
+    tokens: np.ndarray                 # (B, T) int32
+    lengths: np.ndarray                # (B,) prompt lengths
+    steps: int
+    logits: Optional[np.ndarray] = None  # (B, T, V) float32
+
+
+class ModelRunner:
+    """Compile-once execution cache + batch/serving/decode fronts.
+
+    Accepts any of:
+
+    - ``payload`` — an object exposing ``pure_apply`` / ``variables`` (and
+      optionally ``module``): ``FlaxModelPayload``, ``OnnxModelPayload``;
+    - ``module=`` + ``variables=`` — a flax module (resnet, transformer,
+      bilstm); ``apply_kwargs`` forward to ``module.apply``;
+    - ``apply_fn=`` + ``variables=`` — a raw pure ``(variables, batch)``
+      callable.
+
+    ``name`` labels every metric series and compile-report entry this
+    runner books — keep it low-cardinality (a model family, not a uid).
+    """
+
+    def __init__(self, payload=None, *, module=None, variables=None,
+                 apply_fn: Optional[Callable] = None,
+                 apply_kwargs: Optional[Dict[str, Any]] = None,
+                 name: str = "model", batch_size: int = 64,
+                 registry=None):
+        if payload is not None:
+            self._pure = payload.pure_apply
+            self.variables = payload.variables
+            self.module = getattr(payload, "module", None)
+        elif apply_fn is not None:
+            self._pure = apply_fn
+            self.variables = variables
+            self.module = module
+        elif module is not None:
+            kw = dict(apply_kwargs or {})
+
+            def _pure(vs, batch, _m=module, _kw=kw):
+                return _m.apply(vs, batch, **_kw)
+
+            self._pure = _pure
+            self.variables = variables
+            self.module = module
+        else:
+            raise ValueError("need a payload, a module, or an apply_fn")
+        self.name = name
+        self.batch_size = int(batch_size)
+        from ..observability import get_registry
+        self.registry = registry if registry is not None else get_registry()
+        #: (kind, device_key, *shape) -> executable; every entry lowered once
+        self._executables: Dict[Tuple, Callable] = {}
+        #: name -> InstrumentedJit wrappers this runner created (compile
+        #: introspection for tests and compile_stats)
+        self._wrappers: list = []
+        self._lock = threading.Lock()
+        reg = self.registry
+        c_batches = reg.counter(
+            "mmlspark_runner_batches_total",
+            "device dispatches per runner by front",
+            labels=("runner", "front"))
+        c_rows = reg.counter(
+            "mmlspark_runner_rows_total",
+            "real (unpadded) rows scored per runner by front",
+            labels=("runner", "front"))
+        self._c_batches = {f: c_batches.labels(runner=name, front=f)
+                          for f in FRONTS}
+        self._c_rows = {f: c_rows.labels(runner=name, front=f)
+                        for f in FRONTS}
+        self._c_pad = reg.counter(
+            "mmlspark_runner_pad_rows_total",
+            "padding rows added by bucketing (wasted device work)",
+            labels=("runner",)).labels(runner=name)
+        self._c_decode_steps = reg.counter(
+            "mmlspark_runner_decode_steps_total",
+            "single-token decode-step dispatches",
+            labels=("runner",)).labels(runner=name)
+        self._c_decode_tokens = reg.counter(
+            "mmlspark_runner_decode_tokens_total",
+            "tokens generated (real sequences only)",
+            labels=("runner",)).labels(runner=name)
+
+    # ------------------------------------------------------------- lowering
+    @staticmethod
+    def _device_key() -> Tuple:
+        """The local device set the executables are specialized to; a mesh
+        change (tests swapping in mesh8, a late-attached accelerator)
+        re-keys instead of serving a stale placement."""
+        from ..parallel import get_active_mesh
+        mesh = get_active_mesh()
+        return tuple(int(d.id) for d in mesh.devices.flat)
+
+    def _instrumented(self, fn: Callable, suffix: str = "", **jit_kwargs):
+        from ..observability.compute import instrumented_jit
+        wrapper = instrumented_jit(
+            fn, name=f"runner.{self.name}{suffix}",
+            registry=self.registry, **jit_kwargs)
+        self._wrappers.append(wrapper)
+        return wrapper
+
+    def executable(self, bucket_n: int, feat_shape: Tuple[int, ...]):
+        """The compiled apply for one (device set, bucketed batch shape) —
+        built on first use, a dict hit forever after.  Multi-device meshes
+        shard the batch dim over ``data`` with params replicated (inference
+        DP); multi-host processes stage their host-local batch as a global
+        array explicitly (jit refuses host-local numpy for non-replicated
+        shardings; every process holds the SAME batch under the executor
+        model — identical partition per call)."""
+        key = ("apply", self._device_key(), int(bucket_n), tuple(feat_shape))
+        fn = self._executables.get(key)
+        if fn is not None:
+            return fn
+        with self._lock:
+            fn = self._executables.get(key)
+            if fn is not None:
+                return fn
+            import jax
+            from ..parallel import batch_sharded, get_active_mesh, replicated
+            mesh = get_active_mesh()
+            n_dev = mesh.devices.size
+            if n_dev > 1 and bucket_n % n_dev == 0:
+                sharded = self._instrumented(
+                    self._pure,
+                    in_shardings=(replicated(mesh), batch_sharded(mesh)),
+                    out_shardings=replicated(mesh))
+                if jax.process_count() > 1:
+                    bsh = batch_sharded(mesh)
+
+                    def fn(variables, chunk, _inner=sharded, _s=bsh):
+                        garr = jax.make_array_from_callback(
+                            chunk.shape, _s, lambda idx: chunk[idx])
+                        return _inner(variables, garr)
+                else:
+                    fn = sharded
+            else:
+                fn = self._instrumented(self._pure)
+            self._executables[key] = fn
+        return fn
+
+    def compile_stats(self) -> Dict[str, Any]:
+        """Introspection for tests and ops: executables cached by key plus
+        the underlying compile count (one per signature by contract)."""
+        return {
+            "executables": sorted(
+                "/".join(str(p) for p in k) for k in self._executables),
+            "compiles": sum(getattr(w, "compiles", 0)
+                            for w in self._wrappers),
+        }
+
+    # ------------------------------------------------------------ batch front
+    def apply_batch(self, x: np.ndarray, front: str = "transform",
+                    batch_size: Optional[int] = None) -> np.ndarray:
+        """Score a stacked host batch of any row count: chunk to
+        ``batch_size``, pad each chunk to its power-of-two bucket, run the
+        cached executable, unpad, concatenate.  This is the ONE copy of the
+        pad/bucket glue the per-model transformers used to hand-roll."""
+        bs = int(batch_size or self.batch_size)
+        n = x.shape[0]
+        if n == 0:
+            return np.empty((0,), dtype=np.float32)
+        variables = self.variables
+        outs = []
+        pad_total = 0
+        for start in range(0, n, bs):
+            chunk = x[start:start + bs]
+            m = chunk.shape[0]
+            bucket = bucket_rows(m, bs)
+            pad_total += bucket - m
+            chunk = _pad_rows(chunk, bucket)
+            fn = self.executable(bucket, chunk.shape[1:])
+            outs.append(np.asarray(fn(variables, chunk))[:m])
+            self._c_batches[front].inc()
+        self._c_rows[front].inc(n)
+        if pad_total:
+            self._c_pad.inc(pad_total)
+        return np.concatenate(outs, axis=0)
+
+    # ---------------------------------------------------------- serving front
+    def scorer(self, input_col: str = "request", reply_col: str = "reply",
+               prepare: Optional[Callable] = None,
+               encode: Optional[Callable] = None,
+               mode: str = "score", **decode_kwargs) -> "Transformer":
+        """A ``Transformer`` front for ``PipelineServer`` / the streaming
+        facade.  ``mode="score"`` stacks request rows (via ``prepare``,
+        default ``np.asarray(..., float32)``) and scores them through
+        :meth:`apply_batch`; ``mode="decode"`` treats each request as a
+        token-id prompt and returns generated token lists from
+        :meth:`decode` (``decode_kwargs`` forward, e.g.
+        ``max_new_tokens=``).  The server's continuous-mode drain is the
+        admission window: whatever is in flight when the scorer runs
+        becomes ONE bucketed device batch."""
+        if mode not in ("score", "decode"):
+            raise ValueError("scorer mode must be score|decode")
+        return _RunnerScorer(self, input_col, reply_col, prepare, encode,
+                             mode, decode_kwargs)
+
+    # ------------------------------------------------------------ decode front
+    def _decode_executables(self, batch_b: int, prompt_b: int,
+                            cache_len: int):
+        """(prefill, step) executables for one decode signature.  Prefill is
+        keyed by (batch bucket, prompt bucket, cache length); the step by
+        (batch bucket, cache length) only — its input shapes are constant
+        across the whole generation loop, so EVERY token of EVERY request
+        at this signature re-dispatches one compiled program."""
+        import jax.numpy as jnp
+        module = self.module
+        dkey = self._device_key()
+        kp = ("prefill", dkey, batch_b, prompt_b, cache_len)
+        ks = ("step", dkey, batch_b, cache_len)
+        prefill = self._executables.get(kp)
+        step = self._executables.get(ks)
+        if prefill is not None and step is not None:
+            return prefill, step
+        with self._lock:
+            prefill = self._executables.get(kp)
+            if prefill is None:
+                def _prefill(variables, toks, positions, lengths, cache,
+                             _m=module):
+                    logits, cache = _m.apply(variables, toks,
+                                             positions=positions,
+                                             kv_cache=cache)
+                    # last REAL token's logits per sequence — gathered
+                    # on-device so the (B, P, V) tensor never crosses to host
+                    last = jnp.take_along_axis(
+                        logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+                    return last, cache
+
+                prefill = self._executables[kp] = self._instrumented(
+                    _prefill, suffix=".prefill")
+            step = self._executables.get(ks)
+            if step is None:
+                def _step(variables, tok, positions, cache, _m=module):
+                    logits, cache = _m.apply(variables, tok,
+                                             positions=positions,
+                                             kv_cache=cache)
+                    return logits[:, 0], cache
+
+                step = self._executables[ks] = self._instrumented(
+                    _step, suffix=".decode_step")
+        return prefill, step
+
+    def decode(self, prompts: np.ndarray, lengths=None,
+               max_new_tokens: int = 16, eos_id: Optional[int] = None,
+               sample_fn: Optional[Callable] = None,
+               collect_logits: bool = False,
+               batch_bucket: Optional[int] = None,
+               prompt_bucket: Optional[int] = None,
+               cache_len: Optional[int] = None) -> DecodeResult:
+        """KV-cached batched autoregressive generation.
+
+        ``prompts`` is ``(B, P)`` int32 (rows padded to the longest prompt);
+        ``lengths`` gives each sequence's true prompt length so ragged
+        batches decode exactly — each sequence writes and reads the cache at
+        ITS own frontier.  Buckets: ``B`` pads to a power-of-two row bucket,
+        ``P`` to a power-of-two prompt bucket, and the cache length defaults
+        to the next power of two covering prompt + new tokens — three static
+        shapes, so one prefill compile and one step compile serve every
+        request at the signature.  ``sample_fn(logits) -> tokens`` defaults
+        to greedy argmax; ``eos_id`` freezes finished sequences (and ends
+        the loop early once ALL are finished)."""
+        if self.module is None or not hasattr(self.module, "init_cache"):
+            raise TypeError(
+                "decode() needs a module with init_cache (a KV-cache-capable "
+                "model, e.g. models.TransformerEncoder with causal=True, "
+                "pool='none'); this runner wraps "
+                f"{type(self.module).__name__ if self.module else 'a raw apply_fn'}")
+        import jax.numpy as jnp
+        prompts = np.asarray(prompts, np.int32)
+        if prompts.ndim != 2:
+            raise ValueError("prompts must be (batch, prompt_len) int32")
+        B, P = prompts.shape
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        lengths = (np.full(B, P, np.int32) if lengths is None
+                   else np.asarray(lengths, np.int32))
+        if lengths.shape != (B,) or lengths.min() < 1 or lengths.max() > P:
+            raise ValueError("lengths must be (batch,) in [1, prompt_len]")
+        B_b = batch_bucket or 1 << (B - 1).bit_length()
+        P_b = prompt_bucket or 1 << (P - 1).bit_length()
+        if B_b < B or P_b < P:
+            raise ValueError("bucket smaller than the batch/prompt it serves")
+        S = cache_len or 1 << (P_b + max_new_tokens - 1).bit_length()
+        if S < P_b + max_new_tokens:
+            raise ValueError("cache_len must cover prompt_bucket + "
+                             "max_new_tokens")
+        toks = np.zeros((B_b, P_b), np.int32)
+        toks[:B, :P] = prompts
+        lens = np.concatenate([lengths, np.ones(B_b - B, np.int32)])
+        self._c_pad.inc((B_b - B) * P_b + B * (P_b - P))
+        prefill, step = self._decode_executables(B_b, P_b, S)
+        variables = self.variables
+        cache = self.module.init_cache(B_b, S)
+        positions = np.broadcast_to(np.arange(P_b, dtype=np.int32),
+                                    (B_b, P_b))
+        last, cache = prefill(variables, jnp.asarray(toks),
+                              jnp.asarray(positions), jnp.asarray(lens),
+                              cache)
+        self._c_batches["decode"].inc()
+        sample = sample_fn or (lambda lg: np.argmax(lg, axis=-1))
+        out_tokens = np.zeros((B_b, max_new_tokens), np.int32)
+        out_logits = [] if collect_logits else None
+        # pad rows are born finished: their garbage samples must never hold
+        # the eos early-exit open (or inflate the step counters)
+        finished = np.zeros(B_b, bool)
+        finished[B:] = True
+        steps = 0
+        for t in range(max_new_tokens):
+            lg = np.asarray(last)                      # (B_b, V) host fetch
+            if collect_logits:
+                out_logits.append(lg)
+            tok = np.asarray(sample(lg), np.int32)
+            if eos_id is not None:
+                tok = np.where(finished, eos_id, tok)
+                finished |= tok == eos_id
+            out_tokens[:, t] = tok
+            if t == max_new_tokens - 1 or \
+                    (eos_id is not None and bool(finished.all())):
+                break
+            # token t sits at absolute position lengths + t; the step
+            # writes it at that frontier and returns logits for t+1
+            pos = (lens + t).astype(np.int32)[:, None]
+            last, cache = step(variables, jnp.asarray(tok[:, None]),
+                               jnp.asarray(pos), cache)
+            steps += 1
+            self._c_decode_steps.inc()
+        n_generated = t + 1
+        self._c_decode_tokens.inc(B * n_generated)
+        self._c_rows["decode"].inc(B)
+        logits = (np.stack(out_logits, axis=1)[:B] if collect_logits
+                  else None)
+        return DecodeResult(tokens=out_tokens[:B, :n_generated],
+                            lengths=lengths, steps=steps, logits=logits)
+
+
+class _RunnerScorer(Transformer):
+    """Private serving front: built by :meth:`ModelRunner.scorer`, scored by
+    ``PipelineServer`` / the streaming facade.  Not a registered stage —
+    it is constructed programmatically around a live runner, never from
+    params, so it stays out of codegen/fuzzing by the ``_`` convention."""
+
+    def __init__(self, runner: ModelRunner, input_col: str, reply_col: str,
+                 prepare: Optional[Callable], encode: Optional[Callable],
+                 mode: str, decode_kwargs: Dict[str, Any]):
+        super().__init__()
+        self.runner = runner
+        self.input_col, self.reply_col = input_col, reply_col
+        self.prepare = prepare or (lambda v: np.asarray(v, np.float32))
+        self.encode = encode or (lambda y: y)
+        self.mode = mode
+        self.decode_kwargs = dict(decode_kwargs)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        def per_part(p):
+            col = p[self.input_col]
+            n = len(col)
+            out = np.empty(n, dtype=object)
+            if n == 0:
+                return {**p, self.reply_col: out}
+            if self.mode == "decode":
+                prompts = [np.asarray(v, np.int32).reshape(-1) for v in col]
+                lengths = np.asarray([len(q) for q in prompts], np.int32)
+                P = int(lengths.max())
+                stacked = np.zeros((n, P), np.int32)
+                for i, q in enumerate(prompts):
+                    stacked[i, :len(q)] = q
+                res = self.runner.decode(stacked, lengths=lengths,
+                                         **self.decode_kwargs)
+                for i in range(n):
+                    out[i] = self.encode(res.tokens[i])
+            else:
+                x = np.stack([self.prepare(v) for v in col])
+                y = self.runner.apply_batch(x, front="serving")
+                for i in range(n):
+                    out[i] = self.encode(y[i])
+            return {**p, self.reply_col: out}
+
+        return df.map_partitions(per_part)
+
+    def transform_schema(self, schema):
+        schema.require(self.input_col)
+        return schema.add(self.reply_col, ColumnType.VECTOR)

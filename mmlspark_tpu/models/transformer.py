@@ -5,6 +5,21 @@ SURVEY.md §5.7): a flax encoder whose attention can run dense, blockwise
 (memory-efficient single device), or as ring attention over the ``seq`` mesh
 axis for sequences longer than one device's HBM
 (``parallel.ring_attention``).
+
+Generative scoring (ISSUE 9): the encoder doubles as a causal LM
+(``causal=True, pool="none", num_classes=vocab_size``) with an explicit
+KV cache threaded through ``__call__(tokens, positions=..., kv_cache=...)``.
+The cache is a plain pytree — one ``(k, v)`` pair of static-shape
+``(batch, cache_len, heads, head_dim)`` slots per layer (``init_cache``) —
+so prefill and the single-token decode step are ordinary pure functions the
+``ModelRunner`` lowers ONCE each: the decode loop re-dispatches one compiled
+executable per token instead of recompiling per step (the lower-once/
+execute-many contract, PAPERS arxiv 1810.09868; the Gemma-on-TPU serving
+comparison in PAPERS.md is the reference point for the shape of the cache).
+Per-sequence write positions make ragged prompts exact: each sequence's new
+k/v land at ITS next slot, and attention masks keys strictly by absolute
+position, so padded prompt tails are overwritten before any real query can
+attend to them (see docs/runner.md, "Decode correctness").
 """
 from __future__ import annotations
 
@@ -14,6 +29,19 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from ..parallel import ring_attention as ra
+
+
+def _cache_update(cache_kv, k_new, v_new, positions):
+    """Scatter this call's per-token k/v into the cache slots.
+
+    ``cache_kv`` = (k, v) each (B, S, H, D); ``k_new``/``v_new`` (B, L, H, D);
+    ``positions`` (B, L) absolute slot per token — per-sequence, so ragged
+    batches write each sequence at its own frontier."""
+    ck, cv = cache_kv
+    bidx = jnp.arange(ck.shape[0])[:, None]            # (B, 1)
+    ck = ck.at[bidx, positions].set(k_new.astype(ck.dtype))
+    cv = cv.at[bidx, positions].set(v_new.astype(cv.dtype))
+    return ck, cv
 
 
 class MultiHeadAttention(nn.Module):
@@ -26,10 +54,38 @@ class MultiHeadAttention(nn.Module):
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, positions=None, kv_cache=None):
         B, L, _ = x.shape
         H, D = self.num_heads, self.head_dim
         qkv = nn.Dense(3 * H * D, dtype=self.dtype, name="qkv")(x)
+        if kv_cache is not None:
+            # KV-cached path (prefill when L = prompt bucket, decode when
+            # L = 1).  Dense only: blockwise/ring tile over the query axis
+            # and cannot address per-sequence cache slots.
+            if self.attention_mode != "dense":
+                raise ValueError(
+                    "kv_cache requires attention_mode='dense' (got "
+                    f"{self.attention_mode!r}); blockwise/ring serve the "
+                    "full-sequence paths only")
+            if positions is None:
+                raise ValueError("kv_cache requires explicit positions")
+            q, k, v = jnp.split(qkv.reshape(B, L, 3, H, D), 3, axis=2)
+            q, k, v = q[:, :, 0], k[:, :, 0], v[:, :, 0]   # (B, L, H, D)
+            ck, cv = _cache_update(kv_cache, k, v, positions)
+            s = jnp.einsum("blhd,bshd->bhls", q, ck) / jnp.sqrt(D)
+            # keys admissible strictly by absolute position: slot s serves
+            # query l iff s <= positions[b, l].  Slots past a sequence's
+            # frontier hold zeros or stale pad-token k/v, but every decode
+            # step writes its token at the frontier BEFORE attending, so
+            # admissible slots are always freshly written.
+            key_pos = jnp.arange(ck.shape[1])[None, None, None, :]
+            admissible = key_pos <= positions[:, None, :, None]
+            s = jnp.where(admissible, s, -1e30)
+            out = jnp.einsum("bhls,bshd->blhd", nn.softmax(s, axis=-1),
+                             cv.astype(s.dtype))
+            out = out.reshape(B, L, H * D)
+            return nn.Dense(x.shape[-1], dtype=self.dtype,
+                            name="proj")(out), (ck, cv)
         q, k, v = jnp.split(qkv.reshape(B, L, 3, H, D).transpose(2, 0, 3, 1, 4), 3)
         q, k, v = q[0], k[0], v[0]                    # (B, H, L, D)
         if self.attention_mode == "ring":
@@ -61,17 +117,22 @@ class EncoderBlock(nn.Module):
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, positions=None, kv_cache=None):
         h = nn.LayerNorm(dtype=self.dtype)(x)
-        h = MultiHeadAttention(self.num_heads, self.head_dim,
-                               self.attention_mode, self.causal,
-                               dtype=self.dtype)(h)
+        attn = MultiHeadAttention(self.num_heads, self.head_dim,
+                                  self.attention_mode, self.causal,
+                                  dtype=self.dtype)
+        if kv_cache is not None:
+            h, kv_cache = attn(h, positions=positions, kv_cache=kv_cache)
+        else:
+            h = attn(h)
         x = x + h
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h = nn.Dense(self.mlp_dim, dtype=self.dtype)(h)
         h = nn.gelu(h)
         h = nn.Dense(x.shape[-1], dtype=self.dtype)(h)
-        return x + h
+        x = x + h
+        return (x, kv_cache) if kv_cache is not None else x
 
 
 class TransformerEncoder(nn.Module):
@@ -91,26 +152,50 @@ class TransformerEncoder(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, features: bool = False,
-                 positions=None):
+                 positions=None, kv_cache=None):
         B, L = tokens.shape
         x = nn.Embed(self.vocab_size, self.embed_dim, dtype=self.dtype)(tokens)
         pos = self.param("pos_embed", nn.initializers.normal(0.02),
                          (1, self.max_len, self.embed_dim))
         if positions is not None:
-            # explicit global positions: required under sequence parallelism,
-            # where the local shard starts at axis_index * L_local
+            # explicit global positions: required under sequence parallelism
+            # (local shard starts at axis_index * L_local) and under KV-cached
+            # decode (each sequence's token sits at its own frontier)
             x = x + jnp.take(pos[0], positions, axis=0).astype(self.dtype)
         else:
             x = x + pos[:, :L].astype(self.dtype)
         head_dim = self.embed_dim // self.num_heads
+        new_cache = []
         for i in range(self.num_layers):
-            x = EncoderBlock(self.num_heads, head_dim, self.mlp_dim,
-                             self.attention_mode, self.causal,
-                             dtype=self.dtype, name=f"block_{i}")(x)
+            block = EncoderBlock(self.num_heads, head_dim, self.mlp_dim,
+                                 self.attention_mode, self.causal,
+                                 dtype=self.dtype, name=f"block_{i}")
+            if kv_cache is not None:
+                x, layer_kv = block(x, positions=positions,
+                                    kv_cache=kv_cache[i])
+                new_cache.append(layer_kv)
+            else:
+                x = block(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         if features:
-            return x.astype(jnp.float32)
-        if self.pool == "mean":
+            x = x.astype(jnp.float32)
+            return (x, tuple(new_cache)) if kv_cache is not None else x
+        if self.pool == "mean" and kv_cache is None:
             x = x.mean(axis=1)
         logits = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
-        return logits.astype(jnp.float32)  # (B, C) or (B, L, C) for pool="none"
+        logits = logits.astype(jnp.float32)  # (B, C) / (B, L, C) pool="none"
+        return (logits, tuple(new_cache)) if kv_cache is not None else logits
+
+    def init_cache(self, batch: int, cache_len: int):
+        """Zeroed KV-cache pytree: ``num_layers`` pairs of static-shape
+        ``(batch, cache_len, heads, head_dim)`` slots.  Plain data, no
+        params — build it host-side once per decode signature and thread it
+        through ``__call__(..., kv_cache=...)``.  ``cache_len`` bounds
+        prompt + generated tokens and is part of the compile signature."""
+        if cache_len > self.max_len:
+            raise ValueError(f"cache_len {cache_len} exceeds max_len "
+                             f"{self.max_len} (positional table bound)")
+        head_dim = self.embed_dim // self.num_heads
+        shape = (batch, cache_len, self.num_heads, head_dim)
+        return tuple((jnp.zeros(shape, self.dtype), jnp.zeros(shape, self.dtype))
+                     for _ in range(self.num_layers))

@@ -5,82 +5,125 @@ One implementation shared by ``bench.py``'s sustained-load phase and
 can never drift apart.  Reference context: the reference's serving claims
 are about SUSTAINED throughput (``docs/mmlspark-serving.md:10-11``), not
 single-connection latency.
+
+``mixed_load`` (ISSUE 9) drives several request classes — e.g. vector
+scoring AND generative decode — through one shared measurement window, the
+traffic shape the multi-model serving-fleet ROADMAP item needs a generator
+for: per-class latency percentiles under combined load, not per-class runs
+that never contend.
 """
 from __future__ import annotations
 
 import http.client
 import threading
 import time
-from typing import Dict, List
+from typing import Any, Dict, List, Optional, Sequence
+
+
+def mixed_load(host: str, port: int,
+               workloads: Sequence[Dict[str, Any]],
+               warm: int = 10) -> Dict[str, Dict[str, float]]:
+    """Fire several request classes concurrently through one wall-clock
+    window.
+
+    Each workload is ``{"name", "path", "body", "headers", "n_clients",
+    "per_client"}`` (``n_clients`` default 4, ``per_client`` default 100).
+    Every client opens its own persistent connection, fires ``warm``
+    untimed requests, then waits on ONE barrier shared by every workload —
+    the clock starts when the whole mixed fleet is warm, so the classes
+    genuinely contend for the server for the entire window.  Worker
+    exceptions are caught and counted; a dying connection deflates (never
+    inflates) its class's numbers.
+
+    Returns ``{workload_name: {"rps", "p50_ms", "p99_ms", "completed",
+    "errors"}, "combined": {...}}`` — per-class RPS shares the combined
+    wall window, so the numbers add up.  Raises AssertionError if no
+    request of any class completed.
+    """
+    names = [w["name"] for w in workloads]
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate workload names: {sorted(names)} — "
+                         "per-class attribution would silently merge them")
+    lats: Dict[str, List[float]] = {w["name"]: [] for w in workloads}
+    errors: Dict[str, List[str]] = {w["name"]: [] for w in workloads}
+    lock = threading.Lock()
+    total_clients = sum(int(w.get("n_clients", 4)) for w in workloads)
+    barrier = threading.Barrier(total_clients + 1)
+
+    def fire(w: Dict[str, Any]):
+        name = w["name"]
+        body, headers = w["body"], w.get("headers") or {}
+        mine: List[float] = []
+        try:
+            conn = http.client.HTTPConnection(host, port, timeout=30)
+            for _ in range(warm):
+                conn.request("POST", w["path"], body, headers)
+                conn.getresponse().read()
+        except Exception as e:  # noqa: BLE001 - a dead warm-up is an error
+            with lock:
+                errors[name].append(f"warmup: {e!r}")
+            try:
+                barrier.wait(timeout=60)
+            except Exception:  # noqa: BLE001
+                pass
+            return
+        try:
+            barrier.wait(timeout=60)
+        except Exception:  # noqa: BLE001
+            return
+        try:
+            for _ in range(int(w.get("per_client", 100))):
+                t0 = time.perf_counter()
+                conn.request("POST", w["path"], body, headers)
+                conn.getresponse().read()
+                mine.append(time.perf_counter() - t0)
+        except Exception as e:  # noqa: BLE001 - count what completed
+            with lock:
+                errors[name].append(repr(e))
+        finally:
+            with lock:
+                lats[name].extend(mine)
+
+    threads = [threading.Thread(target=fire, args=(w,))
+               for w in workloads for _ in range(int(w.get("n_clients", 4)))]
+    for t in threads:
+        t.start()
+    barrier.wait(timeout=120)      # clock starts once the whole fleet is warm
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    wall = max(time.perf_counter() - t0, 1e-9)
+
+    def stats(vals: List[float], errs: List[str]) -> Dict[str, float]:
+        vals = sorted(vals)
+        # the percentile keys are part of the return contract even for a
+        # class that completed nothing (0.0, with completed==0 saying why)
+        return {"rps": len(vals) / wall, "completed": float(len(vals)),
+                "errors": float(len(errs)),
+                "p50_ms": 1000 * vals[len(vals) // 2] if vals else 0.0,
+                "p99_ms": 1000 * vals[int(len(vals) * 0.99)] if vals else 0.0}
+
+    all_lats = [v for vs in lats.values() for v in vs]
+    all_errs = [e for es in errors.values() for e in es]
+    assert all_lats, f"no request completed; errors={all_errs[:3]}"
+    result = {w["name"]: stats(lats[w["name"]], errors[w["name"]])
+              for w in workloads}
+    result["combined"] = stats(all_lats, all_errs)
+    return result
 
 
 def sustained_load(host: str, port: int, path: str, body: str,
                    headers: Dict[str, str], n_clients: int = 8,
                    per_client: int = 250, warm: int = 10) -> Dict[str, float]:
     """Fire ``per_client`` requests from ``n_clients`` persistent
-    connections concurrently.
-
-    Each worker opens its connection and fires ``warm`` untimed requests,
-    then waits on a barrier; the wall clock starts when every worker is
-    warm, so connection setup and warm-up never bias the window.  Worker
-    exceptions are CAUGHT and counted — the RPS numerator is the number of
-    requests that actually completed, so a dying connection deflates (never
-    inflates) the result.
+    connections concurrently — the single-workload special case of
+    :func:`mixed_load` (one shared warm barrier, completed-request RPS
+    numerator, caught-and-counted worker errors).
 
     Returns {"rps", "p50_ms", "p99_ms", "completed", "errors"}.
     Raises AssertionError if no request completed.
     """
-    lats: List[float] = []
-    errors: List[str] = []
-    lock = threading.Lock()
-    barrier = threading.Barrier(n_clients + 1)
-
-    def fire():
-        mine: List[float] = []
-        try:
-            conn = http.client.HTTPConnection(host, port, timeout=10)
-            for _ in range(warm):
-                conn.request("POST", path, body, headers)
-                conn.getresponse().read()
-        except Exception as e:  # noqa: BLE001 - a dead warm-up is an error
-            with lock:
-                errors.append(f"warmup: {e!r}")
-            try:
-                barrier.wait(timeout=30)
-            except Exception:  # noqa: BLE001
-                pass
-            return
-        try:
-            barrier.wait(timeout=30)
-        except Exception:  # noqa: BLE001
-            return
-        try:
-            for _ in range(per_client):
-                t0 = time.perf_counter()
-                conn.request("POST", path, body, headers)
-                conn.getresponse().read()
-                mine.append(time.perf_counter() - t0)
-        except Exception as e:  # noqa: BLE001 - count what completed
-            with lock:
-                errors.append(repr(e))
-        finally:
-            with lock:
-                lats.extend(mine)
-
-    threads = [threading.Thread(target=fire) for _ in range(n_clients)]
-    for t in threads:
-        t.start()
-    barrier.wait(timeout=60)          # clock starts once every worker is warm
-    t0 = time.perf_counter()
-    for t in threads:
-        t.join()
-    wall = time.perf_counter() - t0
-    assert lats, f"no request completed; errors={errors[:3]}"
-    lats.sort()
-    return {
-        "rps": len(lats) / max(wall, 1e-9),
-        "p50_ms": 1000 * lats[len(lats) // 2],
-        "p99_ms": 1000 * lats[int(len(lats) * 0.99)],
-        "completed": float(len(lats)),
-        "errors": float(len(errors)),
-    }
+    res = mixed_load(host, port, [{
+        "name": "default", "path": path, "body": body, "headers": headers,
+        "n_clients": n_clients, "per_client": per_client}], warm=warm)
+    return res["default"]

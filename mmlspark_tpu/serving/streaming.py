@@ -244,16 +244,29 @@ class _StreamReader:
 class _StreamPipeline:
     def __init__(self, source: HTTPStreamSource):
         self.source = source
-        self._model: Optional[Transformer] = None
+        self._model = None
+        self._scorer_kwargs: Dict[str, Any] = {}
 
-    def transform_with(self, model: Transformer) -> "_StreamPipeline":
+    def transform_with(self, model, **scorer_kwargs) -> "_StreamPipeline":
+        """Score micro-batches through ``model``: a fitted ``Transformer``,
+        or a ``models.ModelRunner`` directly (ISSUE 9) — the runner is
+        wrapped in its serving scorer at ``reply_to`` time, bound to this
+        source's value column, so streaming scoring rides the SAME
+        lower-once executable cache as batch transform and PipelineServer
+        (``scorer_kwargs`` forward, e.g. ``mode="decode"``,
+        ``max_new_tokens=``)."""
         self._model = model
+        self._scorer_kwargs = dict(scorer_kwargs)
         return self
 
     def reply_to(self, reply_col: str, trigger_interval_ms: int = 1) -> StreamingQuery:
         if self._model is None:
             raise ValueError("call transform_with(model) before reply_to")
-        return StreamingQuery(self.source, self._model, reply_col,
+        model = self._model
+        if not isinstance(model, Transformer) and hasattr(model, "scorer"):
+            model = model.scorer(input_col=self.source.value_col,
+                                 reply_col=reply_col, **self._scorer_kwargs)
+        return StreamingQuery(self.source, model, reply_col,
                               trigger_interval_ms).start()
 
 

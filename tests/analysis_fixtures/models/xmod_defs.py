@@ -1,0 +1,22 @@
+# TRC cross-module fixture — the DEFINING module: nothing here is jitted
+# locally, so a module-local walk sees no roots and stays silent.  The
+# sibling xmod_use.py jits these through imports; the cross-module pass
+# must sweep them anyway (ISSUE 9: runner.py jits apply fns from models/).
+import time
+
+
+def jitted_elsewhere(variables, batch):
+    t = time.time()          # TRC001 once xmod_use jits this function
+    return batch * t
+
+
+def called_from_traced(x):
+    print("inside traced")   # TRC002 through a cross-module call edge
+    return x + 1
+
+
+def never_traced(x):
+    # identical banned call, but nothing roots this function anywhere —
+    # the near-miss proving cross-module reachability is not "flag every
+    # banned call in scope"
+    return x * time.time()

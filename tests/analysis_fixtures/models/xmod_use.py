@@ -1,0 +1,13 @@
+# TRC cross-module fixture — the USING module: jits functions DEFINED in
+# xmod_defs.py.  Clean on its own; the findings belong to the sibling.
+import jax
+
+from .xmod_defs import called_from_traced, jitted_elsewhere
+
+apply_step = jax.jit(jitted_elsewhere)
+
+
+@jax.jit
+def local_root(x):
+    # cross-module CALL edge: a locally-rooted function calling an import
+    return called_from_traced(x)

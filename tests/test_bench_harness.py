@@ -158,3 +158,37 @@ def test_hist_ab_fused_markers_are_optional():
         assert "hist_ab_fused_speedup" not in ex
     finally:
         bench.RESULT["extras"].clear()
+
+
+def test_runner_markers_fold_into_extras():
+    """ISSUE 9: the runner A/B + decode markers must fold (and note a
+    below-gate overhead ratio); the decode arm is additive like the fused
+    hist_ab arm."""
+    proc = _child(
+        "print('RUNNER_AB 1000.0 980.0 0.98')\n"
+        "print('RUNNER_DECODE 512.5 8 32')\n")
+    got = bench._collect_multi(proc, ("RUNNER_AB", "RUNNER_DECODE"),
+                               idle=10, hard=20)
+    bench.RESULT["extras"].clear()
+    try:
+        assert bench._record_runner(got)
+        ex = bench.RESULT["extras"]
+        assert ex["runner_ab_legacy_rows_per_sec"] == 1000.0
+        assert ex["runner_ab_runner_rows_per_sec"] == 980.0
+        assert ex["runner_vs_legacy"] == 0.98
+        assert ex["runner_decode_tokens_per_sec"] == 512.5
+        assert ex["runner_decode_shape"] == "b8xt32"
+        assert "runner" not in ex.get("phase_notes", {})
+        assert not bench._record_runner({})    # absent markers -> False
+    finally:
+        bench.RESULT["extras"].clear()
+
+
+def test_runner_below_gate_ratio_leaves_a_note():
+    bench.RESULT["extras"].clear()
+    try:
+        assert bench._record_runner({"RUNNER_AB": [1000.0, 500.0, 0.5]})
+        assert bench.RESULT["extras"]["runner_vs_legacy"] == 0.5
+        assert "0.9x" in bench.RESULT["extras"]["phase_notes"]["runner"]
+    finally:
+        bench.RESULT["extras"].clear()

@@ -137,7 +137,9 @@ def test_torch_import_matches_torch():
 
 def test_jax_model_single_row_uses_small_bucket():
     """Round-1 weak item 9: a 1-row request must not pad to batch_size=64 —
-    it compiles/uses the 1-row bucket (latency path)."""
+    it compiles/uses the 1-row bucket (latency path).  The buckets now live
+    in the stage's ModelRunner (ISSUE 9), keyed (kind, devices, bucket,
+    feat shape)."""
     import jax.numpy as jnp
     from mmlspark_tpu.dl import JaxModel
 
@@ -148,11 +150,15 @@ def test_jax_model_single_row_uses_small_bucket():
     one[0] = np.asarray([1.0, 2.0], np.float32)
     out = jm.transform(DataFrame.from_dict({"input": one})).collect()["out"]
     np.testing.assert_allclose(np.asarray(out[0]), [2.0, 4.0])
-    assert any(k[0] == 1 for k in jm._jit_cache), jm._jit_cache.keys()
+
+    def buckets():
+        return {k[2] for k in jm.runner()._executables if k[0] == "apply"}
+
+    assert 1 in buckets(), buckets()
     # 3 rows -> bucket 4; full batches still use batch_size
     three = np.empty(3, dtype=object)
     for i in range(3):
         three[i] = np.asarray([float(i), 1.0], np.float32)
     jm.transform(DataFrame.from_dict({"input": three}))
-    assert any(k[0] == 4 for k in jm._jit_cache)
-    assert not any(k[0] == 64 for k in jm._jit_cache)
+    assert 4 in buckets()
+    assert 64 not in buckets()

@@ -74,6 +74,23 @@ def test_trc_reaches_through_call_edges_and_module_level_roots():
     assert "_scan_body" in symbols
 
 
+def test_trc_cross_module_reachability():
+    """ISSUE 9 carried follow-up: reachability crosses module boundaries.
+    xmod_defs.py jits NOTHING locally; xmod_use.py jits its functions via
+    imports (directly and through a call edge).  The findings must land in
+    the DEFINING module — and only for functions actually rooted."""
+    findings = _scan(TracerSafetyChecker(), "models/xmod_defs.py",
+                     "models/xmod_use.py")
+    by_symbol = {f.symbol: f for f in findings}
+    assert "jitted_elsewhere" in by_symbol       # rooted by jit(import)
+    assert "called_from_traced" in by_symbol     # rooted via call edge
+    assert "never_traced" not in by_symbol, \
+        "cross-module pass must not flag unrooted functions"
+    assert all(f.file == "models/xmod_defs.py" for f in findings)
+    # the defining module ALONE stays silent: no local roots
+    assert _scan(TracerSafetyChecker(), "models/xmod_defs.py") == []
+
+
 def test_trc_pallas_kernels_are_tracing_roots():
     """pl.pallas_call-wrapped kernel bodies are traced code (ISSUE 8):
     kernels passed directly AND through functools.partial must root the

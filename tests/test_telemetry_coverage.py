@@ -86,8 +86,11 @@ def test_lightgbm_phase_histogram_carries_backend_and_quant_labels():
 
 
 #: hot-module directories whose jit entry points must carry compute-plane
-#: telemetry (ISSUE 6 contract)
-JIT_SWEEP_DIRS = ("lightgbm", "ops", "parallel", "serving")
+#: telemetry (ISSUE 6 contract; ISSUE 9 extended the sweep over the model
+#: runner's home dirs — models/, dl/, featurize/ — so every runner jit site
+#: is instrumented or pragma'd)
+JIT_SWEEP_DIRS = ("lightgbm", "ops", "parallel", "serving", "models", "dl",
+                  "featurize")
 
 #: call targets that hand a function to the XLA compiler
 _JIT_TARGETS = {"jax.jit", "jax.pmap", "jax.shard_map", "shard_map",
@@ -234,6 +237,42 @@ def test_prefetch_seam_books_overlap_histograms():
     assert "TilePrefetcher" in inspect.getsource(gbdt_core.train_streamed)
     assert "TilePrefetcher" in inspect.getsource(
         trainer_mod.Trainer.train_stream)
+
+
+def test_runner_books_front_and_decode_metrics():
+    """ISSUE 9 coverage: the ModelRunner is the one copy of the pad/bucket/
+    dispatch glue, so its metric seam is the only place batch-vs-serving-vs-
+    decode attribution can come from.  Source-level: apply_batch must book
+    rows/batches/padding, decode must book steps/tokens, and every
+    executable must be built through the instrumented path (a raw jax.jit
+    in the runner would silently drop compile accounting for every model it
+    serves).  Live: construction registers all five families."""
+    import inspect as _inspect
+
+    from mmlspark_tpu.models import runner as runner_mod
+    from mmlspark_tpu.observability import MetricsRegistry
+
+    apply_src = _inspect.getsource(runner_mod.ModelRunner.apply_batch)
+    for needle in ("_c_batches[front]", "_c_rows[front]", "_c_pad"):
+        assert needle in apply_src, f"apply_batch lost {needle}"
+    decode_src = _inspect.getsource(runner_mod.ModelRunner.decode)
+    for needle in ("_c_decode_steps", "_c_decode_tokens"):
+        assert needle in decode_src, f"decode lost {needle}"
+    for fn in (runner_mod.ModelRunner.executable,
+               runner_mod.ModelRunner._decode_executables):
+        assert "_instrumented" in _inspect.getsource(fn), \
+            f"{fn.__name__} no longer lowers through instrumented_jit"
+
+    reg = MetricsRegistry()
+    runner_mod.ModelRunner(apply_fn=lambda v, x: x, variables={},
+                           name="sweep", registry=reg)
+    for family in ("mmlspark_runner_batches_total",
+                   "mmlspark_runner_rows_total",
+                   "mmlspark_runner_pad_rows_total",
+                   "mmlspark_runner_decode_steps_total",
+                   "mmlspark_runner_decode_tokens_total"):
+        assert reg.family(family) is not None, \
+            f"ModelRunner no longer registers {family}"
 
 
 def test_every_stage_routes_verbs_through_log_verb():
