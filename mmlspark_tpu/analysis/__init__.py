@@ -15,16 +15,17 @@ workflow, and how to add a checker.
 """
 from .baseline import (BaselineEntry, load_baseline, save_baseline,
                        split_findings, update_baseline)
-from .checkers import (HotPathChecker, LockDisciplineChecker,
-                       ResilienceCoverageChecker, TracerSafetyChecker,
-                       TransferDisciplineChecker, UndeadlinedRetryChecker)
+from .checkers import (CheckpointAtomicityChecker, HotPathChecker,
+                       LockDisciplineChecker, ResilienceCoverageChecker,
+                       TracerSafetyChecker, TransferDisciplineChecker,
+                       UndeadlinedRetryChecker)
 from .cli import default_checkers, main, rule_catalog, run_analysis
 from .engine import AnalysisEngine, Checker, Finding, iter_python_files
 from .stagecheck import StageContractChecker
 
 __all__ = [
-    "AnalysisEngine", "BaselineEntry", "Checker", "Finding",
-    "HotPathChecker", "LockDisciplineChecker", "ResilienceCoverageChecker",
+    "AnalysisEngine", "BaselineEntry", "Checker", "CheckpointAtomicityChecker",
+    "Finding", "HotPathChecker", "LockDisciplineChecker", "ResilienceCoverageChecker",
     "StageContractChecker", "TracerSafetyChecker",
     "TransferDisciplineChecker", "UndeadlinedRetryChecker",
     "default_checkers", "iter_python_files", "load_baseline", "main",

@@ -14,8 +14,9 @@ from typing import Dict, List, Optional, Set, Tuple
 from .engine import Checker, Finding, ModuleContext, with_lock_items
 
 __all__ = ["TracerSafetyChecker", "ResilienceCoverageChecker",
-           "UndeadlinedRetryChecker", "LockDisciplineChecker",
-           "HotPathChecker", "TransferDisciplineChecker"]
+           "UndeadlinedRetryChecker", "CheckpointAtomicityChecker",
+           "LockDisciplineChecker", "HotPathChecker",
+           "TransferDisciplineChecker"]
 
 
 # ---------------------------------------------------------------------------
@@ -466,6 +467,59 @@ class UndeadlinedRetryChecker(Checker):
                    args.posonlyargs + args.args + args.kwonlyargs):
                 return True
         return False
+
+
+#: open() modes that create/modify the target — the torn-write hazard
+_WRITE_MODE_CHARS = set("wax+")
+
+
+class CheckpointAtomicityChecker(Checker):
+    """RES003 — a direct ``open(..., "w"/"wb"/"a"/...)`` write inside a
+    checkpoint module bypasses the atomic temp-file + ``os.replace``
+    publish contract (``io/checkpoint.atomic_write``): a crash mid-write
+    tears the very snapshot the module exists to protect, and resume then
+    has nothing valid to fall back to.  Route every checkpoint-path write
+    through the atomic writer; reads are fine."""
+
+    rules = {"RES003": "direct open(..., 'w'/'wb'/'a') write in a "
+                       "checkpoint module — route through "
+                       "io.checkpoint.atomic_write"}
+
+    #: the atomic writer's own module is the one sanctioned raw-open site
+    EXCLUDED = ("io/checkpoint.py",)
+
+    def interested(self, relpath: str) -> bool:
+        base = relpath.rsplit("/", 1)[-1]
+        if "checkpoint" not in base:
+            return False
+        norm = f"/{relpath}"
+        return not any(norm.endswith(f"/{e}") for e in self.EXCLUDED)
+
+    def visit(self, node: ast.AST, ctx: ModuleContext) -> None:
+        if not isinstance(node, ast.Call):
+            return
+        dotted = ctx.dotted_name(node.func)
+        if dotted not in ("open", "io.open", "builtins.open"):
+            return
+        mode = None
+        if len(node.args) >= 2:
+            mode = node.args[1]
+        for kw in node.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        if mode is None:
+            return  # default "r": reads are fine
+        if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+            if not (_WRITE_MODE_CHARS & set(mode.value)):
+                return  # read-only mode
+        # non-constant modes are flagged too: the checker cannot prove
+        # they are read-only, and checkpoint writes must be provably atomic
+        shown = repr(mode.value) if isinstance(mode, ast.Constant) \
+            else "<dynamic>"
+        ctx.report("RES003", node,
+                   f"{dotted}(..., mode={shown}) — checkpoint writes must "
+                   "publish via io.checkpoint.atomic_write (temp file + "
+                   "os.replace)")
 
 
 # ---------------------------------------------------------------------------

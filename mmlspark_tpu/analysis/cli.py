@@ -19,9 +19,10 @@ from typing import List, Optional, Sequence
 
 from .baseline import (DEFAULT_BASELINE_NAME, load_baseline, split_findings,
                        update_baseline)
-from .checkers import (HotPathChecker, LockDisciplineChecker,
-                       ResilienceCoverageChecker, TracerSafetyChecker,
-                       TransferDisciplineChecker, UndeadlinedRetryChecker)
+from .checkers import (CheckpointAtomicityChecker, HotPathChecker,
+                       LockDisciplineChecker, ResilienceCoverageChecker,
+                       TracerSafetyChecker, TransferDisciplineChecker,
+                       UndeadlinedRetryChecker)
 from .engine import AnalysisEngine, Checker, Finding, iter_python_files
 from .stagecheck import StageContractChecker
 
@@ -30,9 +31,9 @@ __all__ = ["default_checkers", "run_analysis", "main", "rule_catalog"]
 
 def default_checkers() -> List[Checker]:
     return [TracerSafetyChecker(), ResilienceCoverageChecker(),
-            UndeadlinedRetryChecker(), LockDisciplineChecker(),
-            HotPathChecker(), TransferDisciplineChecker(),
-            StageContractChecker()]
+            UndeadlinedRetryChecker(), CheckpointAtomicityChecker(),
+            LockDisciplineChecker(), HotPathChecker(),
+            TransferDisciplineChecker(), StageContractChecker()]
 
 
 def rule_catalog() -> dict:

@@ -38,6 +38,7 @@ from typing import Any, Callable, Dict, Iterable, Optional, Sequence, Tuple
 import numpy as np
 
 from ..observability.metrics import MetricsRegistry, get_registry
+from ..utils.resilience import current_deadline, is_transient_io
 
 __all__ = ["ChunkedDataset", "TilePrefetcher", "resolve_tile_rows",
            "pad_tile", "TILE_ROWS_ENV"]
@@ -211,6 +212,15 @@ class TilePrefetcher:
     deterministic tests; :attr:`waiting` is a test seam set while the
     consumer is blocked on an empty pipeline.
 
+    Transient ``load_fn`` failures (flaky storage, a wedged device relay)
+    retry up to ``retries`` times with exponential backoff
+    (``retry_backoff_s`` × ``retry_backoff_mult``^k, clipped to the
+    ambient :class:`~mmlspark_tpu.utils.resilience.Deadline`), classified
+    transient-vs-fatal by ``is_transient`` (default
+    ``utils.resilience.is_transient_io``); each retried attempt books
+    ``mmlspark_prefetch_retries_total{site}``.  Retries happen before the
+    tile enters the queue, so delivery stays exactly-once and in order.
+
     Both histograms book HOST-VISIBLE time: on an async-dispatch backend a
     consumer that only enqueues device work attributes the dispatch gap to
     compute, so device-side serialization shows up in end-to-end
@@ -224,12 +234,36 @@ class TilePrefetcher:
     def __init__(self, items: Iterable[Any], load_fn: Callable[[Any], Any],
                  *, site: str = "unlabeled",
                  clock: Optional[Callable[[], float]] = None,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 retries: int = 3, retry_backoff_s: float = 0.05,
+                 retry_backoff_mult: float = 2.0,
+                 is_transient: Optional[Callable[[BaseException], bool]] = None,
+                 sleep: Optional[Callable[[float], None]] = None):
         self._items = items
         self._load = load_fn
         self._clock = clock if clock is not None else time.perf_counter
         self.site = site
+        # transient-failure retry (ISSUE 10): a flaky tile load must not
+        # kill an hours-long stream.  Bounded exponential backoff, clipped
+        # to the consumer's ambient Deadline (captured HERE — contextvars
+        # do not cross into the worker thread), transient-vs-fatal
+        # classified by utils.resilience.is_transient_io unless overridden.
+        # The retry happens strictly BEFORE the tile enters the queue, so
+        # exactly-once delivery and ordering are untouched.
+        self._retries = max(0, int(retries))
+        self._retry_backoff_s = float(retry_backoff_s)
+        self._retry_backoff_mult = float(retry_backoff_mult)
+        self._is_transient = is_transient if is_transient is not None \
+            else is_transient_io
+        self._sleep = sleep if sleep is not None else time.sleep
+        self._deadline = current_deadline()
+        self.retries_total = 0
         reg = registry if registry is not None else get_registry()
+        self._c_retry = reg.counter(
+            "mmlspark_prefetch_retries_total",
+            "transient tile-load failures retried by the prefetch worker "
+            "(each inc is one failed attempt that was retried, not a "
+            "killed stream)", labels=("site",)).labels(site=site)
         self._h_wait = reg.histogram(
             "mmlspark_prefetch_wait_seconds",
             "host->device prefetch stall: consumer time blocked waiting for "
@@ -272,10 +306,36 @@ class TilePrefetcher:
                 self._tokens.acquire()
                 if self._cancel.is_set():
                     return
-                self._q.put((self._load(item), None))
+                self._q.put((self._load_with_retry(item), None))
             self._q.put((self._DONE, None))
         except BaseException as exc:  # noqa: BLE001 — propagated to consumer
             self._q.put((self._DONE, exc))
+
+    def _load_with_retry(self, item):
+        """``load_fn`` under bounded deadline-clipped backoff: transient
+        failures retry up to ``retries`` times with exponential backoff
+        (never sleeping past the ambient deadline's remaining budget);
+        fatal failures and exhausted budgets propagate to the consumer as
+        before.  Runs on the worker thread, so retry sleeps overlap the
+        consumer's compute exactly like the load itself does."""
+        delay = self._retry_backoff_s
+        attempt = 0
+        while True:
+            try:
+                return self._load(item)
+            except BaseException as exc:  # noqa: BLE001 — classified below
+                if attempt >= self._retries or not self._is_transient(exc) \
+                        or self._cancel.is_set():
+                    raise
+                if self._deadline is not None and self._deadline.expired():
+                    raise
+                attempt += 1
+                self.retries_total += 1
+                self._c_retry.inc()
+                sleep_s = delay if self._deadline is None else \
+                    min(delay, max(0.0, self._deadline.remaining()))
+                self._sleep(sleep_s)
+                delay *= self._retry_backoff_mult
 
     # -------------------------------------------------------------- consumer
     def __iter__(self):

@@ -1444,7 +1444,11 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
           feature_names: Optional[List[str]] = None,
           callbacks: Optional[List[Callable]] = None,
           shard_rows: bool = False,
-          bin_cache: Optional[Dict] = None) -> TrainResult:
+          bin_cache: Optional[Dict] = None,
+          checkpoint_dir: Optional[str] = None,
+          checkpoint_every: int = 0,
+          checkpoint_keep_last: int = 3,
+          resume: str = "auto") -> TrainResult:
     """Boosting loop.  Host python drives iterations; each tree is one jitted
     XLA program (reference: driver drives ``updateOneIteration`` per iter,
     ``TrainUtils.scala:67``).  ``shard_rows`` puts the binned matrix/gradients
@@ -1456,7 +1460,19 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
     nothing; mutating X IN PLACE between calls is detected by the ~4k-element
     strided fingerprint and rebins — but a mutation that only touches
     elements the stride skips can slip through, so callers that rewrite X
-    wholesale should pass a fresh cache dict rather than rely on detection."""
+    wholesale should pass a fresh cache dict rather than rely on detection.
+
+    Fault tolerance (ISSUE 10): with ``checkpoint_dir`` set, the run
+    snapshots its booster-so-far + iteration + host PRNG/bagging state
+    atomically every ``checkpoint_every`` iterations (plus once at the end)
+    — the snapshot arrays are handed to a background writer thread as
+    device-array references, so the device-to-host fetch AND the disk
+    write both happen off the boosting loop.  ``resume="auto"`` restores
+    the newest valid snapshot and continues through the warm-start
+    machinery (a torn newest snapshot falls back to the previous one);
+    SIGTERM/SIGINT requests one final checkpoint at the next iteration
+    boundary and returns the partial booster cleanly with
+    ``extras["preempted"]`` set."""
     import jax
     import jax.numpy as jnp
     from ..observability import get_registry
@@ -1576,6 +1592,61 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
         p = dataclasses.replace(p, cat_subset=tuple(sub))
 
     sig = _params_sig(p) + (hist_cfg,)
+
+    # ---- fault tolerance (ISSUE 10): periodic atomic checkpoints + resume
+    # through the warm-start machinery below
+    import contextlib
+    from ..io.checkpoint import CheckpointManager, check_resume_arg
+    from ..utils.resilience import PreemptionToken, preemption_scope
+    _ckpt_fingerprint = repr((sig, n, F, B, K, shard_rows,
+                              _content_fingerprint(X)))
+    _mgr = None
+    if checkpoint_dir:
+        check_resume_arg(resume)
+        _mgr = CheckpointManager(checkpoint_dir, site="lightgbm.train",
+                                 keep_last=checkpoint_keep_last)
+    _resume_meta = None
+    _resume_bag: Optional[np.ndarray] = None
+    _n_user_init_trees = init_booster.num_trees if init_booster is not None \
+        else 0
+    if _mgr is not None and resume == "auto":
+        _got = _mgr.load_latest()
+        if _got is not None:
+            _, _arrs, _meta = _got
+            if _meta.get("fingerprint") != _ckpt_fingerprint:
+                raise ValueError(_CKPT_FINGERPRINT_MISMATCH)
+            from ..models.gbdt import children_depth_bound
+            # the snapshot booster replaces any user init_booster: it
+            # already CONTAINS those trees (they were replayed into the
+            # run the snapshot came from)
+            init_booster = GBDTBooster(
+                np.asarray(_arrs["split_feature"]),
+                np.asarray(_arrs["threshold"]),
+                np.asarray(_arrs["threshold_bin"]),
+                np.asarray(_arrs["split_gain"]),
+                np.asarray(_arrs["internal_value"]),
+                np.asarray(_arrs["internal_count"]),
+                np.asarray(_arrs["leaf_value"]),
+                np.asarray(_arrs["leaf_count"]),
+                np.asarray(_arrs["tree_weight"], np.float32),
+                left_child=np.asarray(_arrs["left_child"]),
+                right_child=np.asarray(_arrs["right_child"]),
+                max_depth=children_depth_bound(_arrs["left_child"],
+                                               _arrs["right_child"]),
+                num_features=F, objective=p.objective, num_class=K,
+                init_score=float(_meta["init_score"]),
+                average_output=(p.boosting_type == "rf"),
+                sigmoid=p.sigmoid,
+                categorical_features=list(p.categorical_features or []),
+                cat_bitset=(np.asarray(_arrs["cat_bitset"], bool)
+                            if "cat_bitset" in _arrs else None))
+            _n_user_init_trees = int(_meta.get("n_init_trees", 0))
+            if "bag_mask" in _arrs:
+                # unpacked at the restore site below: shard_rows pads n
+                # between here and there
+                _resume_bag = np.asarray(_arrs["bag_mask"])
+            _resume_meta = _meta
+
     if shard_rows:
         from jax.sharding import PartitionSpec as P
         from ..parallel import get_active_mesh, batch_sharded
@@ -1698,9 +1769,34 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
         yv = np.asarray(valid[1], np.float32)
         binned_v = jnp.asarray(mapper.transform(Xv))
         scores_v = jnp.full((Xv.shape[0], K), init_score, jnp.float32)
+        if _resume_meta is not None and init_booster is not None:
+            # resumed run: valid scores must carry the contributions of
+            # the trees grown BEFORE the crash (user warm-start trees stay
+            # out, matching the uninterrupted run's scores_v history)
+            init_cbs_v = init_booster.resolve_cat_bitset(B) \
+                if store_bitset else None
+            for t in range(_n_user_init_trees, init_booster.num_trees):
+                leaf_v = walker(binned_v,
+                                jnp.asarray(init_booster.split_feature[t]),
+                                jnp.asarray(init_booster.threshold_bin[t]),
+                                jnp.asarray(init_booster.left_child[t]),
+                                jnp.asarray(init_booster.right_child[t]),
+                                bitset=(jnp.asarray(init_cbs_v[t])
+                                        if store_bitset else None))
+                scores_v = scores_v.at[:, t % K].add(
+                    jnp.asarray(init_booster.leaf_value[t])[leaf_v]
+                    * init_booster.tree_weight[t])
     best_metric = -np.inf if larger_better else np.inf
     best_iter = -1
     rounds_no_improve = 0
+    if _resume_meta is not None:
+        # restore the host-side loop state the snapshot carried: the PRNG
+        # (feature/bagging/dart draws), early-stopping scalars, and evals
+        rng.bit_generator.state = _resume_meta["rng_state"]
+        best_metric = float(_resume_meta["best_metric"])
+        best_iter = int(_resume_meta["best_iter"])
+        rounds_no_improve = int(_resume_meta["rounds_no_improve"])
+        evals[:] = [dict(e) for e in _resume_meta.get("evals", [])]
 
     feat_mask_full = jnp.ones((F,), bool)
     hist_mask_full = jnp.ones((n,), bool) if not shard_rows else jnp.asarray(w > 0)
@@ -1871,9 +1967,53 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
 
     it = start_iter
     bag_mask = None  # sampled lazily on the first bagging-eligible iteration
+    if _resume_bag is not None:
+        bag_mask = jnp.asarray(np.unpackbits(_resume_bag)[:n].astype(bool))
     lambda_fn = None  # built on first lambdarank iteration, reused after
-    end_iter = start_iter + p.num_iterations
-    while it < end_iter:
+    _run_iter0 = start_iter
+    _done_before = 0
+    if _resume_meta is not None:
+        _done_before = int(_resume_meta["iteration"])
+        if _resume_meta.get("finished") and p.num_iterations <= int(
+                _resume_meta.get("num_iterations", _done_before)):
+            # the snapshot IS the finished run: skip the loop and return
+            # its booster; a LARGER num_iterations target keeps training
+            _done_before = p.num_iterations
+    end_iter = start_iter + max(0, p.num_iterations - _done_before)
+    _preempted = False
+    _last_ckpt_iter = start_iter
+    _trees_at_loop_start = len(tree_weights)
+
+    def _save_ckpt_train(finished: bool, block: bool = False) -> None:
+        # snapshot = list copies of DEVICE array refs (immutable; the tree
+        # outputs are never donated) — np.asarray/stack/serialize/publish
+        # all run on the manager's writer thread, so the boosting loop
+        # never waits on the device fetch or the disk.  Completed-
+        # iteration accounting derives from the TREE COUNT (one shared
+        # convention with train_streamed): loop counters disagree with
+        # completed work at early-stop breaks and mid-chunk boundaries.
+        done = len(tree_weights) // K - _n_user_init_trees // K
+        meta = _booster_ckpt_meta(done, _n_user_init_trees, rng,
+                                  best_metric, best_iter, rounds_no_improve,
+                                  evals, init_score, _ckpt_fingerprint,
+                                  finished, p.num_iterations, "booster_v1")
+        _mgr.save(done, _booster_ckpt_arrays(trees, tree_weights, bag_mask),
+                  meta, block=block)
+
+    _scope = preemption_scope() if _mgr is not None \
+        else contextlib.nullcontext(PreemptionToken())
+    with _scope as _token:
+      while it < end_iter:
+        if _token.requested:
+            # preempted: final checkpoint at this iteration boundary, then
+            # a clean partial return the caller can resume from
+            _save_ckpt_train(finished=False, block=True)
+            _preempted = True
+            break
+        if _mgr is not None and checkpoint_every > 0 \
+                and it - _last_ckpt_iter >= checkpoint_every:
+            _save_ckpt_train(finished=False)
+            _last_ckpt_iter = it
         if multi_iter is not None and end_iter - it >= CH:
             keys = jnp.stack([jrandom.PRNGKey(p.seed * 1000003 + it + j)
                               for j in range(CH)])
@@ -2050,6 +2190,15 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
                 cb(it, evals[-1] if evals else None)
         it += 1
 
+    if _mgr is not None:
+        if not _preempted and (len(tree_weights) > _trees_at_loop_start
+                              or _resume_meta is None):
+            # terminal snapshot (covers early stopping too): resume of a
+            # finished run restores the final booster without retraining;
+            # a finished-run restore that grew nothing skips the re-save
+            _save_ckpt_train(finished=True, block=True)
+        _mgr.close()
+
     trees_np = jax.device_get({k: v for k, v in trees.items()})  # one transfer
     lch_np = np.stack(trees_np["left_child"])
     rch_np = np.stack(trees_np["right_child"])
@@ -2085,8 +2234,18 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
     _train_span.set_attribute("features", F)
     _train_span.set_attribute("iterations", len(tree_weights) // K)
     _train_span.set_attribute("growth", p.growth)
+    _extras = None
+    if _mgr is not None:
+        _extras = {"preempted": float(_preempted),
+                   "resumed_from_iteration":
+                       float(_resume_meta["iteration"])
+                       if _resume_meta is not None else -1.0,
+                   "checkpoint_saves": float(_mgr.saves_ok)}
+        for k, v in _extras.items():
+            _train_span.set_attribute(f"ckpt.{k}", v)
     export_span(_train_span)
-    return TrainResult(booster=booster, evals=evals, bin_mapper=mapper)
+    return TrainResult(booster=booster, evals=evals, bin_mapper=mapper,
+                       extras=_extras)
 
 
 # ---------------------------------------------------------------------------
@@ -2110,6 +2269,89 @@ def _check_quant_tile_bound(use_quant: bool, quant_bins: int,
             "use_quantized_grad")
 
 
+#: the streamed paths' array-of-nodes tree surface (booster column order)
+_STREAM_TREE_KEYS = ("left_child", "right_child", "split_feature",
+                     "threshold", "threshold_bin", "split_gain",
+                     "internal_value", "internal_count", "leaf_value",
+                     "leaf_count")
+
+
+def _booster_ckpt_arrays(trees: Dict[str, list], tree_weights: list,
+                         bag_mask) -> Callable[[], Dict[str, np.ndarray]]:
+    """Snapshot-arrays callable shared by ``train`` and ``train_streamed``
+    (one copy so the two drivers' checkpoint formats cannot drift).  The
+    training thread pays only list copies; ``np.asarray``/``np.stack``/
+    ``np.packbits`` — including any device-to-host fetches for device-
+    resident trees or bagging masks — run on the manager's writer thread.
+    Tree arrays and the bag mask are immutable once captured (the loop
+    REBINDS them rather than mutating), so the deferred reads are safe."""
+    tl = {k: list(v) for k, v in trees.items()}
+    tw = list(tree_weights)
+
+    def _arrays(tl=tl, tw=tw, bm=bag_mask):
+        out = {k: np.stack([np.asarray(a) for a in v])
+               for k, v in tl.items()}
+        out["tree_weight"] = np.asarray(tw, np.float32)
+        if bm is not None:
+            out["bag_mask"] = np.packbits(np.asarray(bm, bool))
+        return out
+
+    return _arrays
+
+
+def _booster_ckpt_meta(completed_iter: int, n_init_trees: int, rng,
+                       best_metric, best_iter: int, rounds_no_improve: int,
+                       evals: list, init_score: float, fingerprint: str,
+                       finished: bool, num_iterations: int,
+                       fmt: str) -> Dict:
+    """Snapshot meta shared by both drivers.  ``completed_iter`` is the
+    ONE convention both must use: boosting iterations completed beyond the
+    user's warm-start trees, derived from the tree count (robust to early
+    stopping and the fused multi-iteration chunk path, where loop counters
+    and completed work can disagree at the break)."""
+    return {"iteration": int(completed_iter),
+            "n_init_trees": int(n_init_trees),
+            "rng_state": rng.bit_generator.state,
+            "best_metric": best_metric, "best_iter": int(best_iter),
+            "rounds_no_improve": int(rounds_no_improve),
+            "evals": [dict(e) for e in evals],
+            "init_score": float(init_score),
+            "fingerprint": fingerprint, "finished": bool(finished),
+            "num_iterations": int(num_iterations), "format": fmt}
+
+
+_CKPT_FINGERPRINT_MISMATCH = (
+    "checkpoint_dir holds a snapshot for different data or params "
+    "(fingerprint mismatch) — point checkpoint_dir at a fresh directory, "
+    "or pass resume='never' (docs/RESILIENCE.md: training fault tolerance)")
+
+
+def _np_walk_tree(binned: np.ndarray, sf: np.ndarray, tb: np.ndarray,
+                  lch: np.ndarray, rch: np.ndarray,
+                  depth_bound: int) -> np.ndarray:
+    """Host twin of ``make_binned_walker`` for numerical splits: per-row
+    leaf index of ONE tree over host-resident binned data.  Integer
+    compares and gathers only, so the leaf assignment is exactly the one
+    the device walker (and the streamed router) produces — which is what
+    lets resume replay reconstruct training scores bit-for-bit without
+    ever putting the full binned matrix on device."""
+    n = binned.shape[0]
+    node = np.zeros((n,), np.int64)
+    rows = np.arange(n)
+    sf = np.asarray(sf, np.int64)
+    tb = np.asarray(tb, np.int64)
+    lch = np.asarray(lch, np.int64)
+    rch = np.asarray(rch, np.int64)
+    for _ in range(max(1, int(depth_bound))):
+        j = np.maximum(node, 0)
+        f = sf[j]
+        go_right = (f >= 0) & (binned[rows, np.maximum(f, 0)].astype(np.int64)
+                               > tb[j])
+        child = np.where(go_right, rch[j], lch[j])
+        node = np.where(node >= 0, child, node)
+    return ~node
+
+
 def _np_leaf_output(G, H, l1: float, l2: float, max_delta: float):
     """Host-side twin of the growers' leaf_output (f32 in, f32 out).
     Empty nodes (G=H=0, l2=0) yield NaN exactly like the device version —
@@ -2128,7 +2370,13 @@ def train_streamed(X, y: Optional[np.ndarray] = None, params: GBDTParams = None,
                    valid: Optional[Tuple[np.ndarray, np.ndarray]] = None,
                    tile_rows: Optional[int] = None,
                    memory_budget_bytes: Optional[int] = None,
-                   feature_names: Optional[List[str]] = None) -> TrainResult:
+                   feature_names: Optional[List[str]] = None,
+                   init_booster: Optional[GBDTBooster] = None,
+                   callbacks: Optional[List[Callable]] = None,
+                   checkpoint_dir: Optional[str] = None,
+                   checkpoint_every: int = 0,
+                   checkpoint_keep_last: int = 3,
+                   resume: str = "auto") -> TrainResult:
     """Out-of-core boosting: the dataset lives in host RAM and streams
     through the device in fixed-shape tiles with double-buffered prefetch
     (Snap ML's host->HBM hierarchy, ``io.chunked``).  Nothing row-sized is
@@ -2159,6 +2407,25 @@ def train_streamed(X, y: Optional[np.ndarray] = None, params: GBDTParams = None,
     ``io.chunked.resolve_tile_rows``); prefetch overlap books into
     ``mmlspark_prefetch_wait_seconds`` / ``mmlspark_tile_compute_seconds``
     and is returned in ``TrainResult.extras``.
+
+    Warm start: ``init_booster`` continues training from an existing
+    single-output gbdt booster, matching ``train()`` — its trees replay on
+    the host (exact integer walks + the same float32 score adds training
+    performs), so continuation optimizes against the recorded scores.
+    Binning must agree with the booster's (same dataset or same edge
+    semantics, the ``train()`` contract).
+
+    Fault tolerance (ISSUE 10): with ``checkpoint_dir`` set, the run
+    snapshots its booster-so-far + iteration + host PRNG/bagging state
+    atomically every ``checkpoint_every`` iterations (plus once at the
+    end), serialization riding a background writer thread so device work
+    never waits on disk; ``resume="auto"`` restores the newest VALID
+    snapshot (a torn newest falls back to the previous one) and continues
+    through the same replay machinery — the resumed run's booster is
+    bit-identical to an uninterrupted one (the integer histogram path
+    makes that exact; tested by the chaos harness).  SIGTERM/SIGINT
+    during the loop requests one final checkpoint at the next iteration
+    boundary and returns cleanly with ``extras["preempted"]`` set.
 
     Not (yet) streamed: multiclass, lambdarank, dart/goss/rf, categorical
     features, and ``shard_rows`` (the multi-host composition — per-tile
@@ -2216,6 +2483,29 @@ def train_streamed(X, y: Optional[np.ndarray] = None, params: GBDTParams = None,
     if p.objective == "gamma" and (y <= 0).any():
         raise ValueError("objective 'gamma' requires strictly positive "
                          "labels")
+    if init_booster is not None:
+        # continuation guards, same raise-with-pointer shape as the other
+        # streamed rejects: the streamed path is single-output numerical
+        # gbdt, so only boosters of that shape can continue here
+        if init_booster.num_class != 1 or init_booster.objective == "multiclass":
+            raise ValueError(
+                "streamed continuation supports single-output boosters only "
+                f"(init_booster.num_class={init_booster.num_class}); use "
+                "train() for multiclass continuation (docs/out_of_core.md)")
+        if bool(getattr(init_booster, "average_output", False)):
+            raise ValueError(
+                "streamed training does not support rf-averaged boosters "
+                "(boosting_type='rf' is not streamed; docs/out_of_core.md)")
+        if getattr(init_booster, "categorical_features", None) \
+                or getattr(init_booster, "cat_bitset", None) is not None:
+            raise ValueError(
+                "streamed training does not support categorical features "
+                "yet, so a categorical booster cannot continue here "
+                "(docs/out_of_core.md)")
+        if int(init_booster.num_features) != F:
+            raise ValueError(
+                f"init_booster was trained on {init_booster.num_features} "
+                f"features, dataset has {F}")
 
     # ---- backend / quantization resolution (same contract as train())
     hist_cfg = _resolve_hist_backend()
@@ -2409,7 +2699,8 @@ def train_streamed(X, y: Optional[np.ndarray] = None, params: GBDTParams = None,
     if has_valid:
         Xv = np.asarray(valid[0], np.float32)
         yv = np.asarray(valid[1], np.float32)
-        binned_v = jnp.asarray(mapper.transform(Xv))
+        binned_v_h = mapper.transform(Xv)   # host copy: resume replay walks
+        binned_v = jnp.asarray(binned_v_h)
         scores_v = np.full((Xv.shape[0], 1), init_score, np.float32)
         walker = _cached(("walker", D, ()), lambda: make_binned_walker(D))
     best_metric = -np.inf if larger_better else np.inf
@@ -2423,15 +2714,130 @@ def train_streamed(X, y: Optional[np.ndarray] = None, params: GBDTParams = None,
         from ..models.gbdt import perfect_tree_children
         lc_const, rc_const = perfect_tree_children(D)
 
-    trees: Dict[str, List[np.ndarray]] = {k: [] for k in (
-        "left_child", "right_child", "split_feature", "threshold",
-        "threshold_bin", "split_gain", "internal_value", "internal_count",
-        "leaf_value", "leaf_count")}
+    trees: Dict[str, List[np.ndarray]] = {k: [] for k in _STREAM_TREE_KEYS}
     tree_weights: List[float] = []
     bag_on = p.bagging_freq > 0 and p.bagging_fraction < 1.0
     ff_on = p.feature_fraction < 1.0
     mask_h = np.ones((n,), bool)
     bag_mask = None
+
+    # ---- fault tolerance (ISSUE 10): periodic atomic checkpoints,
+    # resume-through-replay, preemption-aware shutdown
+    import contextlib
+    from ..io.checkpoint import CheckpointManager, check_resume_arg
+    from ..utils.resilience import PreemptionToken, preemption_scope
+    fingerprint = repr((sig, n, F, B, _content_fingerprint(cd.X)))
+    manager = None
+    if checkpoint_dir:
+        check_resume_arg(resume)
+        manager = CheckpointManager(checkpoint_dir,
+                                    site="lightgbm.train_streamed",
+                                    keep_last=checkpoint_keep_last)
+    n_init_trees = 0
+    start_iter = 0
+    resumed_from = -1
+    preempted = False
+
+    def _replay_range(t0: int, t1: int, valid_too: bool) -> None:
+        """Replay stored trees [t0, t1) into the running scores with the
+        EXACT float32 adds the live loop performs (host walks are pure
+        integer ops), so a resumed run's state is bit-identical to the
+        uninterrupted one's at the same iteration."""
+        if t1 <= t0:
+            return
+        from ..models.gbdt import children_depth_bound
+        depth_b = children_depth_bound(
+            np.stack(trees["left_child"][t0:t1]),
+            np.stack(trees["right_child"][t0:t1]))
+        for t in range(t0, t1):
+            sf_t, tb_t = trees["split_feature"][t], trees["threshold_bin"][t]
+            lch_t, rch_t = trees["left_child"][t], trees["right_child"][t]
+            lv_t = np.asarray(trees["leaf_value"][t], np.float32)
+            w_t = float(tree_weights[t])
+            leaf = _np_walk_tree(binned_h, sf_t, tb_t, lch_t, rch_t, depth_b)
+            contrib = lv_t[leaf]
+            if w_t != 1.0:
+                contrib = (contrib * np.float32(w_t)).astype(np.float32)
+            # in-place add (same ufunc the live loop's += runs) without
+            # rebinding the closed-over array
+            np.add(scores_h, contrib, out=scores_h)
+            if valid_too and has_valid:
+                leaf_v = _np_walk_tree(binned_v_h, sf_t, tb_t, lch_t, rch_t,
+                                       depth_b)
+                contrib_v = lv_t[leaf_v]
+                if w_t != 1.0:
+                    contrib_v = (contrib_v * np.float32(w_t)) \
+                        .astype(np.float32)
+                scores_v[:, 0] += contrib_v
+
+    def _save_ckpt(finished: bool, block: bool = False) -> None:
+        # snapshot on the training thread is just list copies + the PRNG
+        # state dict; stacking + device-independent serialization + the
+        # atomic publish all ride the manager's writer thread.  The one
+        # completed-iteration convention (shared with train()): trees
+        # grown beyond the warm-start prefix.
+        done = len(tree_weights) - n_init_trees
+        meta = _booster_ckpt_meta(done, n_init_trees, rng, best_metric,
+                                  best_iter, rounds_no_improve, evals,
+                                  init_score, fingerprint, finished,
+                                  p.num_iterations, "streamed_booster_v1")
+        manager.save(done, _booster_ckpt_arrays(trees, tree_weights,
+                                                bag_mask), meta,
+                     block=block)
+
+    resumed = False
+    if manager is not None and resume == "auto":
+        got = manager.load_latest()
+        if got is not None:
+            _, _arrs, _meta = got
+            if _meta.get("fingerprint") != fingerprint:
+                raise ValueError(_CKPT_FINGERPRINT_MISMATCH)
+            T_done = int(_arrs["split_feature"].shape[0])
+            for k in _STREAM_TREE_KEYS:
+                trees[k] = [np.asarray(_arrs[k][t]) for t in range(T_done)]
+            tree_weights[:] = [float(x) for x in _arrs["tree_weight"]]
+            n_init_trees = int(_meta.get("n_init_trees", 0))
+            rng.bit_generator.state = _meta["rng_state"]
+            if "bag_mask" in _arrs:
+                bag_mask = np.unpackbits(_arrs["bag_mask"])[:n].astype(bool)
+            best_metric = float(_meta["best_metric"])
+            best_iter = int(_meta["best_iter"])
+            rounds_no_improve = int(_meta["rounds_no_improve"])
+            evals[:] = [dict(e) for e in _meta.get("evals", [])]
+            _replay_range(0, n_init_trees, valid_too=False)
+            if float(_meta["init_score"]) != float(init_score):
+                scores_h += np.float32(float(_meta["init_score"])
+                                       - init_score)
+                init_score = float(_meta["init_score"])
+                if has_valid:
+                    scores_v[:] = init_score
+            _replay_range(n_init_trees, T_done, valid_too=True)
+            resumed_from = int(_meta["iteration"])
+            start_iter = resumed_from
+            if _meta.get("finished") and \
+                    p.num_iterations <= int(_meta.get("num_iterations",
+                                                      resumed_from)):
+                # the snapshot IS the finished run (early stop included):
+                # skip the loop and return its booster; a LARGER
+                # num_iterations target keeps training instead
+                start_iter = p.num_iterations
+            resumed = True
+    if not resumed and init_booster is not None:
+        # warm start (the substrate resume rides): replay the incoming
+        # booster's trees on the host, matching train()'s machinery
+        for t in range(init_booster.num_trees):
+            for k in _STREAM_TREE_KEYS:
+                trees[k].append(np.asarray(getattr(init_booster, k)[t]))
+            tree_weights.append(float(init_booster.tree_weight[t]))
+        n_init_trees = init_booster.num_trees
+        _replay_range(0, n_init_trees, valid_too=False)
+        if float(init_booster.init_score) != float(init_score):
+            # shift base score AFTER replay (train() order), so continued
+            # training optimizes against the recorded init_score
+            scores_h += np.float32(init_booster.init_score - init_score)
+            init_score = float(init_booster.init_score)
+            if has_valid:
+                scores_v[:] = init_score
 
     def _grad_pass():
         """First pass: gradients per tile (device), stored host-side, plus
@@ -2484,7 +2890,20 @@ def train_streamed(X, y: Optional[np.ndarray] = None, params: GBDTParams = None,
         _finish_stream(pf)
         return acc
 
-    for it in range(p.num_iterations):
+    # preemption scope only when checkpointing is on: without a durable
+    # snapshot to write, a SIGTERM should keep its default behaviour
+    _scope = preemption_scope() if manager is not None \
+        else contextlib.nullcontext(PreemptionToken())
+    _last_ckpt_iter = start_iter
+    _trees_at_loop_start = len(tree_weights)
+    with _scope as _token:
+      for it in range(start_iter, p.num_iterations):
+        if _token.requested:
+            # preempted: one final checkpoint at this iteration boundary,
+            # then a clean partial return the caller can resume from
+            _save_ckpt(finished=False, block=True)
+            preempted = True
+            break
         # ---- per-iteration host randomness (same semantics as train())
         feat_mask = np.ones((F,), bool)
         if ff_on:
@@ -2547,9 +2966,7 @@ def train_streamed(X, y: Optional[np.ndarray] = None, params: GBDTParams = None,
         lv_s = (leaf_value * lr).astype(np.float32)
         scores_h += lv_s[leaf_of_row]
         for k_name, arr in zip(
-                ("left_child", "right_child", "split_feature", "threshold",
-                 "threshold_bin", "split_gain", "internal_value",
-                 "internal_count", "leaf_value", "leaf_count"),
+                _STREAM_TREE_KEYS,
                 (lch, rch, sf, th, tb, sg, iv, ic, lv_s, leaf_count)):
             trees[k_name].append(np.asarray(arr))
         tree_weights.append(1.0)
@@ -2570,6 +2987,23 @@ def train_streamed(X, y: Optional[np.ndarray] = None, params: GBDTParams = None,
             if p.early_stopping_round > 0 and \
                     rounds_no_improve >= p.early_stopping_round:
                 break
+        if callbacks:
+            for cb in callbacks:
+                cb(it, evals[-1] if evals else None)
+        if manager is not None and checkpoint_every > 0 \
+                and it + 1 - _last_ckpt_iter >= checkpoint_every:
+            _save_ckpt(finished=False)
+            _last_ckpt_iter = it + 1
+
+    if manager is not None:
+        if not preempted and (len(tree_weights) > _trees_at_loop_start
+                              or not resumed):
+            # terminal snapshot (covers early stopping too): resume of a
+            # finished run restores the final booster instead of
+            # re-training the tail.  A finished-run restore that grew
+            # nothing skips the redundant re-save.
+            _save_ckpt(finished=True, block=True)
+        manager.close()
 
     if p.growth == "leaf":
         from ..models.gbdt import children_depth_bound
@@ -2599,6 +3033,10 @@ def train_streamed(X, y: Optional[np.ndarray] = None, params: GBDTParams = None,
         else 100.0,
         "quantized": float(use_quant),
     }
+    if manager is not None:
+        extras["preempted"] = float(preempted)
+        extras["resumed_from_iteration"] = float(resumed_from)
+        extras["checkpoint_saves"] = float(manager.saves_ok)
     for k, v in extras.items():
         _span.set_attribute(f"ooc.{k}", v)
     _span.set_attribute("rows", n)

@@ -88,6 +88,13 @@ def _shared_params(cls):
         ("num_grad_quant_bins", "quantization levels for grad/hess under "
          "quantized training (reference name; 4-128, reference default 4 — "
          "16 here holds every repo accuracy gate)", "int", 16),
+        ("checkpoint_dir", "directory for periodic atomic booster "
+         "checkpoints: the run snapshots booster + iteration + PRNG state "
+         "every checkpoint_every iterations and auto-resumes from the "
+         "newest valid snapshot (docs/RESILIENCE.md: training fault "
+         "tolerance)", "string", None),
+        ("checkpoint_every", "checkpoint cadence in boosting iterations "
+         "(0 = off; requires checkpoint_dir)", "int", 0),
     ]
     for name, doc, dtype, default in specs:
         setattr(cls, name, Param(name, doc, dtype, default))
@@ -169,25 +176,32 @@ class _LightGBMBase(Estimator, HasFeaturesCol, HasLabelCol, HasWeightCol):
         if ms:
             init_booster = GBDTBooster.from_string(ms)
         num_batches = self.get("num_batches") or 0
+        ckpt_kw = dict(checkpoint_dir=self.get("checkpoint_dir"),
+                       checkpoint_every=self.get("checkpoint_every"))
         if num_batches > 1:
             # sequential batch training with warm start between batches
-            # (reference LightGBMBase.scala:46-61)
+            # (reference LightGBMBase.scala:46-61).  Checkpoints would
+            # collide across batches sharing one dir, so the batch index
+            # namespaces them.
             bounds = np.linspace(0, len(y), num_batches + 1).astype(int)
             batch_params = dataclasses.replace(
                 params, num_iterations=max(1, params.num_iterations // num_batches))
             result = None
+            base_dir = ckpt_kw["checkpoint_dir"]
             for i in range(num_batches):
                 sl = slice(bounds[i], bounds[i + 1])
+                if base_dir:
+                    ckpt_kw["checkpoint_dir"] = f"{base_dir}/batch_{i:04d}"
                 result = gbdt_core.train(
                     X[sl], y[sl], batch_params,
                     sample_weight=None if w is None else w[sl],
                     valid=valid, init_booster=init_booster,
-                    shard_rows=self.get("shard_rows"))
+                    shard_rows=self.get("shard_rows"), **ckpt_kw)
                 init_booster = result.booster
             return result
         return gbdt_core.train(X, y, params, sample_weight=w, valid=valid,
                                group_ptr=group_ptr, init_booster=init_booster,
-                               shard_rows=self.get("shard_rows"))
+                               shard_rows=self.get("shard_rows"), **ckpt_kw)
 
 
 class _LightGBMModelBase(Model, HasFeaturesCol, HasPredictionCol):
@@ -338,7 +352,9 @@ class LightGBMRegressor(_LightGBMBase, HasPredictionCol):
         init_booster = GBDTBooster.from_string(ms) if ms else None
         result = gbdt_core.train(Xt, yt, params, sample_weight=wt, valid=valid,
                                  init_booster=init_booster,
-                                 shard_rows=self.get("shard_rows"))
+                                 shard_rows=self.get("shard_rows"),
+                                 checkpoint_dir=self.get("checkpoint_dir"),
+                                 checkpoint_every=self.get("checkpoint_every"))
         model = LightGBMRegressionModel()
         model.set("booster", result.booster)
         model.set("features_col", self.get("features_col"))

@@ -14,6 +14,12 @@ batch_stats.  Two backends:
   optimizer state keep their exact structure (a raw orbax restore without a
   target flattens them to lists, breaking the compiled step's structure
   match).
+
+All save paths publish atomically through ``io.checkpoint.atomic_write``
+(ISSUE 10; graft-lint RES003 enforces it): a crash mid-save can no longer
+tear the only copy.  :class:`TrainLoopCheckpointer` adds step-numbered
+periodic snapshots with keep-last-K retention and torn-newest fallback —
+the loop-level layer ``Trainer.train_stream`` rides for auto-resume.
 """
 from __future__ import annotations
 
@@ -22,6 +28,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..io.checkpoint import CheckpointManager, atomic_write
 from .trainer import TrainState
 
 
@@ -42,17 +49,20 @@ def save_train_state(state: TrainState, path: str,
             ckptr.save(target, _state_tree(state), force=True)
         _write_marker(path, "orbax")
         return
-    # NPZ arrays + pickled optimizer state: exact pytree fidelity
+    # NPZ arrays + pickled optimizer state: exact pytree fidelity.  Every
+    # file publishes via atomic_write (temp + os.replace): a crash
+    # mid-save leaves the previous copy intact instead of a torn npz that
+    # would strand the run it exists to protect.
     from flax import traverse_util
     os.makedirs(path, exist_ok=True)
     tree = jax.device_get({"params": state.params,
                            "batch_stats": state.batch_stats or {},
                            "step": np.asarray(state.step)})
     flat = traverse_util.flatten_dict({"t": tree}, sep="/")
-    np.savez(os.path.join(path, "state.npz"),
-             **{k: v for k, v in flat.items() if v is not None})
+    with atomic_write(os.path.join(path, "state.npz"), "wb") as f:
+        np.savez(f, **{k: v for k, v in flat.items() if v is not None})
     from ..utils import pickling
-    with open(os.path.join(path, "opt_state.pkl"), "wb") as f:
+    with atomic_write(os.path.join(path, "opt_state.pkl"), "wb") as f:
         pickling.dump(jax.device_get(state.opt_state), f)
     _write_marker(path, "npz")
 
@@ -61,7 +71,7 @@ def _write_marker(path: str, backend: str) -> None:
     """Record which backend wrote last: mtimes survive neither cp nor rsync
     reliably, so backend selection on load must not depend on them."""
     os.makedirs(path, exist_ok=True)
-    with open(os.path.join(path, "LATEST_BACKEND"), "w") as f:
+    with atomic_write(os.path.join(path, "LATEST_BACKEND"), "w") as f:
         f.write(backend)
 
 
@@ -130,3 +140,85 @@ def load_train_state(path: str, trainer=None,
     if trainer is not None:
         state = trainer.shard_state(state)
     return state
+
+
+# ---------------------------------------------------------------------------
+# loop-level periodic checkpointing (ISSUE 10) — step-numbered snapshots
+# ---------------------------------------------------------------------------
+
+class TrainLoopCheckpointer:
+    """Periodic TrainState snapshots for long-running training loops.
+
+    Rides :class:`~mmlspark_tpu.io.checkpoint.CheckpointManager`: each
+    snapshot is ONE atomically-published ``state_<step>.npz`` (flattened
+    params/batch_stats arrays, the step scalar, and the optimizer pytree
+    pickled into a uint8 payload lane), with keep-last-K retention, async
+    background writes, shared ``mmlspark_checkpoint_*`` telemetry, and
+    torn-newest fallback on load.
+
+    The ONE synchronous cost on the training thread is ``jax.device_get``
+    of the state inside :meth:`save` — unavoidable, because the trainer
+    donates the state buffers into the next ``train_step`` and a deferred
+    fetch would read freed memory.  Serialization and disk I/O then happen
+    on the writer thread.
+    """
+
+    _OPT_KEY = "__opt_state__"
+    _STEP_KEY = "__step__"
+
+    def __init__(self, directory: str, *, keep_last: int = 3,
+                 site: str = "parallel.trainer", registry=None):
+        self._mgr = CheckpointManager(directory, site=site,
+                                      keep_last=keep_last, prefix="state",
+                                      registry=registry)
+        self.site = site
+
+    @property
+    def manager(self) -> CheckpointManager:
+        return self._mgr
+
+    def save(self, state: TrainState, step: int, *,
+             meta: Optional[dict] = None, block: bool = False) -> None:
+        import jax
+        from flax import traverse_util
+        from ..utils import pickling
+        host = jax.device_get({"params": state.params,
+                               "batch_stats": state.batch_stats or {},
+                               "step": np.asarray(state.step)})
+        flat = traverse_util.flatten_dict(
+            {"t": {"params": host["params"],
+                   "batch_stats": host["batch_stats"]}}, sep="/")
+        arrays = {k: v for k, v in flat.items() if v is not None}
+        arrays[self._STEP_KEY] = np.asarray(host["step"])
+        arrays[self._OPT_KEY] = np.frombuffer(
+            pickling.dumps(jax.device_get(state.opt_state)), dtype=np.uint8)
+        self._mgr.save(step, arrays, dict(meta or {}, kind="train_state"),
+                       block=block)
+
+    def wait(self) -> None:
+        self._mgr.wait()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def load_latest(self, trainer=None) -> Optional[TrainState]:
+        """Newest valid snapshot as a TrainState (re-sharded onto
+        ``trainer``'s mesh when given), or None.  A torn newest snapshot
+        falls back to the previous one (CheckpointManager contract)."""
+        got = self._mgr.load_latest()
+        if got is None:
+            return None
+        _, arrays, _meta = got
+        from flax import traverse_util
+        from ..utils import pickling
+        flat = {k: v for k, v in arrays.items()
+                if k not in (self._OPT_KEY, self._STEP_KEY)}
+        tree = traverse_util.unflatten_dict(flat, sep="/").get("t", {})
+        opt_state = pickling.loads(arrays[self._OPT_KEY].tobytes())
+        state = TrainState(params=tree.get("params", {}),
+                           opt_state=opt_state,
+                           step=arrays[self._STEP_KEY],
+                           batch_stats=tree.get("batch_stats") or None)
+        if trainer is not None:
+            state = trainer.shard_state(state)
+        return state

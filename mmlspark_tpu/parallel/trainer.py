@@ -233,7 +233,11 @@ class Trainer:
         return out
 
     def train_stream(self, state: TrainState, batches,
-                     site: str = "parallel.trainer.stream"):
+                     site: str = "parallel.trainer.stream",
+                     checkpoint_dir: Optional[str] = None,
+                     checkpoint_every: int = 0,
+                     checkpoint_keep_last: int = 3,
+                     resume: str = "auto"):
         """Out-of-core training loop: iterate host batches through a
         double-buffered prefetcher — batch ``k+1`` is ``device_put`` (row
         sharded over the mesh's data axis, through the instrumented
@@ -243,28 +247,89 @@ class Trainer:
         into ``mmlspark_prefetch_wait_seconds`` /
         ``mmlspark_tile_compute_seconds`` under ``site``.
 
-        Returns ``(state, losses, overlap_stats)``.
+        Fault tolerance (ISSUE 10): with ``checkpoint_dir`` set, the state
+        snapshots atomically every ``checkpoint_every`` steps (plus once at
+        the end) through :class:`parallel.checkpoint.TrainLoopCheckpointer`,
+        and ``resume="auto"`` restores the newest valid snapshot and
+        fast-forwards ``batches`` past the steps it already holds — so the
+        SAME batch iterable must be passed again on resume (``resume=
+        "never"`` disables restoring).  SIGTERM/SIGINT during the loop
+        requests one final checkpoint at the next step boundary and
+        returns cleanly with ``stats["preempted"]`` set — a preempted
+        worker resumes instead of restarting.
+
+        Returns ``(state, losses, stats)`` — ``stats`` is the prefetcher's
+        overlap summary plus ``steps`` / ``resumed_from_step`` /
+        ``preempted`` / ``checkpoint_saves``.
         """
+        import contextlib
+        import itertools
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
         from ..io.chunked import TilePrefetcher
         from ..observability.compute import device_put as _obs_device_put
+        from ..utils.resilience import PreemptionToken, preemption_scope
         batch_sh = NamedSharding(self.mesh, P(AXIS_DATA))
+
+        ckpt = None
+        skip = 0
+        step0 = None
+        if checkpoint_dir:
+            from ..io.checkpoint import check_resume_arg
+            check_resume_arg(resume)
+            from .checkpoint import TrainLoopCheckpointer
+            ckpt = TrainLoopCheckpointer(checkpoint_dir,
+                                         keep_last=checkpoint_keep_last,
+                                         site=site)
+            step0 = int(jax.device_get(state.step))
+            if resume == "auto":
+                restored = ckpt.load_latest(trainer=self)
+                if restored is not None:
+                    skip = max(0, int(jax.device_get(restored.step)) - step0)
+                    state = restored
 
         def _load(batch):
             return jax.tree.map(
                 lambda leaf: _obs_device_put(leaf, batch_sh, site=site),
                 batch)
 
-        prefetcher = TilePrefetcher(batches, _load, site=site)
+        items = itertools.islice(iter(batches), skip, None) if skip \
+            else batches
+        prefetcher = TilePrefetcher(items, _load, site=site)
         losses = []
-        for batch in prefetcher:
-            state, loss = self.train_step(state, batch)
-            losses.append(loss)
+        steps_done = skip
+        preempted = False
+        scope = preemption_scope() if ckpt is not None \
+            else contextlib.nullcontext(PreemptionToken())
+        with scope as token:
+            for batch in prefetcher:
+                state, loss = self.train_step(state, batch)
+                losses.append(loss)
+                steps_done += 1
+                if ckpt is not None and token.requested:
+                    # preemption: final snapshot at this step boundary,
+                    # then a clean return the caller can resume from
+                    ckpt.save(state, step0 + steps_done, block=True)
+                    preempted = True
+                    break
+                if ckpt is not None and checkpoint_every > 0 \
+                        and steps_done % checkpoint_every == 0:
+                    ckpt.save(state, step0 + steps_done)
         # losses fetched AFTER the loop: per-step float() syncs would
         # serialize the very pipeline the prefetcher exists to overlap
         losses = [float(l) for l in losses]
-        return state, losses, prefetcher.overlap_stats()
+        stats = prefetcher.overlap_stats()
+        stats.update(steps=float(steps_done), resumed_from_step=float(skip),
+                     preempted=float(preempted))
+        if ckpt is not None:
+            if not preempted and (steps_done > skip or skip == 0):
+                # terminal snapshot: resume of a finished stream restores
+                # the final state instead of re-training the tail (a
+                # restore that ran zero steps skips the redundant re-save)
+                ckpt.save(state, step0 + steps_done, block=True)
+            stats["checkpoint_saves"] = float(ckpt.manager.saves_ok)
+            ckpt.close()
+        return state, losses, stats
 
 
 def _accepts_train(module) -> bool:

@@ -19,6 +19,7 @@ health-probe eviction and failover paths end to end.
 from __future__ import annotations
 
 import random
+import signal as _signal
 import threading
 import time
 from typing import Callable, Optional
@@ -27,7 +28,8 @@ from ..io.http import HTTPRequestData, HTTPResponseData
 from ..utils.resilience import FakeClock  # re-export for chaos suites
 
 __all__ = ["ChaosInjector", "LatencyInjector", "ConnectionErrorInjector",
-           "StatusStormInjector", "WorkerKiller", "FakeClock"]
+           "StatusStormInjector", "WorkerKiller", "FakeClock",
+           "FlakyLoadInjector", "PreemptionSimulator"]
 
 Transport = Callable[[HTTPRequestData, float], HTTPResponseData]
 
@@ -106,6 +108,70 @@ class StatusStormInjector(ChaosInjector):
         return HTTPResponseData(status_code=self.status,
                                 reason="injected storm", headers=headers,
                                 entity=b'{"error": "injected"}')
+
+
+class FlakyLoadInjector(ChaosInjector):
+    """Compute-plane twin of the HTTP injectors: wraps a prefetcher
+    ``load_fn`` and makes it raise a transient error on a seeded coin —
+    the tile-load failure class (flaky storage, wedged device relay) the
+    ``TilePrefetcher`` retry exists for.  ``max_injections`` bounds the
+    total faults so a high rate cannot exhaust a bounded retry budget by
+    pure bad luck; ``exc_factory`` picks the failure shape (default: a
+    transient ``ConnectionError``)."""
+
+    def __init__(self, seed: int = 0, rate: float = 1.0,
+                 max_injections: Optional[int] = None,
+                 exc_factory: Callable[[int], BaseException] = None):
+        super().__init__(seed, rate)
+        self.max_injections = max_injections
+        self.exc_factory = exc_factory or (
+            lambda k: ConnectionError(f"injected tile-load failure #{k}"))
+
+    def _fire(self) -> bool:
+        with self._lock:
+            self.calls += 1
+            if self.max_injections is not None \
+                    and self.injected >= self.max_injections:
+                return False
+            fire = self.rng.random() < self.rate
+            if fire:
+                self.injected += 1
+            return fire
+
+    def wrap(self, load_fn: Callable) -> Callable:
+        def flaky(item):
+            if self._fire():
+                raise self.exc_factory(self.injected)
+            return load_fn(item)
+        return flaky
+
+
+class PreemptionSimulator:
+    """Fires SIGTERM at a seeded boosting-iteration boundary — the
+    scheduled-preemption drill for checkpoint-aware training loops.
+
+    Shaped as a ``callbacks`` entry (``cb(iteration, eval)``, the contract
+    ``train``/``train_streamed`` already expose): install it and the
+    process receives SIGTERM at the END of the chosen iteration, exactly
+    where a cloud scheduler's grace window would land mid-run.  The
+    iteration is drawn from ``random.Random(seed)`` over [lo, hi), so the
+    kill point replays exactly.  ``fired`` makes schedule assertions
+    cheap; ``signum`` defaults to SIGTERM (``preemption_scope`` handles
+    SIGINT identically)."""
+
+    def __init__(self, seed: int = 0, lo: int = 0, hi: int = 1,
+                 signum: int = _signal.SIGTERM):
+        if hi <= lo:
+            raise ValueError(f"need hi > lo, got [{lo}, {hi})")
+        self.rng = random.Random(seed)
+        self.at_iteration = self.rng.randrange(lo, hi)
+        self.signum = signum
+        self.fired = False
+
+    def __call__(self, iteration: int, evals=None) -> None:
+        if not self.fired and iteration >= self.at_iteration:
+            self.fired = True
+            _signal.raise_signal(self.signum)
 
 
 class WorkerKiller:

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import collections
 import concurrent.futures
+import signal as _signal
 import threading
 import time
 from contextlib import contextmanager
@@ -380,6 +381,112 @@ class CircuitBreaker:
                     "consecutive_failures": self.consecutive_failures,
                     "failure_rate": round(rate, 4),
                     "rejected": self.rejected, "opened_count": self.opened_count}
+
+
+# ---------------------------------------------------------------------------
+# transient-vs-fatal classification for data-plane I/O
+# ---------------------------------------------------------------------------
+
+#: failure shapes a retry can plausibly outwait: flaky storage/NFS, a
+#: wedged device relay, a reset transfer.  ``OSError`` is deliberately in —
+#: EIO/EAGAIN from a shared filesystem is the canonical transient — with
+#: the *specifically hopeless* OSErrors carved out below.
+TRANSIENT_IO_ERRORS: Tuple[Type[BaseException], ...] = (
+    ConnectionError, TimeoutError, InterruptedError, OSError)
+
+#: failure shapes a retry can never fix: the path/permissions are wrong,
+#: not the weather.  Checked FIRST (they are OSError subclasses).
+FATAL_IO_ERRORS: Tuple[Type[BaseException], ...] = (
+    FileNotFoundError, PermissionError, IsADirectoryError,
+    NotADirectoryError)
+
+
+def is_transient_io(exc: BaseException) -> bool:
+    """Transient-vs-fatal classification for load/transfer failures
+    (prefetch retry, ISSUE 10): fatal subclasses win over the transient
+    families; anything outside both (TypeError, ValueError, ...) is a
+    bug, not weather — fatal."""
+    if isinstance(exc, FATAL_IO_ERRORS):
+        return False
+    return isinstance(exc, TRANSIENT_IO_ERRORS)
+
+
+# ---------------------------------------------------------------------------
+# preemption-aware shutdown
+# ---------------------------------------------------------------------------
+
+class PreemptionToken:
+    """Cooperative shutdown flag set by SIGTERM/SIGINT inside a
+    :func:`preemption_scope`.  Training loops poll :attr:`requested` at
+    iteration boundaries: a set token means "write a final checkpoint and
+    return cleanly" — the preempted worker resumes instead of restarting.
+    ``armed`` is False when the scope could not install handlers (not the
+    main thread); the token then never fires and the loop runs normally."""
+
+    __slots__ = ("requested", "signum", "count", "armed")
+
+    def __init__(self, armed: bool = False):
+        self.requested = False
+        self.signum: Optional[int] = None
+        self.count = 0
+        self.armed = armed
+
+    def fire(self, signum: int) -> None:
+        self.requested = True
+        self.signum = signum
+        self.count += 1
+
+
+@contextmanager
+def preemption_scope(signals: Tuple[int, ...] = None):
+    """Install SIGTERM/SIGINT handlers for the duration of a training
+    loop, yielding a :class:`PreemptionToken`.
+
+    First signal: sets the token (and books a ``preemption_requested``
+    ring event) — the loop finishes the current iteration, checkpoints,
+    and exits cleanly.  A SECOND SIGINT falls through to the previous
+    handler (normally ``KeyboardInterrupt``): a user hammering ctrl-C
+    still gets the hard stop.  Handlers are restored on exit.  Off the
+    main thread signal installation is impossible; the scope degrades to
+    an inert (``armed=False``) token rather than failing the run."""
+    if signals is None:
+        signals = (_signal.SIGTERM, _signal.SIGINT)
+    token = PreemptionToken()
+    previous = {}
+    try:
+        for signum in signals:
+            def _handler(sn, frame, _token=token, _signals=signals):
+                if _token.requested and sn == _signal.SIGINT:
+                    # second ctrl-C: the user wants a hard stop, not
+                    # patience — chain to the previous handler, honouring
+                    # SIG_DFL (reinstall + re-raise so the default
+                    # terminate semantics apply) and SIG_IGN
+                    prev = previous.get(sn)
+                    if callable(prev):
+                        prev(sn, frame)
+                    elif prev == _signal.SIG_DFL:
+                        _signal.signal(sn, prev)
+                        _signal.raise_signal(sn)
+                    return
+                _token.fire(sn)
+                from ..core.logging import log_event
+                log_event({"event": "preemption_requested",
+                           "signal": int(sn)})
+            previous[signum] = _signal.signal(signum, _handler)
+        token.armed = True
+    except ValueError:
+        # not the main thread: nothing was actually installed (the FIRST
+        # signal() call is what raises there), so there is nothing to
+        # restore — degrade to an inert token
+        previous = {}
+    try:
+        yield token
+    finally:
+        for signum, prev in previous.items():
+            try:
+                _signal.signal(signum, prev)
+            except ValueError:
+                pass
 
 
 # ---------------------------------------------------------------------------

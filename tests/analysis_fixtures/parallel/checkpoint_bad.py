@@ -1,0 +1,17 @@
+"""RES003 true-positive fixture: direct writes in a checkpoint module —
+a crash mid-write tears the only snapshot copy.  Parsed by graft-lint
+only — never imported or executed."""
+import json
+import numpy as np
+
+
+def save_snapshot(path, arrays, meta):
+    with open(path + "/state.npz", "wb") as f:       # RES003
+        np.savez(f, **arrays)
+    with open(path + "/meta.json", "w") as f:        # RES003
+        json.dump(meta, f)
+
+
+def append_journal(path, line):
+    with open(path, mode="a") as f:                  # RES003
+        f.write(line + "\n")

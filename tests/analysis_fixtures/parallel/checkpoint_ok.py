@@ -1,0 +1,21 @@
+"""RES003 near-miss fixture: reads are fine, and writes routed through
+the atomic writer publish with temp file + os.replace — zero findings.
+Parsed by graft-lint only."""
+import json
+import numpy as np
+
+from mmlspark_tpu.io.checkpoint import atomic_write
+
+
+def save_snapshot(path, arrays, meta):
+    with atomic_write(path + "/state.npz", "wb") as f:
+        np.savez(f, **arrays)
+    with atomic_write(path + "/meta.json", "w") as f:
+        json.dump(meta, f)
+
+
+def load_snapshot(path):
+    with open(path + "/meta.json") as f:             # default "r": read
+        meta = json.load(f)
+    with open(path + "/state.npz", "rb") as f:       # explicit read mode
+        return np.load(f), meta

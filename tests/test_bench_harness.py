@@ -160,6 +160,33 @@ def test_hist_ab_fused_markers_are_optional():
         bench.RESULT["extras"].clear()
 
 
+def test_ooc_ckpt_marker_folds_into_extras():
+    """ISSUE 10: the checkpoint-overhead arm rides the ooc child — its
+    OOC_CKPT marker must fold into extras (and stay optional, so an older
+    child without the arm still folds its OOC_AB)."""
+    proc = _child(
+        "print('OOC_AB 1000.0 1200.0 1.2 99.5 4')\n"
+        "print('OOC_CKPT 1160.0 3.33 2')\n")
+    got = bench._collect_multi(proc, ("OOC_AB", "OOC_CKPT"), idle=10, hard=20)
+    bench.RESULT["extras"].clear()
+    try:
+        assert bench._record_ooc(got)
+        ex = bench.RESULT["extras"]
+        assert ex["ooc_streamed_rows_per_sec"] == 1200.0
+        assert ex["ooc_ckpt_streamed_rows_per_sec"] == 1160.0
+        assert ex["ckpt_overhead_pct"] == 3.33
+        assert ex["ooc_ckpt_every"] == 2
+    finally:
+        bench.RESULT["extras"].clear()
+    # OOC_CKPT is optional: a child without the arm still folds OOC_AB
+    bench.RESULT["extras"].clear()
+    try:
+        assert bench._record_ooc({"OOC_AB": [1000.0, 1200.0, 1.2, 99.5, 4]})
+        assert "ckpt_overhead_pct" not in bench.RESULT["extras"]
+    finally:
+        bench.RESULT["extras"].clear()
+
+
 def test_runner_markers_fold_into_extras():
     """ISSUE 9: the runner A/B + decode markers must fold (and note a
     below-gate overhead ratio); the decode arm is additive like the fused

@@ -1,0 +1,659 @@
+"""Training fault tolerance (ISSUE 10): atomic checkpoints, streamed
+resume, prefetch retry, preemption-aware shutdown.
+
+Tier-1 here is deterministic — FakeClock drives every backoff, the chaos
+injectors are seeded, and the in-process preemption drill uses
+``signal.raise_signal`` at a seeded iteration.  The real SIGKILL
+crash->resume proof lives under the ``chaos`` marker (outside tier-1),
+and the headline contract it checks — a resumed ``train_streamed`` run is
+bit-identical to an uninterrupted one — is ALSO checked in-process here,
+because the integer histogram path makes it exactly decidable.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.io.checkpoint import (CheckpointManager, atomic_write,
+                                        snapshot_steps)
+from mmlspark_tpu.io.chunked import TilePrefetcher
+from mmlspark_tpu.observability.metrics import MetricsRegistry
+from mmlspark_tpu.testing.chaos import FlakyLoadInjector, PreemptionSimulator
+from mmlspark_tpu.utils.resilience import (Deadline, FakeClock,
+                                           deadline_scope, is_transient_io,
+                                           preemption_scope)
+
+BOOSTER_ARRAYS = ("split_feature", "threshold", "threshold_bin",
+                  "split_gain", "leaf_value", "leaf_count", "left_child",
+                  "right_child", "tree_weight")
+
+
+def _data(n=2500, f=8, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] + rng.normal(scale=0.3, size=n) > 0) \
+        .astype(np.float32)
+    return X, y
+
+
+def _assert_boosters_identical(a, b):
+    for k in BOOSTER_ARRAYS:
+        np.testing.assert_array_equal(getattr(a, k), getattr(b, k),
+                                      err_msg=f"booster arrays differ: {k}")
+
+
+# ---------------------------------------------------------------------------
+# atomic writer + snapshot manager
+# ---------------------------------------------------------------------------
+
+def test_atomic_write_publishes_or_leaves_previous(tmp_path):
+    p = str(tmp_path / "f.txt")
+    with atomic_write(p, "w") as f:
+        f.write("v1")
+    assert open(p).read() == "v1"
+    # a failing write leaves v1 intact and no temp debris
+    with pytest.raises(RuntimeError):
+        with atomic_write(p, "w") as f:
+            f.write("torn")
+            raise RuntimeError("crash mid-write")
+    assert open(p).read() == "v1"
+    assert os.listdir(tmp_path) == ["f.txt"]
+
+
+def test_manager_retention_and_cadence(tmp_path):
+    reg = MetricsRegistry()
+    clk = FakeClock()
+    m = CheckpointManager(str(tmp_path), site="t", keep_last=2,
+                          registry=reg, clock=clk)
+    for s in (1, 2, 3):
+        # block per save: rapid-fire unblocked saves would (by design)
+        # coalesce, which is its own test below
+        m.save(s, {"a": np.arange(s + 1)}, {"s": s}, block=True)
+    assert m.steps() == [2, 3]          # keep-last-K pruned step 1
+    assert m.saves_ok == 3
+    # last-success age rides the injected clock
+    fam = reg.family("mmlspark_checkpoint_last_success_age_seconds")
+    clk.advance(7.5)
+    assert fam.value(site="t") == pytest.approx(7.5)
+    # save/bytes/saves families all booked
+    assert reg.family("mmlspark_checkpoint_save_seconds") is not None
+    assert reg.family("mmlspark_checkpoint_bytes") is not None
+    got = m.load_latest()
+    assert got is not None and got[0] == 3
+    assert got[2]["s"] == 3
+    np.testing.assert_array_equal(got[1]["a"], np.arange(4))
+    m.close()
+
+
+def test_manager_torn_newest_falls_back(tmp_path):
+    reg = MetricsRegistry()
+    m = CheckpointManager(str(tmp_path), site="t", keep_last=3, registry=reg)
+    m.save(1, {"a": np.ones(3)}, {"s": 1})
+    m.save(2, {"a": np.full(3, 2.0)}, {"s": 2}, block=True)
+    with open(m.path_for(2), "r+b") as f:
+        f.truncate(8)                    # torn copy of the newest
+    step, arrays, meta = m.load_latest()
+    assert step == 1 and meta["s"] == 1
+    np.testing.assert_array_equal(arrays["a"], np.ones(3))
+    fam = reg.family("mmlspark_checkpoint_resumes_total")
+    assert fam.labels(site="t", result="torn_skipped").value == 1
+    assert fam.labels(site="t", result="ok").value == 1
+    m.close()
+
+
+def test_manager_save_failure_is_contained(tmp_path):
+    reg = MetricsRegistry()
+    m = CheckpointManager(str(tmp_path), site="t", registry=reg)
+
+    def boom():
+        raise RuntimeError("serialization failed")
+
+    m.save(1, boom, {}, block=True)
+    assert m.saves_failed == 1 and m.saves_ok == 0
+    assert isinstance(m.last_error, RuntimeError)
+    fam = reg.family("mmlspark_checkpoint_saves_total")
+    assert fam.labels(site="t", result="error").value == 1
+    # the manager still works after a failed save
+    m.save(2, {"a": np.zeros(1)}, {}, block=True)
+    assert m.saves_ok == 1
+    m.close()
+
+
+def test_manager_coalesces_pending_saves_under_slow_writer(tmp_path):
+    """Backpressure: a writer slower than the save cadence must not
+    accumulate snapshot payloads in host memory — only the newest pending
+    periodic snapshot survives; a blocking save drains everything."""
+    reg = MetricsRegistry()
+    m = CheckpointManager(str(tmp_path), site="t", keep_last=10,
+                          registry=reg)
+    gate = threading.Event()
+    orig = m._write_one
+
+    def slow_write(step, arrays, meta):
+        gate.wait(timeout=30)
+        orig(step, arrays, meta)
+
+    m._write_one = slow_write
+    m.save(1, {"a": np.zeros(1)}, {})    # enters the writer, blocks on gate
+    time.sleep(0.1)                      # let the worker take step 1
+    for s in (2, 3, 4, 5):
+        m.save(s, {"a": np.full(1, s)}, {})
+    gate.set()
+    m.save(6, {"a": np.full(1, 6.0)}, {}, block=True)
+    # 1 was in flight, 2-4 coalesced away, 5 and 6 landed
+    assert m.steps() == [1, 5, 6]
+    assert m.saves_coalesced == 3
+    fam = reg.family("mmlspark_checkpoint_saves_total")
+    assert fam.labels(site="t", result="coalesced").value == 3
+    m.close()
+
+
+def test_manager_close_unhooks_age_gauge(tmp_path):
+    """A finished run's last-success age must not keep climbing in the
+    shared registry — close() removes the gauge series (and a later save
+    re-registers it)."""
+    reg = MetricsRegistry()
+    clk = FakeClock()
+    m = CheckpointManager(str(tmp_path), site="t", registry=reg, clock=clk)
+    m.save(1, {"a": np.zeros(1)}, {}, block=True)
+    fam = reg.family("mmlspark_checkpoint_last_success_age_seconds")
+    assert ("t",) in dict(fam._snapshot())
+    m.close()
+    assert ("t",) not in dict(fam._snapshot()), \
+        "closed manager still exports its age series"
+    m.save(2, {"a": np.zeros(1)}, {}, block=True)   # re-open re-registers
+    assert ("t",) in dict(fam._snapshot())
+    m.close()
+
+
+def test_snapshot_steps_ignores_foreign_and_temp_files(tmp_path):
+    m = CheckpointManager(str(tmp_path), site="t")
+    m.save(5, {"a": np.zeros(1)}, {}, block=True)
+    (tmp_path / "ckpt_0000000006.npz.tmp-123").write_bytes(b"partial")
+    (tmp_path / "other.npz").write_bytes(b"x")
+    assert snapshot_steps(str(tmp_path)) == [5]
+    m.close()
+
+
+# ---------------------------------------------------------------------------
+# prefetch retry (FakeClock, seeded injector)
+# ---------------------------------------------------------------------------
+
+def test_transient_classification():
+    assert is_transient_io(ConnectionError())
+    assert is_transient_io(TimeoutError())
+    assert is_transient_io(OSError(5, "EIO"))
+    assert not is_transient_io(FileNotFoundError())      # fatal OSError
+    assert not is_transient_io(PermissionError())
+    assert not is_transient_io(ValueError("bug"))        # bug, not weather
+
+
+def test_prefetch_retries_transient_and_preserves_exactly_once():
+    clk = FakeClock()
+    reg = MetricsRegistry()
+    inj = FlakyLoadInjector(seed=7, rate=0.5, max_injections=5)
+    pf = TilePrefetcher(range(12), inj.wrap(lambda i: i * 10), site="s",
+                        clock=clk, registry=reg, sleep=clk.sleep)
+    assert list(pf) == [i * 10 for i in range(12)]       # order + no dupes
+    assert pf.retries_total == inj.injected >= 1
+    fam = reg.family("mmlspark_prefetch_retries_total")
+    assert fam.labels(site="s").value == pf.retries_total
+
+
+def test_prefetch_backoff_is_exponential_and_fatal_skips_retry():
+    clk = FakeClock()
+    sleeps = []
+
+    def sleep(s):
+        sleeps.append(s)
+        clk.sleep(s)
+
+    attempts = [0]
+
+    def load(i):
+        attempts[0] += 1
+        if attempts[0] <= 3:
+            raise ConnectionError("flaky")
+        return i
+
+    pf = TilePrefetcher([1], load, site="s", clock=clk,
+                        registry=MetricsRegistry(), retries=3,
+                        retry_backoff_s=0.1, retry_backoff_mult=2.0,
+                        sleep=sleep)
+    assert list(pf) == [1]
+    assert sleeps == pytest.approx([0.1, 0.2, 0.4])
+
+    # retries exhausted -> the transient propagates
+    pf2 = TilePrefetcher([1], (lambda i: (_ for _ in ()).throw(
+        ConnectionError("always"))), site="s", clock=clk,
+        registry=MetricsRegistry(), retries=2, retry_backoff_s=0.1,
+        sleep=clk.sleep)
+    with pytest.raises(ConnectionError):
+        list(pf2)
+    assert pf2.retries_total == 2
+
+    # fatal errors never burn a retry
+    pf3 = TilePrefetcher([1], (lambda i: (_ for _ in ()).throw(
+        ValueError("bug"))), site="s", clock=clk,
+        registry=MetricsRegistry(), sleep=clk.sleep)
+    with pytest.raises(ValueError):
+        list(pf3)
+    assert pf3.retries_total == 0
+
+
+def test_prefetch_retry_clips_to_ambient_deadline():
+    clk = FakeClock()
+    sleeps = []
+
+    def sleep(s):
+        sleeps.append(s)
+        clk.sleep(s)
+
+    attempts = [0]
+
+    def load(i):
+        attempts[0] += 1
+        if attempts[0] <= 2:
+            raise ConnectionError("flaky")
+        return i
+
+    with deadline_scope(Deadline(clk() + 0.15, clock=clk)):
+        pf = TilePrefetcher([1], load, site="s", clock=clk,
+                            registry=MetricsRegistry(), retries=5,
+                            retry_backoff_s=0.1, retry_backoff_mult=2.0,
+                            sleep=sleep)
+        assert list(pf) == [1]
+    # second backoff (nominal 0.2s) clipped to the 0.05s remaining budget
+    assert sleeps == pytest.approx([0.1, 0.05])
+
+    # an expired deadline turns the next transient failure terminal
+    with deadline_scope(Deadline(clk() - 1.0, clock=clk)):
+        pf2 = TilePrefetcher([1], (lambda i: (_ for _ in ()).throw(
+            ConnectionError("x"))), site="s", clock=clk,
+            registry=MetricsRegistry(), sleep=clk.sleep)
+        with pytest.raises(ConnectionError):
+            list(pf2)
+        assert pf2.retries_total == 0
+
+
+# ---------------------------------------------------------------------------
+# preemption scope + simulator
+# ---------------------------------------------------------------------------
+
+def test_preemption_scope_catches_sigterm_and_restores_handler():
+    before = signal.getsignal(signal.SIGTERM)
+    with preemption_scope() as token:
+        assert token.armed and not token.requested
+        signal.raise_signal(signal.SIGTERM)
+        assert token.requested and token.signum == signal.SIGTERM
+    assert signal.getsignal(signal.SIGTERM) is before
+    from mmlspark_tpu.core.logging import recent_events
+    assert any(e.get("event") == "preemption_requested"
+               for e in recent_events())
+
+
+def test_preemption_scope_degrades_off_main_thread():
+    out = {}
+
+    def run():
+        with preemption_scope() as token:
+            out["armed"] = token.armed
+
+    t = threading.Thread(target=run)
+    t.start()
+    t.join()
+    assert out["armed"] is False
+
+
+def test_preemption_simulator_is_seeded():
+    sims = [PreemptionSimulator(seed=5, lo=2, hi=9) for _ in range(3)]
+    assert len({s.at_iteration for s in sims}) == 1
+    assert 2 <= sims[0].at_iteration < 9
+
+
+# ---------------------------------------------------------------------------
+# train_streamed: warm start, checkpoint cadence, resume bit-exactness
+# ---------------------------------------------------------------------------
+
+def _stream_params(iters=6, **kw):
+    from mmlspark_tpu.lightgbm import GBDTParams
+    base = dict(num_iterations=iters, objective="binary", max_depth=3,
+                growth="level", seed=3)
+    base.update(kw)
+    return GBDTParams(**base)
+
+
+def test_train_streamed_init_booster_matches_single_run():
+    from mmlspark_tpu.lightgbm import train_streamed
+    X, y = _data()
+    r4 = train_streamed(X, y, _stream_params(4))
+    r44 = train_streamed(X, y, _stream_params(4), init_booster=r4.booster)
+    r8 = train_streamed(X, y, _stream_params(8))
+    _assert_boosters_identical(r44.booster, r8.booster)
+
+
+def _mini_booster(num_features=8, num_class=1, objective="binary",
+                  categorical_features=None, average_output=False):
+    """Structurally valid single-split boosters without any training —
+    the continuation guards fire on metadata alone."""
+    from mmlspark_tpu.models.gbdt import GBDTBooster, perfect_tree_children
+    lc, rc = perfect_tree_children(2)
+    T = max(1, num_class)
+    z3 = np.zeros((T, 3), np.float32)
+    return GBDTBooster(
+        np.zeros((T, 3), np.int32), z3, np.zeros((T, 3), np.int32), z3,
+        z3, z3, np.zeros((T, 4), np.float32), np.zeros((T, 4), np.float32),
+        np.ones((T,), np.float32), left_child=np.tile(lc, (T, 1)),
+        right_child=np.tile(rc, (T, 1)), max_depth=2,
+        num_features=num_features, objective=objective, num_class=num_class,
+        average_output=average_output,
+        categorical_features=list(categorical_features or []))
+
+
+def test_train_streamed_init_booster_guards():
+    from mmlspark_tpu.lightgbm import train_streamed
+    X, y = _data(n=600)
+    with pytest.raises(ValueError, match="single-output"):
+        train_streamed(X, y, _stream_params(2),
+                       init_booster=_mini_booster(num_class=3,
+                                                  objective="multiclass"))
+    with pytest.raises(ValueError, match="features"):
+        train_streamed(X, y, _stream_params(2),
+                       init_booster=_mini_booster(num_features=4))
+    with pytest.raises(ValueError, match="categorical"):
+        train_streamed(X, y, _stream_params(2),
+                       init_booster=_mini_booster(categorical_features=(1,)))
+    with pytest.raises(ValueError, match="rf-averaged"):
+        train_streamed(X, y, _stream_params(2),
+                       init_booster=_mini_booster(average_output=True))
+
+
+def test_train_streamed_preempt_resume_bit_identical(tmp_path):
+    from mmlspark_tpu.lightgbm import train_streamed
+    X, y = _data()
+    Xv, yv = X[:400].copy(), y[:400].copy()
+    p = _stream_params(6, feature_fraction=0.8, bagging_fraction=0.7,
+                       bagging_freq=2)
+    ra = train_streamed(X, y, p, valid=(Xv, yv))
+
+    d = str(tmp_path / "ck")
+    sim = PreemptionSimulator(seed=1, lo=2, hi=3)
+    rb1 = train_streamed(X, y, p, valid=(Xv, yv), checkpoint_dir=d,
+                         checkpoint_every=1, callbacks=[sim])
+    assert rb1.extras["preempted"] == 1.0
+    assert sim.fired and rb1.booster.num_trees == sim.at_iteration + 1
+    rb2 = train_streamed(X, y, p, valid=(Xv, yv), checkpoint_dir=d,
+                         checkpoint_every=1)
+    assert rb2.extras["resumed_from_iteration"] == sim.at_iteration + 1
+    assert rb2.extras["preempted"] == 0.0
+    _assert_boosters_identical(ra.booster, rb2.booster)
+    # eval trajectory identical too (same metric values, same iterations)
+    assert [e["iteration"] for e in ra.evals] == \
+        [e["iteration"] for e in rb2.evals]
+    np.testing.assert_array_equal(
+        [list(e.values())[0] for e in ra.evals],
+        [list(e.values())[0] for e in rb2.evals])
+
+
+def test_train_streamed_checkpoint_cadence_and_finished_restore(tmp_path):
+    from mmlspark_tpu.lightgbm import train_streamed
+    X, y = _data(n=1500)
+    d = str(tmp_path / "ck")
+    p = _stream_params(6)
+    r1 = train_streamed(X, y, p, checkpoint_dir=d, checkpoint_every=2)
+    # periodic saves at 2/4/6 + terminal overwrite of 6; keep-last-3 holds
+    assert snapshot_steps(d) == [2, 4, 6]
+    assert r1.extras["checkpoint_saves"] == 4.0
+    # resume of a finished run restores without training a single tree
+    r2 = train_streamed(X, y, p, checkpoint_dir=d, checkpoint_every=2)
+    assert r2.extras["resumed_from_iteration"] == 6.0
+    assert r2.extras["checkpoint_saves"] == 0.0
+    _assert_boosters_identical(r1.booster, r2.booster)
+
+
+def test_resume_arg_is_validated_everywhere(tmp_path):
+    """A typo'd resume value silently restarting from zero is the exact
+    loss the layer prevents — every driver rejects it loudly."""
+    from mmlspark_tpu.lightgbm import train, train_streamed
+    X, y = _data(n=600)
+    d = str(tmp_path / "ck")
+    with pytest.raises(ValueError, match="resume must be"):
+        train_streamed(X, y, _stream_params(2), checkpoint_dir=d,
+                       resume="always")
+    with pytest.raises(ValueError, match="resume must be"):
+        train(X, y, _stream_params(2), checkpoint_dir=d, resume="true")
+    tr, s0, batches = _trainer_fixture()
+    with pytest.raises(ValueError, match="resume must be"):
+        tr.train_stream(s0, batches(), checkpoint_dir=d, resume=" auto")
+
+
+def test_train_streamed_fingerprint_mismatch_raises(tmp_path):
+    from mmlspark_tpu.lightgbm import train_streamed
+    X, y = _data(n=1500)
+    d = str(tmp_path / "ck")
+    train_streamed(X, y, _stream_params(2), checkpoint_dir=d,
+                   checkpoint_every=1)
+    X2 = X.copy()
+    X2[:100] += 1.0                       # different data, same shape
+    with pytest.raises(ValueError, match="fingerprint"):
+        train_streamed(X2, y, _stream_params(2), checkpoint_dir=d)
+    # resume='never' ignores the stale snapshot and trains fresh
+    r = train_streamed(X2, y, _stream_params(2), checkpoint_dir=d,
+                       resume="never")
+    assert r.booster.num_trees == 2
+
+
+def test_train_streamed_leafwise_resume_bit_identical(tmp_path):
+    from mmlspark_tpu.lightgbm import GBDTParams, train_streamed
+    X, y = _data()
+    p = GBDTParams(num_iterations=5, objective="regression", num_leaves=8,
+                   seed=11)
+    ra = train_streamed(X, X[:, 0].astype(np.float32), p)
+    d = str(tmp_path / "ck")
+    sim = PreemptionSimulator(seed=2, lo=1, hi=4)
+    rb1 = train_streamed(X, X[:, 0].astype(np.float32), p,
+                         checkpoint_dir=d, checkpoint_every=1,
+                         callbacks=[sim])
+    assert rb1.extras["preempted"] == 1.0
+    rb2 = train_streamed(X, X[:, 0].astype(np.float32), p,
+                         checkpoint_dir=d, checkpoint_every=1)
+    _assert_boosters_identical(ra.booster, rb2.booster)
+
+
+# ---------------------------------------------------------------------------
+# train(): resume through the warm-start machinery
+# ---------------------------------------------------------------------------
+
+def test_train_preempt_resume_matches_uninterrupted(tmp_path):
+    from mmlspark_tpu.lightgbm import GBDTParams, train
+    X, y = _data()
+    Xv, yv = X[:400].copy(), y[:400].copy()
+    p = GBDTParams(num_iterations=8, objective="binary", num_leaves=15,
+                   feature_fraction=0.8, bagging_fraction=0.7,
+                   bagging_freq=2, seed=3)
+    ra = train(X, y, p, valid=(Xv, yv))
+    d = str(tmp_path / "ck")
+    sim = PreemptionSimulator(seed=1, lo=3, hi=4)
+    rb1 = train(X, y, p, valid=(Xv, yv), checkpoint_dir=d,
+                checkpoint_every=2, callbacks=[sim])
+    assert rb1.extras["preempted"] == 1.0
+    assert rb1.booster.num_trees == sim.at_iteration + 1
+    rb2 = train(X, y, p, valid=(Xv, yv), checkpoint_dir=d,
+                checkpoint_every=2)
+    assert rb2.extras["resumed_from_iteration"] == sim.at_iteration + 1
+    assert rb2.booster.num_trees == 8
+    # tree STRUCTURE is identical; leaf values replay through the warm-
+    # start walker (device adds in a different dispatch grouping), so the
+    # committed tolerance is tight-but-not-bitwise
+    for k in ("split_feature", "threshold_bin", "left_child", "right_child",
+              "leaf_count"):
+        np.testing.assert_array_equal(getattr(ra.booster, k),
+                                      getattr(rb2.booster, k))
+    np.testing.assert_allclose(ra.booster.leaf_value, rb2.booster.leaf_value,
+                               rtol=0, atol=1e-6)
+    np.testing.assert_allclose([list(e.values())[0] for e in ra.evals],
+                               [list(e.values())[0] for e in rb2.evals],
+                               rtol=1e-6)
+
+
+def test_train_early_stop_records_exact_iteration_count(tmp_path):
+    """Early stopping breaks the loop before the counter advances — the
+    snapshot must still record the TREE-count-derived completed
+    iterations, so a later resume toward a larger target trains exactly
+    the remainder (no over-training off-by-one)."""
+    from mmlspark_tpu.lightgbm import GBDTParams, train
+    X, y = _data(n=1500)
+    yv_noise = np.random.default_rng(9).integers(0, 2, 300) \
+        .astype(np.float64)
+    d = str(tmp_path / "ck")
+    p_es = GBDTParams(num_iterations=20, objective="binary", num_leaves=7,
+                      seed=3, early_stopping_round=1)
+    r1 = train(X, y, p_es, valid=(X[:300], yv_noise), checkpoint_dir=d,
+               checkpoint_every=50)
+    stopped = r1.booster.num_trees
+    assert stopped < 20, "noise valid labels should early-stop the run"
+    assert snapshot_steps(d) == [stopped]
+    # same ask again: the finished (early-stopped) run restores as-is
+    r_same = train(X, y, p_es, valid=(X[:300], yv_noise), checkpoint_dir=d,
+                   checkpoint_every=50)
+    assert r_same.booster.num_trees == stopped
+    # a target beyond the ORIGINAL ask continues with exactly the
+    # remainder from the recorded (tree-count) iteration — the loop-
+    # counter convention would over-train by one here
+    p_more = GBDTParams(num_iterations=23, objective="binary",
+                        num_leaves=7, seed=3)
+    r2 = train(X, y, p_more, checkpoint_dir=d, checkpoint_every=50)
+    assert r2.extras["resumed_from_iteration"] == stopped
+    assert r2.booster.num_trees == 23
+
+
+# ---------------------------------------------------------------------------
+# Trainer.train_stream: loop-level save + auto-resume
+# ---------------------------------------------------------------------------
+
+def _trainer_fixture():
+    import jax
+    import optax
+    from flax import linen as nn
+    from mmlspark_tpu.parallel.trainer import Trainer, softmax_cross_entropy
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4)(nn.relu(nn.Dense(16)(x)))
+
+    def batches():
+        r = np.random.default_rng(42)
+        for _ in range(10):
+            x = r.normal(size=(16, 8)).astype(np.float32)
+            yield {"x": x, "y": (x[:, 0] > 0).astype(np.int32)}
+
+    tr = Trainer(MLP(), optax.adam(1e-2), softmax_cross_entropy)
+    state = tr.init_state(jax.random.PRNGKey(0), next(iter(batches())))
+    return tr, state, batches
+
+
+def test_trainer_stream_resume_step_count_and_losses(tmp_path):
+    import itertools
+    tr, s0, batches = _trainer_fixture()
+    _, loss_full, _ = tr.train_stream(s0, batches())
+
+    tr2, s0b, _ = _trainer_fixture()
+    d = str(tmp_path / "ck")
+    _, _, st1 = tr2.train_stream(s0b, itertools.islice(batches(), 4),
+                                 checkpoint_dir=d, checkpoint_every=2)
+    assert st1["steps"] == 4.0 and st1["checkpoint_saves"] >= 2
+
+    tr3, s0c, _ = _trainer_fixture()
+    state, loss_tail, st2 = tr3.train_stream(s0c, batches(),
+                                             checkpoint_dir=d,
+                                             checkpoint_every=2)
+    import jax
+    assert st2["resumed_from_step"] == 4.0 and st2["steps"] == 10.0
+    assert int(jax.device_get(state.step)) == 10
+    # committed tolerance: the state round-trips through npz + re-put
+    np.testing.assert_allclose(loss_full[4:], loss_tail, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_trainer_checkpointer_torn_newest_falls_back(tmp_path):
+    from mmlspark_tpu.parallel.checkpoint import TrainLoopCheckpointer
+    tr, s0, _ = _trainer_fixture()
+    ck = TrainLoopCheckpointer(str(tmp_path), site="t",
+                               registry=MetricsRegistry())
+    ck.save(s0, 1, block=True)
+    ck.save(s0, 2, block=True)
+    with open(ck.manager.path_for(2), "r+b") as f:
+        f.truncate(16)
+    restored = ck.load_latest()
+    assert restored is not None
+    assert int(np.asarray(restored.step)) == int(np.asarray(
+        __import__("jax").device_get(s0.step)))
+    ck.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos tier: a real SIGKILL mid-train_streamed, then resume
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_sigkill_mid_stream_resume_bit_identical(tmp_path):
+    """The acceptance drill: a child process is SIGKILLed (no grace, no
+    handler — the crash class atomic publication exists for) mid-
+    ``train_streamed``; the resumed run must produce a booster
+    bit-identical to an uninterrupted one."""
+    from mmlspark_tpu.lightgbm import train_streamed
+    ckdir = str(tmp_path / "ck")
+    marker = str(tmp_path / "iters.log")
+    prog = textwrap.dedent(f"""
+        import numpy as np
+        from mmlspark_tpu.lightgbm import GBDTParams, train_streamed
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(2500, 8)).astype(np.float32)
+        y = (X[:, 0] + 0.5 * X[:, 1]
+             + rng.normal(scale=0.3, size=2500) > 0).astype(np.float32)
+        p = GBDTParams(num_iterations=10, objective="binary", max_depth=3,
+                       growth="level", seed=3)
+        def cb(it, ev):
+            with open({marker!r}, "a") as f:
+                f.write(str(it) + chr(10))
+        train_streamed(X, y, p, checkpoint_dir={ckdir!r},
+                       checkpoint_every=1, callbacks=[cb])
+    """)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen([sys.executable, "-c", prog], env=env,
+                            cwd=os.path.dirname(os.path.dirname(
+                                os.path.abspath(__file__))))
+    try:
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            if os.path.exists(marker) and \
+                    len(open(marker).read().splitlines()) >= 2:
+                break
+            if proc.poll() is not None:
+                break
+            time.sleep(0.05)
+        if proc.poll() is None:
+            proc.kill()                  # SIGKILL: no cleanup, no handler
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert snapshot_steps(ckdir), "child died before any checkpoint landed"
+
+    X, y = _data()
+    p = _stream_params(10)
+    resumed = train_streamed(X, y, p, checkpoint_dir=ckdir,
+                             checkpoint_every=1)
+    assert resumed.extras["resumed_from_iteration"] >= 1
+    uninterrupted = train_streamed(X, y, p)
+    _assert_boosters_identical(uninterrupted.booster, resumed.booster)

@@ -12,6 +12,7 @@ import os
 import pytest
 
 from mmlspark_tpu.analysis import (AnalysisEngine, BaselineEntry, Finding,
+                                   CheckpointAtomicityChecker,
                                    HotPathChecker, LockDisciplineChecker,
                                    ResilienceCoverageChecker,
                                    StageContractChecker, TracerSafetyChecker,
@@ -44,6 +45,8 @@ PAIRS = [
      "cognitive/res_ok.py", {"RES001"}),
     (UndeadlinedRetryChecker, "cognitive/res_deadline_bad.py",
      "cognitive/res_deadline_ok.py", {"RES002"}),
+    (CheckpointAtomicityChecker, "parallel/checkpoint_bad.py",
+     "parallel/checkpoint_ok.py", {"RES003"}),
     (LockDisciplineChecker, "observability/lck_bad.py",
      "observability/lck_ok.py", {"LCK001", "LCK002", "LCK003"}),
     (HotPathChecker, "serving/hot_bad.py", "serving/hot_ok.py",

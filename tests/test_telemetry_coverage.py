@@ -239,6 +239,57 @@ def test_prefetch_seam_books_overlap_histograms():
         trainer_mod.Trainer.train_stream)
 
 
+def test_checkpoint_surface_books_metrics():
+    """ISSUE 10 coverage: the fault-tolerance layer's save/resume/retry
+    sites must book their metric families — a checkpointing run whose
+    last-success age silently stops updating is an unpageable outage.
+    Source-level like the stage sweep (the writer must book save latency/
+    bytes/outcomes, failed saves must book ``result="error"``, resume
+    outcomes must ride ``book_resume``, the prefetch retry loop must tick
+    its counter), plus a live check that construction registers every
+    family, and that all three training drivers actually ride the
+    instrumented managers."""
+    import tempfile
+
+    from mmlspark_tpu.io import checkpoint as ckpt_mod
+    from mmlspark_tpu.io import chunked
+    from mmlspark_tpu.lightgbm import core as gbdt_core
+    from mmlspark_tpu.observability import MetricsRegistry
+    from mmlspark_tpu.parallel import trainer as trainer_mod
+
+    write_src = inspect.getsource(ckpt_mod.CheckpointManager._write_one)
+    for needle in ('_m["save_seconds"]', '_m["bytes"]', '_m["saves"]'):
+        assert needle in write_src, f"_write_one lost {needle}"
+    writer_src = inspect.getsource(ckpt_mod.CheckpointManager._writer)
+    assert 'result="error"' in writer_src, "failed saves no longer booked"
+    assert "book_resume" in inspect.getsource(
+        ckpt_mod.CheckpointManager.load_latest), \
+        "resume outcomes no longer booked"
+
+    retry_src = inspect.getsource(chunked.TilePrefetcher._load_with_retry)
+    assert "_c_retry.inc" in retry_src, "retry loop lost its counter"
+    init_src = inspect.getsource(chunked.TilePrefetcher.__init__)
+    assert '"mmlspark_prefetch_retries_total"' in init_src
+
+    reg = MetricsRegistry()
+    with tempfile.TemporaryDirectory() as d:
+        m = ckpt_mod.CheckpointManager(d, site="sweep", registry=reg)
+        m.close()
+    for family in ("mmlspark_checkpoint_save_seconds",
+                   "mmlspark_checkpoint_bytes",
+                   "mmlspark_checkpoint_saves_total",
+                   "mmlspark_checkpoint_resumes_total",
+                   "mmlspark_checkpoint_last_success_age_seconds"):
+        assert reg.family(family) is not None, \
+            f"CheckpointManager no longer registers {family}"
+
+    # all three long-running training drivers ride the instrumented layer
+    assert "CheckpointManager" in inspect.getsource(gbdt_core.train)
+    assert "CheckpointManager" in inspect.getsource(gbdt_core.train_streamed)
+    assert "TrainLoopCheckpointer" in inspect.getsource(
+        trainer_mod.Trainer.train_stream)
+
+
 def test_runner_books_front_and_decode_metrics():
     """ISSUE 9 coverage: the ModelRunner is the one copy of the pad/bucket/
     dispatch glue, so its metric seam is the only place batch-vs-serving-vs-
