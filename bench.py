@@ -59,6 +59,59 @@ def _emit() -> None:
     print(json.dumps(RESULT), flush=True)
 
 
+def _metrics_snapshot_json(max_bytes: int = 4096) -> str:
+    """Bounded, redact-free ``/metrics``-style snapshot of this process's
+    registry (ISSUE 11): each phase child prints it as a ``PHASE_METRICS``
+    marker so bench regressions can be diagnosed from counters instead of
+    reruns.  Redact-free by construction: exemplars (trace ids) and help
+    text are stripped; when the JSON overflows ``max_bytes`` the largest
+    families are dropped and NAMED — truncation must be attributable,
+    never silent."""
+    import json as _json
+    from mmlspark_tpu.observability import get_registry
+    body = get_registry().to_dict()
+    for fam in body.values():
+        fam.pop("help", None)
+        for s in fam.get("samples", ()):
+            s.pop("exemplars", None)
+    dropped = []
+    while True:
+        payload = dict(body)
+        if dropped:
+            payload["_dropped_families"] = dropped
+        out = _json.dumps(payload, separators=(",", ":"), default=str)
+        if len(out) <= max_bytes or not body:
+            return out
+        largest = max(body,
+                      key=lambda k: len(_json.dumps(body[k], default=str)))
+        body.pop(largest)
+        dropped.append(largest)
+
+
+def _emit_phase_metrics() -> None:
+    """Print the post-phase registry snapshot marker (child side)."""
+    try:
+        print(f"PHASE_METRICS {_metrics_snapshot_json()}", flush=True)
+    except Exception as e:  # noqa: BLE001 — telemetry must not kill a phase
+        _log(f"[bench] phase metrics snapshot failed: {e}")
+
+
+def _record_phase_metrics(phase: str, got: dict) -> bool:
+    """Fold a child's ``PHASE_METRICS`` snapshot into the run artifact's
+    extras; absent or garbled markers fold nothing (False)."""
+    raw = got.get("PHASE_METRICS")
+    if not isinstance(raw, str) or not raw:
+        return False
+    try:
+        snap = json.loads(raw)
+    except ValueError:
+        return False
+    if not isinstance(snap, dict):
+        return False
+    RESULT["extras"].setdefault("phase_metrics", {})[phase] = snap
+    return True
+
+
 def _log(msg) -> None:
     print(msg, file=sys.stderr, flush=True)
 
@@ -796,8 +849,12 @@ def _collect_multi(proc: subprocess.Popen, markers, idle: float,
 
 
 def _collect(proc: subprocess.Popen, marker: str, idle: float,
-             hard: float = 1500.0):
-    got = _collect_multi(proc, (marker,), idle, hard)
+             hard: float = 1500.0, phase: str = ""):
+    # the PHASE_METRICS marker rides every phase child (ISSUE 11); folding
+    # happens here so single-marker call sites get it for free
+    got = _collect_multi(proc, (marker, "PHASE_METRICS"), idle, hard)
+    if phase:
+        _record_phase_metrics(phase, got)
     val = got.get(marker)
     if val is None:
         _log(f"[bench] phase {marker} ended rc={proc.returncode} without result")
@@ -940,8 +997,10 @@ def main() -> None:
     # Phase 1 — CPU-executor baseline, FIRST and STRICTLY ALONE (VERDICT r4
     # weak #1: concurrency halves the denominator on a 1-core host).  It is
     # host-only, so a sick relay cannot cost us the denominator either.
-    got = _collect_multi(_spawn("cpu", _cpu_env()), ("CPU_RPS", "CPU_HOST"),
+    got = _collect_multi(_spawn("cpu", _cpu_env()),
+                         ("CPU_RPS", "CPU_HOST", "PHASE_METRICS"),
                          idle=350, hard=700)
+    _record_phase_metrics("cpu", got)
     cpu_rps = 0.0
     if got.get("CPU_RPS"):
         cpu_rps = got["CPU_RPS"][0]
@@ -985,20 +1044,23 @@ def _run_measured_phases(tpu_ok: bool, cpu_rps: float) -> None:
         # GBDT_UTIL marker rides along: cost-analysis bytes -> achievable-
         # utilization %, the tile-size tuning denominator).
         got = _collect_multi(_spawn("gbdt", _tpu_env()),
-                             ("GBDT_RPS", "GBDT_UTIL"), idle=600, hard=1200)
+                             ("GBDT_RPS", "GBDT_UTIL", "PHASE_METRICS"),
+                             idle=600, hard=1200)
         if got.get("GBDT_RPS") is None:
             # degraded fallback: quarter-size, same trainer
             _note("gbdt", "1M run stalled/overran; retried quarter-size")
             got = _collect_multi(_spawn("gbdt", _tpu_env(),
                                         ["--n", "250000", "--iters_b", "10",
                                          "--reps", "1"]),
-                                 ("GBDT_RPS", "GBDT_UTIL"), idle=300,
+                                 ("GBDT_RPS", "GBDT_UTIL",
+                                  "PHASE_METRICS"), idle=300,
                                  hard=500)
             if got.get("GBDT_RPS"):
                 RESULT["extras"]["note"] = (
                     "measured at 250k x 200 (1M run exceeded its deadline); "
                     "rows/sec is the steady-state marginal rate, ~linear in rows")
         _record_gbdt_util(got)
+        _record_phase_metrics("gbdt", got)
         if got.get("GBDT_RPS"):
             tpu_rps = got["GBDT_RPS"][0]
             RESULT["value"] = round(tpu_rps, 1)
@@ -1011,8 +1073,9 @@ def _run_measured_phases(tpu_ok: bool, cpu_rps: float) -> None:
         # Phase 2c — out-of-core streamed-vs-in-memory A/B on the chip
         # (overhead bound at a fits-in-HBM shape + prefetch overlap %).
         got = _collect_multi(_spawn("ooc", _tpu_env()),
-                             ("OOC_AB", "OOC_CKPT"),
+                             ("OOC_AB", "OOC_CKPT", "PHASE_METRICS"),
                              idle=600, hard=1600)
+        _record_phase_metrics("ooc", got)
         if not _record_ooc(got):
             _note("ooc", "TPU streamed A/B stalled/failed; CPU proxy will run")
         _emit()
@@ -1021,9 +1084,11 @@ def _run_measured_phases(tpu_ok: bool, cpu_rps: float) -> None:
         # shape (quantized-gradient acceptance: packed >= 1.5x the
         # 3-channel f32 build; ISSUE 5).
         got = _collect_multi(_spawn("hist_ab", _tpu_env()),
-                             ("HIST_AB_RATES", "HIST_AB_MODE", "HIST_AB_FUSED"),
+                             ("HIST_AB_RATES", "HIST_AB_MODE",
+                              "HIST_AB_FUSED", "PHASE_METRICS"),
                              idle=600,
                              hard=1100)
+        _record_phase_metrics("hist_ab", got)
         if not _record_hist_ab(got):
             _note("hist_ab", "TPU A/B stalled/failed; CPU proxy will run")
         _emit()
@@ -1034,14 +1099,14 @@ def _run_measured_phases(tpu_ok: bool, cpu_rps: float) -> None:
         # mid-compile, number lost).  A completed compile lands in the
         # persistent cache, so a second attempt is measurement-only.
         got = _collect(_spawn("ranker", _tpu_env()), "RANKER_RPS", idle=480,
-                       hard=900)
+                       hard=900, phase="ranker")
         if got is None:
             _note("ranker", "attempt 1 stalled (likely compile); retried")
             # the retry gets a LARGER idle window: if attempt 1 died inside
             # a silent fresh compile, a smaller window would deterministically
             # kill the retry mid-compile too (the relay-wedge scenario)
             got = _collect(_spawn("ranker", _tpu_env()), "RANKER_RPS",
-                           idle=700, hard=1000)
+                           idle=700, hard=1000, phase="ranker")
         if got:
             RESULT["extras"]["lambdarank_train_rows_per_sec_200kx50"] = \
                 round(got[0], 1)
@@ -1051,11 +1116,11 @@ def _run_measured_phases(tpu_ok: bool, cpu_rps: float) -> None:
 
         # Phase 4 — ResNet-50 featurize (same retry discipline).
         got = _collect(_spawn("resnet", _tpu_env()), "IMAGES_SEC", idle=420,
-                       hard=800)
+                       hard=800, phase="resnet")
         if got is None:
             _note("resnet", "attempt 1 stalled (likely compile); retried")
             got = _collect(_spawn("resnet", _tpu_env()), "IMAGES_SEC",
-                           idle=600, hard=900)
+                           idle=600, hard=900, phase="resnet")
         if got:
             RESULT["extras"]["resnet50_featurize_images_per_sec_per_chip"] = \
                 round(got[0], 1)
@@ -1069,8 +1134,9 @@ def _run_measured_phases(tpu_ok: bool, cpu_rps: float) -> None:
         # chip (ISSUE 9: runner >= 0.9x the legacy glue it replaced, plus
         # the generative-serving number).
         got = _collect_multi(_spawn("runner", _tpu_env()),
-                             ("RUNNER_AB", "RUNNER_DECODE"),
+                             ("RUNNER_AB", "RUNNER_DECODE", "PHASE_METRICS"),
                              idle=600, hard=1100)
+        _record_phase_metrics("runner", got)
         if not _record_runner(got):
             _note("runner", "TPU runner A/B stalled/failed; CPU proxy will run")
         _emit()
@@ -1080,8 +1146,10 @@ def _run_measured_phases(tpu_ok: bool, cpu_rps: float) -> None:
     # attribution number for the quantized pipeline.
     if "hist_ab_packed_speedup" not in RESULT["extras"]:
         got = _collect_multi(_spawn("hist_ab", _cpu_env(), ["--proxy", "1"]),
-                             ("HIST_AB_RATES", "HIST_AB_MODE", "HIST_AB_FUSED"),
+                             ("HIST_AB_RATES", "HIST_AB_MODE",
+                              "HIST_AB_FUSED", "PHASE_METRICS"),
                              idle=300, hard=600)
+        _record_phase_metrics("hist_ab", got)
         if not _record_hist_ab(got):
             _note("hist_ab", "CPU proxy A/B also failed; no packed number")
         _emit()
@@ -1091,8 +1159,9 @@ def _run_measured_phases(tpu_ok: bool, cpu_rps: float) -> None:
     # overhead bound + prefetch-overlap number for the chunked pipeline.
     if "ooc_streamed_vs_inmemory" not in RESULT["extras"]:
         got = _collect_multi(_spawn("ooc", _cpu_env()),
-                             ("OOC_AB", "OOC_CKPT"),
+                             ("OOC_AB", "OOC_CKPT", "PHASE_METRICS"),
                              idle=500, hard=1300)
+        _record_phase_metrics("ooc", got)
         if not _record_ooc(got):
             _note("ooc", "CPU proxy streamed A/B also failed; no ooc number")
         _emit()
@@ -1101,16 +1170,19 @@ def _run_measured_phases(tpu_ok: bool, cpu_rps: float) -> None:
     # always carries the runner-overhead ratio + a decode tokens/sec number.
     if "runner_vs_legacy" not in RESULT["extras"]:
         got = _collect_multi(_spawn("runner", _cpu_env(), ["--proxy", "1"]),
-                             ("RUNNER_AB", "RUNNER_DECODE"),
+                             ("RUNNER_AB", "RUNNER_DECODE", "PHASE_METRICS"),
                              idle=500, hard=900)
+        _record_phase_metrics("runner", got)
         if not _record_runner(got):
             _note("runner", "CPU proxy runner A/B also failed; no runner number")
         _emit()
 
     # Phase 5 — serving latency + sustained load (pure host, CPU platform).
     sproc = _spawn("serving", _cpu_env())
-    got = _collect_multi(sproc, ("SERVING_P50_MS", "SERVING_LOAD"),
+    got = _collect_multi(sproc, ("SERVING_P50_MS", "SERVING_LOAD",
+                                 "PHASE_METRICS"),
                          idle=200, hard=400)
+    _record_phase_metrics("serving", got)
     if got.get("SERVING_P50_MS"):
         RESULT["extras"]["serving_http_p50_ms"] = round(got["SERVING_P50_MS"][0], 2)
         RESULT["extras"]["serving_http_p95_ms"] = round(got["SERVING_P50_MS"][1], 2)
@@ -1131,5 +1203,7 @@ if __name__ == "__main__":
          "resnet": phase_resnet, "cpu": phase_cpu, "hist_ab": phase_hist_ab,
          "ooc": phase_ooc, "serving": phase_serving,
          "runner": phase_runner}[phase](**kw)
+        if phase != "health":  # the health probe must stay marker-clean
+            _emit_phase_metrics()
     else:
         main()

@@ -16,7 +16,14 @@ event ring, ``utils/stopwatch.py``, the hand-rolled serving counters):
   ``GET /trace/<id>`` / ``GET /debug/slow``, with an optional OTLP-shaped
   exporter (file sink or ``MMLSPARK_TPU_OTLP_ENDPOINT`` POST through the
   breaker-guarded io/http client).  Histograms carry exemplars linking
-  bucket outliers to trace ids.
+  bucket outliers to trace ids;
+- ``federation`` / ``slo`` / ``autoscale`` — the fleet plane (ISSUE 11):
+  ``MetricsFederator`` scrapes + merges every worker's ``/metrics`` into a
+  ``FleetView`` (counters summed, gauges worker-labelled, histograms
+  merged only on matching bucket bounds), ``SLOEngine`` evaluates
+  declarative SLOs with multi-window burn rates, ``AutoscaleAdvisor``
+  derives the per-class desired-replica signal — all served by
+  ``TopologyService`` at ``GET /fleet/{metrics,slo,autoscale}``.
 
 Hot paths instrumented: ``serving/server.py`` (GET /metrics, queue gauges,
 queue-vs-score phase histograms, EWMA shed signal), ``serving/
@@ -32,6 +39,9 @@ from .tracing import (Span, TRACE_HEADER, TRACEPARENT_HEADER, current_span,
 from .instruments import (BREAKER_STATE_CODES, instrument_breaker,
                           instrument_collector)
 from .collector import OTLP_ENDPOINT_ENV, SpanCollector, get_collector
+from .federation import FleetView, MetricsFederator, parse_prometheus
+from .slo import SLO, SLOEngine, parse_slo
+from .autoscale import AutoscaleAdvisor
 from .compute import (InstrumentedJit, compile_report, device_put,
                       ensure_build_info, ensure_device_memory_gauges,
                       instrumented_jit, transfer_nbytes)
@@ -45,4 +55,6 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "OTLP_ENDPOINT_ENV", "SpanCollector", "get_collector",
            "InstrumentedJit", "instrumented_jit", "compile_report",
            "device_put", "transfer_nbytes", "ensure_build_info",
-           "ensure_device_memory_gauges"]
+           "ensure_device_memory_gauges",
+           "FleetView", "MetricsFederator", "parse_prometheus",
+           "SLO", "SLOEngine", "parse_slo", "AutoscaleAdvisor"]

@@ -11,7 +11,9 @@ from typing import Optional
 from .metrics import MetricsRegistry, get_registry
 
 __all__ = ["BREAKER_STATE_CODES", "instrument_breaker",
-           "uninstrument_breaker", "instrument_collector"]
+           "uninstrument_breaker", "instrument_collector",
+           "instrument_federator", "instrument_slo_engine",
+           "instrument_autoscaler"]
 
 #: numeric encoding for the breaker-state gauge (alerting rules compare
 #: against these: anything > 0 means degraded)
@@ -110,6 +112,89 @@ def instrument_collector(collector, registry: Optional[MetricsRegistry] = None
     if getattr(collector, "breaker", None) is not None:
         instrument_breaker(collector.breaker, reg)
     return children
+
+
+def instrument_federator(federator, registry: Optional[MetricsRegistry] = None
+                         ) -> dict:
+    """Wire a ``MetricsFederator`` into a registry — the fleet plane
+    watches the workers; these series watch the fleet plane:
+
+    - ``mmlspark_federation_scrape_total{worker,result}`` — per-worker
+      scrape outcomes (``ok``/``error``/``parse_error``/
+      ``deadline_exhausted``);
+    - ``mmlspark_federation_scrape_seconds`` — full-sweep latency;
+    - ``mmlspark_federation_stale_workers{federation}`` — callback gauge:
+      live workers whose last successful scrape is older than the
+      staleness bound (never-scraped counts); labelled by the federator's
+      ``name`` so federators sharing a registry neither clobber each
+      other's callback nor remove each other's series on close;
+    - ``mmlspark_federation_bucket_mismatch_total{family}`` — histogram
+      worker-children skipped on mismatched bucket bounds (the
+      never-silently-merge rule made visible).
+
+    Returns the bound children/families keyed as the federator's scrape
+    path uses them."""
+    reg = registry or get_registry()
+    children = {
+        "scrapes": reg.counter(
+            "mmlspark_federation_scrape_total",
+            "federation /metrics scrapes by worker and outcome",
+            labels=("worker", "result")),
+        "scrape_seconds": reg.histogram(
+            "mmlspark_federation_scrape_seconds",
+            "full federation sweep latency (fan-out + parse + merge)"
+            ).labels(),
+        "bucket_mismatch": reg.counter(
+            "mmlspark_federation_bucket_mismatch_total",
+            "histogram children skipped on mismatched bucket bounds "
+            "(never silently merged)", labels=("family",)),
+    }
+    reg.gauge("mmlspark_federation_stale_workers",
+              "live workers without a fresh successful scrape",
+              labels=("federation",)).set_function(
+        lambda f=federator: f.stale_workers(), federation=federator.name)
+    return children
+
+
+def instrument_slo_engine(engine, registry: Optional[MetricsRegistry] = None
+                          ) -> dict:
+    """Register the SLO engine's verdict gauges:
+
+    - ``mmlspark_slo_burn_rate{slo,window}`` — windowed bad-fraction over
+      the error budget (> 1 on both windows = burning);
+    - ``mmlspark_slo_budget_remaining{slo}`` — slow-window budget left,
+      clamped to [0, 1]."""
+    reg = registry or get_registry()
+    return {
+        "burn_rate": reg.gauge(
+            "mmlspark_slo_burn_rate",
+            "error-budget burn rate per window (fast/slow)",
+            labels=("slo", "window")),
+        "budget_remaining": reg.gauge(
+            "mmlspark_slo_budget_remaining",
+            "fraction of the error budget left over the slow window",
+            labels=("slo",)),
+    }
+
+
+def instrument_autoscaler(advisor, registry: Optional[MetricsRegistry] = None
+                          ) -> dict:
+    """Register the autoscale advisor's recommendation series:
+
+    - ``mmlspark_autoscale_desired_replicas{class}`` — the signal itself;
+    - ``mmlspark_autoscale_recommendations_total{class,direction}`` —
+      recomputations by direction (``up``/``down``/``hold``) so flapping
+      is visible as a rate."""
+    reg = registry or get_registry()
+    return {
+        "desired": reg.gauge(
+            "mmlspark_autoscale_desired_replicas",
+            "desired replica count per request class", labels=("class",)),
+        "recommendations": reg.counter(
+            "mmlspark_autoscale_recommendations_total",
+            "autoscale recomputations by class and direction",
+            labels=("class", "direction")),
+    }
 
 
 def _listeners(reg: MetricsRegistry) -> dict:

@@ -1,9 +1,9 @@
 from .server import (PipelineServer, DistributedPipelineServer, ServingStats)
 from .distributed import RoutingClient, TopologyService, WorkerServer
 from .streaming import HTTPStreamSource, StreamingQuery, read_stream
-from .loadgen import sustained_load, mixed_load
+from .loadgen import check_gates, sustained_load, mixed_load
 
 __all__ = ["PipelineServer", "DistributedPipelineServer", "ServingStats",
            "TopologyService", "WorkerServer", "RoutingClient",
            "HTTPStreamSource", "StreamingQuery", "read_stream",
-           "sustained_load", "mixed_load"]
+           "sustained_load", "mixed_load", "check_gates"]

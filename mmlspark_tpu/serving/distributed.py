@@ -21,13 +21,17 @@ from __future__ import annotations
 import json
 import threading
 import time
+import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional
 
 from .server import PipelineServer
 from ..observability import get_registry, instrument_breaker
+from ..observability.autoscale import AutoscaleAdvisor
+from ..observability.federation import MetricsFederator
 from ..observability.instruments import uninstrument_breaker
+from ..observability.slo import SLOEngine
 from ..observability.tracing import (TRACE_HEADER, TRACEPARENT_HEADER,
                                      current_span, current_trace_id,
                                      format_traceparent)
@@ -58,6 +62,64 @@ def _http_json(url: str, payload: Optional[dict] = None, timeout: float = 10.0,
     req = urllib.request.Request(url, data=data, headers=headers)
     with urllib.request.urlopen(req, timeout=timeout) as r:
         return json.loads(r.read().decode() or "null")
+
+
+#: every HTTP endpoint TopologyService serves, by verb — the telemetry
+#: coverage sweep (tests/test_telemetry_coverage.py) diffs this table
+#: against the handler source, so a new endpoint cannot land unlisted
+#: (and therefore undocumented/unswept).  ``/flag/<key>`` is the
+#: prefix-matched flag read.
+TOPOLOGY_ENDPOINTS = {
+    "GET": ("/routing", "/flag/<key>", "/stats", "/fleet/slow",
+            "/fleet/metrics", "/fleet/slo", "/fleet/autoscale", "/health"),
+    "POST": ("/register", "/deregister", "/flag"),
+}
+
+
+def _nonneg_int(raw: str) -> int:
+    v = int(raw)
+    if v < 0:
+        raise ValueError("must be >= 0")
+    return v
+
+
+def _pos_float(raw: str) -> float:
+    v = float(raw)
+    if not v > 0:
+        raise ValueError("must be > 0")
+    return v
+
+
+def _flag01(raw: str) -> bool:
+    if raw in ("1", "true"):
+        return True
+    if raw in ("", "0", "false"):
+        return False
+    raise ValueError("expected 0|1")
+
+
+def _parse_query(query: str, spec: Dict[str, Callable[[str], object]]):
+    """Validate a query string against ``spec`` (param name -> parser
+    raising ValueError).  Returns ``(params, None)`` or ``(None, error)``
+    — the shared validation for every fleet endpoint: a malformed value
+    is a 400 verdict on the REQUEST, never a silent default and never an
+    unhandled exception turning into a 500 (ISSUE 11 bugfix).  Unknown
+    params are ignored (forward compatibility); percent-encoding is
+    decoded by the stdlib parser; a repeated param's LAST value wins."""
+    params: Dict[str, object] = {}
+    if not query:
+        return params, None
+    for key, values in urllib.parse.parse_qs(
+            query, keep_blank_values=True).items():
+        parser = spec.get(key)
+        if parser is None:
+            continue
+        raw = values[-1]
+        try:
+            params[key] = parser(raw)
+        except ValueError as e:
+            return None, f"bad query param {key}={raw!r}: {e}"
+    return params, None
 
 
 def _default_prober(worker: Dict, timeout: float) -> bool:
@@ -92,7 +154,14 @@ class TopologyService:
                  registry=None, fleet_slow_deadline_s: float = 2.0,
                  fleet_slow_k: int = 10,
                  fleet_breaker_factory: Optional[
-                     Callable[[str], CircuitBreaker]] = None):
+                     Callable[[str], CircuitBreaker]] = None,
+                 slos=(), federation_poll_s: Optional[float] = None,
+                 federation_timeout_s: float = 2.0,
+                 federation_deadline_s: float = 3.0,
+                 telemetry_clock: Callable[[], float] = time.monotonic,
+                 federator: Optional[MetricsFederator] = None,
+                 slo_engine: Optional[SLOEngine] = None,
+                 autoscaler: Optional[AutoscaleAdvisor] = None):
         self.host, self.port = host, port
         self.probe_interval_s = probe_interval_s
         self.probe_timeout_s = probe_timeout_s
@@ -125,6 +194,28 @@ class TopologyService:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._stop = threading.Event()
         self._probe_thread: Optional[threading.Thread] = None
+        # fleet telemetry plane (ISSUE 11): federated /metrics, SLO
+        # burn-rate verdicts, autoscale recommendations — all driven off
+        # ONE injectable clock so the deterministic suites step windows
+        # and cooldowns with FakeClock.  Components are injectable whole
+        # for custom thresholds; the defaults share this service's
+        # registry so every instrument lands in one scrape.
+        self.federation_poll_s = federation_poll_s
+        self.federator = federator if federator is not None else \
+            MetricsFederator(workers_fn=self.routing_table,
+                             registry=self.registry,
+                             timeout_s=federation_timeout_s,
+                             deadline_s=federation_deadline_s,
+                             clock=telemetry_clock)
+        self.slo_engine = slo_engine if slo_engine is not None else \
+            SLOEngine(slos, registry=self.registry, clock=telemetry_clock)
+        self.autoscaler = autoscaler if autoscaler is not None else \
+            AutoscaleAdvisor(registry=self.registry, clock=telemetry_clock)
+        self._fleet_lock = threading.Lock()
+        self._last_view = None
+        self._last_slo: Optional[Dict] = None
+        self._last_autoscale: Optional[Dict] = None
+        self._federation_thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------------ http
     def _make_handler(self):
@@ -135,9 +226,12 @@ class TopologyService:
                 pass
 
             def _json(self, status, obj):
-                body = json.dumps(obj).encode()
+                self._raw(status, json.dumps(obj).encode(),
+                          "application/json")
+
+            def _raw(self, status, body, ctype):
                 self.send_response(status)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -166,31 +260,62 @@ class TopologyService:
                     self._json(404, {"error": "not found"})
 
             def do_GET(self):
-                if self.path == "/routing":
+                path, _, query = self.path.partition("?")
+                if path == "/routing":
                     with svc._lock:
                         table = dict(svc._workers)
                     self._json(200, table)
-                elif self.path.startswith("/flag/"):
+                elif path.startswith("/flag/"):
                     with svc._lock:
-                        self._json(200, {"value": svc._flags.get(self.path[6:])})
-                elif self.path == "/stats":
+                        self._json(200, {"value": svc._flags.get(path[6:])})
+                elif path == "/stats":
                     self._json(200, svc.aggregate_stats())
-                elif self.path.split("?", 1)[0] == "/fleet/slow":
-                    k, deadline_s = svc.fleet_slow_k, None
-                    for part in self.path.partition("?")[2].split("&"):
-                        if part.startswith("k="):
-                            try:
-                                k = int(part[2:])
-                            except ValueError:
-                                pass
-                        elif part.startswith("deadline_ms="):
-                            try:
-                                deadline_s = float(part[12:]) / 1000.0
-                            except ValueError:
-                                pass
-                    self._json(200, svc.fleet_slow(k=k,
-                                                   deadline_s=deadline_s))
-                elif self.path == "/health":
+                elif path == "/fleet/slow":
+                    # shared validation (ISSUE 11 bugfix): a malformed or
+                    # negative ?k= is a 400 verdict on the request — it
+                    # used to be swallowed into the default (or blow up in
+                    # the handler), both of which hide the caller's bug
+                    params, err = _parse_query(query, {
+                        "k": _nonneg_int, "deadline_ms": _pos_float})
+                    if err is not None:
+                        self._json(400, {"error": err})
+                        return
+                    dl = params.get("deadline_ms")
+                    self._json(200, svc.fleet_slow(
+                        k=params.get("k"),
+                        deadline_s=dl / 1000.0 if dl is not None else None))
+                elif path == "/fleet/metrics":
+                    params, err = _parse_query(query, {
+                        "refresh": _flag01, "deadline_ms": _pos_float})
+                    if err is not None:
+                        self._json(400, {"error": err})
+                        return
+                    dl = params.get("deadline_ms")
+                    view, _slo, _auto = svc._fleet_state(
+                        refresh=params.get("refresh"),
+                        deadline_s=dl / 1000.0 if dl is not None else None)
+                    body = view.to_prometheus(extra_registry=svc.registry)
+                    self._raw(200, body.encode(),
+                              "text/plain; version=0.0.4; charset=utf-8")
+                elif path == "/fleet/slo":
+                    params, err = _parse_query(query, {"refresh": _flag01})
+                    if err is not None:
+                        self._json(400, {"error": err})
+                        return
+                    view, verdicts, _auto = svc._fleet_state(
+                        refresh=params.get("refresh"))
+                    self._json(200, {**verdicts, "workers": view.to_dict()["workers"]})
+                elif path == "/fleet/autoscale":
+                    params, err = _parse_query(query, {"refresh": _flag01})
+                    if err is not None:
+                        self._json(400, {"error": err})
+                        return
+                    view, _slo, recs = svc._fleet_state(
+                        refresh=params.get("refresh"))
+                    self._json(200, {"classes": recs,
+                                     "workers": view.to_dict()["workers"],
+                                     "evaluated_at": view.scraped_at})
+                elif path == "/health":
                     self._json(200, {"ok": True})
                 else:
                     self._json(404, {"error": "not found"})
@@ -231,8 +356,59 @@ class TopologyService:
             except Exception:  # noqa: BLE001 — prober must never die
                 pass
 
+    # ------------------------------------------------------ fleet telemetry
+    def workers_by_class(self) -> Dict[str, List[Dict]]:
+        """Live workers grouped by the ``request_class`` they registered
+        under (``"default"`` when unset) — the autoscale signal's unit."""
+        out: Dict[str, List[Dict]] = {}
+        for w in self.routing_table().values():
+            out.setdefault(str(w.get("request_class") or "default"),
+                           []).append(w)
+        return out
+
+    def federation_tick(self, deadline_s: Optional[float] = None) -> Dict:
+        """One federation poll: scrape every live worker's ``/metrics``,
+        evaluate the SLOs against the merged view, recompute the autoscale
+        recommendation — the unit the background poll loops and the
+        on-demand fleet endpoints call.  Always completes with whatever
+        partial view the scrape produced: a dead worker is a failure row,
+        never a blind endpoint."""
+        view = self.federator.scrape_once(deadline_s=deadline_s)
+        verdicts = self.slo_engine.evaluate(view)
+        recs = self.autoscaler.recommend(view, self.workers_by_class())
+        with self._fleet_lock:
+            self._last_view = view
+            self._last_slo = verdicts
+            self._last_autoscale = recs
+        return {"view": view, "slo": verdicts, "autoscale": recs}
+
+    def _fleet_state(self, refresh: Optional[bool] = None,
+                     deadline_s: Optional[float] = None):
+        """(view, slo_verdicts, autoscale_recs) for the fleet endpoints.
+        With a background poll running the cached poll result serves
+        (``?refresh=1`` forces a sweep); without one every GET scrapes on
+        demand — the ISSUE 11 "poll interval or on demand" contract."""
+        if refresh is None:
+            refresh = self.federation_poll_s is None
+        with self._fleet_lock:
+            have = self._last_view is not None
+        if refresh or not have:
+            self.federation_tick(deadline_s=deadline_s)
+        with self._fleet_lock:
+            return self._last_view, self._last_slo, self._last_autoscale
+
+    def _federation_loop(self) -> None:
+        while not self._stop.wait(self.federation_poll_s):
+            try:
+                self.federation_tick()
+            except Exception:  # noqa: BLE001 — the poll must never die
+                pass
+
     # ------------------------------------------------------------------ api
     def start(self) -> "TopologyService":
+        # a restart after stop() must re-arm the loops: the stop event
+        # left set would kill the fresh probe/federation threads on entry
+        self._stop.clear()
         self._httpd = ThreadingHTTPServer((self.host, self.port),
                                           self._make_handler())
         self.port = self._httpd.server_port
@@ -242,6 +418,14 @@ class TopologyService:
             self._probe_thread = threading.Thread(target=self._probe_loop,
                                                   daemon=True)
             self._probe_thread.start()
+        # restore the staleness series after a previous stop() (no-op on
+        # first start — construction already registered it)
+        self.federator.reopen()
+        if self.federation_poll_s is not None:
+            self._federation_thread = threading.Thread(
+                target=self._federation_loop, daemon=True,
+                name="mmlspark-federation-poll")
+            self._federation_thread.start()
         return self
 
     def stop(self) -> None:
@@ -249,6 +433,17 @@ class TopologyService:
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
+        # join the loops before returning: start() clears the stop event,
+        # and an old loop still mid-probe when it is cleared would revive
+        # and run ALONGSIDE the restart's fresh threads (double-counted
+        # probes evict healthy workers at half the intended threshold)
+        for t in (self._probe_thread, self._federation_thread):
+            if t is not None and t.is_alive():
+                t.join(timeout=10.0)
+        self._probe_thread = self._federation_thread = None
+        # the federator's stale-workers callback gauge closes over this
+        # service's routing table — a stopped driver must not scrape on
+        self.federator.close()
 
     @property
     def address(self) -> str:
@@ -266,6 +461,7 @@ class TopologyService:
         total = {"received": 0, "replied": 0, "errors": 0, "shed": 0,
                  "workers": {}, "evicted": evicted}
         lat_sum_ms, lat_count = 0.0, 0
+        ckpt_ages: Dict[str, float] = {}
         for w in workers:
             try:
                 s = _http_json(f"http://{w['host']}:{w['port']}/stats")
@@ -277,6 +473,12 @@ class TopologyService:
             total["replied"] += s.get("replied", 0)
             total["errors"] += s.get("errors", 0)
             total["shed"] += s.get("shed", 0)
+            # checkpointing workers report their last-success age (ISSUE
+            # 11): "checkpoints stopped landing" is a FLEET page, so the
+            # worst age surfaces here, not just per box
+            age = s.get("checkpoint_last_success_age_seconds")
+            if isinstance(age, (int, float)) and age == age:  # NaN out
+                ckpt_ages[w["server_id"]] = age
             # (sum, count)-paired latency when the worker reports it; the
             # pre-pairing fallback weights by replied
             n = s.get("latency_count", s.get("replied", 0))
@@ -286,6 +488,10 @@ class TopologyService:
             total["latency_count"] = lat_count
             total["latency_avg_ms"] = lat_sum_ms / lat_count
             total["mean_latency_ms"] = total["latency_avg_ms"]
+        if ckpt_ages:
+            total["checkpoint_last_success_age_seconds"] = ckpt_ages
+            total["checkpoint_max_last_success_age_seconds"] = \
+                max(ckpt_ages.values())
         return total
 
     # ------------------------------------------------------------ /fleet/slow
@@ -404,10 +610,14 @@ class WorkerServer:
     ``HTTPSourceStateHolder`` registration."""
 
     def __init__(self, model, server_id: str, driver_address: str,
-                 partition_ids: Optional[List[int]] = None, **kw):
+                 partition_ids: Optional[List[int]] = None,
+                 request_class: str = "default", **kw):
         self.server_id = server_id
         self.driver_address = driver_address.rstrip("/")
         self.partition_ids = partition_ids or []
+        # the traffic class this replica serves (e.g. "score" / "decode"):
+        # the autoscale signal groups workers by it (ISSUE 11)
+        self.request_class = request_class
         self.server = PipelineServer(model, **kw)
 
     def start(self) -> "WorkerServer":
@@ -416,7 +626,8 @@ class WorkerServer:
                    {"server_id": self.server_id, "host": self.server.host,
                     "port": self.server.port,
                     "api_path": self.server.api_path,
-                    "partition_ids": self.partition_ids})
+                    "partition_ids": self.partition_ids,
+                    "request_class": self.request_class})
         return self
 
     def stop(self) -> None:

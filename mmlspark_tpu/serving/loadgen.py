@@ -11,6 +11,12 @@ scoring AND generative decode — through one shared measurement window, the
 traffic shape the multi-model serving-fleet ROADMAP item needs a generator
 for: per-class latency percentiles under combined load, not per-class runs
 that never contend.
+
+Per-class gates (ISSUE 11): a workload may carry ``"gates": {"p99_ms":
+..., "p50_ms": ..., "max_error_rate": ..., "min_rps": ...}`` and its
+result gains a ``"gates"`` verdict — pass/fail per class with every
+limit/actual pair, the ROADMAP's "per-class p99 gates" hook reused by the
+fleet E2E suite and bench.
 """
 from __future__ import annotations
 
@@ -20,25 +26,76 @@ import time
 from typing import Any, Dict, List, Optional, Sequence
 
 
+def check_gates(gates: Dict[str, float],
+                stats: Dict[str, float]) -> Dict[str, Any]:
+    """Evaluate one class's gate spec against its measured stats.
+
+    Known gates: ``p99_ms`` / ``p50_ms`` (upper bounds on the measured
+    percentiles), ``max_error_rate`` (lost + non-2xx requests over the
+    class's INTENDED request count when ``stats`` carries ``intended`` —
+    a client thread dying mid-run loses every remaining request, not one
+    "error" — else the legacy transport-errors/attempts ratio),
+    ``min_rps`` (lower bound on completed-request throughput).  Unknown
+    gate keys fail loudly — a typo'd gate that silently always passes is
+    worse than no gate."""
+    checks: Dict[str, Dict[str, float]] = {}
+    failures: List[str] = []
+
+    def book(name: str, actual: float, limit: float, ok: bool) -> None:
+        checks[name] = {"limit": limit, "actual": actual, "ok": ok}
+        if not ok:
+            failures.append(f"{name}: {actual:.4g} vs limit {limit:.4g}")
+
+    for name, limit in gates.items():
+        limit = float(limit)
+        if name in ("p99_ms", "p50_ms"):
+            # a class that completed NOTHING reports 0.0 percentiles — a
+            # vacuous pass there would wave a totally dead class through
+            # its latency gate, the exact silent failure gates exist for
+            ok = stats["completed"] > 0 and stats[name] <= limit
+            book(name, stats[name], limit, ok)
+        elif name == "max_error_rate":
+            intended = stats.get("intended", 0.0)
+            if intended > 0:
+                bad = max(0.0, intended - stats["completed"]) \
+                    + stats.get("non_2xx", 0.0)
+                rate = bad / intended
+            else:
+                attempts = stats["completed"] + stats["errors"]
+                bad = stats["errors"] + stats.get("non_2xx", 0.0)
+                rate = bad / attempts if attempts else 1.0
+            book(name, rate, limit, rate <= limit)
+        elif name == "min_rps":
+            book(name, stats["rps"], limit, stats["rps"] >= limit)
+        else:
+            raise ValueError(f"unknown gate {name!r}; expected one of "
+                             "p99_ms/p50_ms/max_error_rate/min_rps")
+    return {"passed": not failures, "failures": failures, "checks": checks}
+
+
 def mixed_load(host: str, port: int,
                workloads: Sequence[Dict[str, Any]],
-               warm: int = 10) -> Dict[str, Dict[str, float]]:
+               warm: int = 10) -> Dict[str, Dict[str, Any]]:
     """Fire several request classes concurrently through one wall-clock
     window.
 
     Each workload is ``{"name", "path", "body", "headers", "n_clients",
-    "per_client"}`` (``n_clients`` default 4, ``per_client`` default 100).
-    Every client opens its own persistent connection, fires ``warm``
-    untimed requests, then waits on ONE barrier shared by every workload —
-    the clock starts when the whole mixed fleet is warm, so the classes
+    "per_client"}`` (``n_clients`` default 4, ``per_client`` default 100)
+    plus an optional ``"gates"`` spec (see :func:`check_gates`).  Every
+    client opens its own persistent connection, fires ``warm`` untimed
+    requests, then waits on ONE barrier shared by every workload — the
+    clock starts when the whole mixed fleet is warm, so the classes
     genuinely contend for the server for the entire window.  Worker
     exceptions are caught and counted; a dying connection deflates (never
     inflates) its class's numbers.
 
     Returns ``{workload_name: {"rps", "p50_ms", "p99_ms", "completed",
-    "errors"}, "combined": {...}}`` — per-class RPS shares the combined
-    wall window, so the numbers add up.  Raises AssertionError if no
-    request of any class completed.
+    "errors", "non_2xx"[, "gates"]}, "combined": {...}}`` — per-class RPS
+    shares the combined wall window, so the numbers add up; ``non_2xx``
+    counts completed exchanges whose status was not 2xx (sheds, timeouts)
+    so overload is visible without changing the completed/latency
+    semantics.  Raises AssertionError if no request of any class
+    completed.
     """
     names = [w["name"] for w in workloads]
     if len(set(names)) != len(names):
@@ -46,6 +103,7 @@ def mixed_load(host: str, port: int,
                          "per-class attribution would silently merge them")
     lats: Dict[str, List[float]] = {w["name"]: [] for w in workloads}
     errors: Dict[str, List[str]] = {w["name"]: [] for w in workloads}
+    non_2xx: Dict[str, int] = {w["name"]: 0 for w in workloads}
     lock = threading.Lock()
     total_clients = sum(int(w.get("n_clients", 4)) for w in workloads)
     barrier = threading.Barrier(total_clients + 1)
@@ -54,6 +112,7 @@ def mixed_load(host: str, port: int,
         name = w["name"]
         body, headers = w["body"], w.get("headers") or {}
         mine: List[float] = []
+        mine_bad = 0
         try:
             conn = http.client.HTTPConnection(host, port, timeout=30)
             for _ in range(warm):
@@ -75,14 +134,18 @@ def mixed_load(host: str, port: int,
             for _ in range(int(w.get("per_client", 100))):
                 t0 = time.perf_counter()
                 conn.request("POST", w["path"], body, headers)
-                conn.getresponse().read()
+                resp = conn.getresponse()
+                resp.read()
                 mine.append(time.perf_counter() - t0)
+                if not 200 <= resp.status < 300:
+                    mine_bad += 1
         except Exception as e:  # noqa: BLE001 - count what completed
             with lock:
                 errors[name].append(repr(e))
         finally:
             with lock:
                 lats[name].extend(mine)
+                non_2xx[name] += mine_bad
 
     threads = [threading.Thread(target=fire, args=(w,))
                for w in workloads for _ in range(int(w.get("n_clients", 4)))]
@@ -94,36 +157,52 @@ def mixed_load(host: str, port: int,
         t.join()
     wall = max(time.perf_counter() - t0, 1e-9)
 
-    def stats(vals: List[float], errs: List[str]) -> Dict[str, float]:
+    def stats(vals: List[float], errs: List[str], bad: int
+              ) -> Dict[str, float]:
         vals = sorted(vals)
         # the percentile keys are part of the return contract even for a
         # class that completed nothing (0.0, with completed==0 saying why)
         return {"rps": len(vals) / wall, "completed": float(len(vals)),
-                "errors": float(len(errs)),
+                "errors": float(len(errs)), "non_2xx": float(bad),
                 "p50_ms": 1000 * vals[len(vals) // 2] if vals else 0.0,
                 "p99_ms": 1000 * vals[int(len(vals) * 0.99)] if vals else 0.0}
 
     all_lats = [v for vs in lats.values() for v in vs]
     all_errs = [e for es in errors.values() for e in es]
     assert all_lats, f"no request completed; errors={all_errs[:3]}"
-    result = {w["name"]: stats(lats[w["name"]], errors[w["name"]])
-              for w in workloads}
-    result["combined"] = stats(all_lats, all_errs)
+    result: Dict[str, Dict[str, Any]] = {}
+    intended_total = 0.0
+    for w in workloads:
+        name = w["name"]
+        st = stats(lats[name], errors[name], non_2xx[name])
+        # the class's intended request count: the honest error-rate
+        # denominator (a dead client loses all its remaining requests)
+        st["intended"] = float(int(w.get("n_clients", 4))
+                               * int(w.get("per_client", 100)))
+        intended_total += st["intended"]
+        if w.get("gates"):
+            st["gates"] = check_gates(w["gates"], st)
+        result[name] = st
+    result["combined"] = stats(all_lats, all_errs, sum(non_2xx.values()))
+    result["combined"]["intended"] = intended_total
     return result
 
 
 def sustained_load(host: str, port: int, path: str, body: str,
                    headers: Dict[str, str], n_clients: int = 8,
-                   per_client: int = 250, warm: int = 10) -> Dict[str, float]:
+                   per_client: int = 250, warm: int = 10,
+                   gates: Optional[Dict[str, float]] = None
+                   ) -> Dict[str, float]:
     """Fire ``per_client`` requests from ``n_clients`` persistent
     connections concurrently — the single-workload special case of
     :func:`mixed_load` (one shared warm barrier, completed-request RPS
-    numerator, caught-and-counted worker errors).
+    numerator, caught-and-counted worker errors, optional ``gates``).
 
-    Returns {"rps", "p50_ms", "p99_ms", "completed", "errors"}.
+    Returns {"rps", "p50_ms", "p99_ms", "completed", "errors", "non_2xx"}.
     Raises AssertionError if no request completed.
     """
     res = mixed_load(host, port, [{
         "name": "default", "path": path, "body": body, "headers": headers,
-        "n_clients": n_clients, "per_client": per_client}], warm=warm)
+        "n_clients": n_clients, "per_client": per_client, "gates": gates}],
+        warm=warm)
     return res["default"]

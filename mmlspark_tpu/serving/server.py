@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import math
 import queue
 import threading
 import time
@@ -269,6 +270,13 @@ class PipelineServer:
                     # every breaker instrumented into this registry, with
                     # state / consecutive failures / rolling failure rate
                     d["breakers"] = server.registry.breaker_stats()
+                    # a checkpointing worker reports its worst last-success
+                    # age so the fleet aggregator can page on "checkpoints
+                    # stopped landing" fleet-wide (ISSUE 11); absent when
+                    # nothing in this process checkpoints
+                    age = server._checkpoint_age_s()
+                    if age is not None:
+                        d["checkpoint_last_success_age_seconds"] = age
                     self._write_raw(200, json.dumps(d).encode())
                 elif self.path == "/metrics":
                     # content negotiation: exemplars are only legal under
@@ -506,6 +514,22 @@ class PipelineServer:
         if shed is not None:
             self._c_status["shed"].inc()
         return shed
+
+    def _checkpoint_age_s(self) -> Optional[float]:
+        """Max ``mmlspark_checkpoint_last_success_age_seconds`` across the
+        registry's checkpoint sites, or None when nothing checkpoints here.
+        The MAX is the pageable number: one stalled site is an outage even
+        when the others keep landing.  Finite values only: ``inf`` (armed
+        but never saved) would serialize as the non-RFC ``Infinity`` JSON
+        literal strict clients reject — the never-saved state stays
+        visible as ``+Inf`` on the ``/metrics`` text exposition."""
+        fam = self.registry.family(
+            "mmlspark_checkpoint_last_success_age_seconds")
+        if fam is None:
+            return None
+        vals = [child.value for _key, child in fam._snapshot()]
+        vals = [v for v in vals if math.isfinite(v)]
+        return max(vals) if vals else None
 
     def _oldest_queue_age_s(self) -> float:
         """Age of the oldest queued (not yet drained) entry; gauge callback."""

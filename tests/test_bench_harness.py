@@ -211,6 +211,81 @@ def test_runner_markers_fold_into_extras():
         bench.RESULT["extras"].clear()
 
 
+def test_phase_metrics_snapshot_folds_into_extras():
+    """ISSUE 11: each phase child prints a bounded PHASE_METRICS registry
+    snapshot; the parent folds it under extras.phase_metrics so bench
+    regressions diagnose from counters instead of reruns.  Garbled or
+    absent markers fold nothing."""
+    proc = _child(
+        "print('GBDT_RPS 123.0')\n"
+        "print('PHASE_METRICS {\"mmlspark_x_total\": {\"type\": "
+        "\"counter\", \"samples\": [{\"labels\": {}, \"value\": 7}]}}')\n")
+    got = bench._collect_multi(proc, ("GBDT_RPS", "PHASE_METRICS"),
+                               idle=10, hard=20)
+    bench.RESULT["extras"].clear()
+    try:
+        assert bench._record_phase_metrics("gbdt", got)
+        snap = bench.RESULT["extras"]["phase_metrics"]["gbdt"]
+        assert snap["mmlspark_x_total"]["samples"][0]["value"] == 7
+        assert not bench._record_phase_metrics("ooc", {})          # absent
+        assert not bench._record_phase_metrics(
+            "ooc", {"PHASE_METRICS": "not json"})                  # garbled
+        assert not bench._record_phase_metrics(
+            "ooc", {"PHASE_METRICS": [1.0]})            # parsed as floats
+        assert list(bench.RESULT["extras"]["phase_metrics"]) == ["gbdt"]
+    finally:
+        bench.RESULT["extras"].clear()
+
+
+def test_phase_metrics_snapshot_is_bounded_and_names_dropped_families():
+    """The snapshot must stay a single bounded line: oversized registries
+    drop their largest families and NAME them — truncation is
+    attributable, never silent — and exemplars (trace ids) are stripped."""
+    import json
+
+    from mmlspark_tpu.observability import MetricsRegistry, set_registry
+
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    try:
+        big = reg.counter("mmlspark_bulk_total", "bulk", labels=("k",))
+        for i in range(200):
+            big.inc(k=f"series-{i}")
+        reg.counter("mmlspark_tiny_total", "tiny").inc(3)
+        h = reg.histogram("mmlspark_lat_seconds", "lat")
+        h.observe(0.01, trace_id="deadbeef")  # exemplar must not leak
+        out = bench._metrics_snapshot_json(max_bytes=2048)
+        assert len(out) <= 2048
+        snap = json.loads(out)
+        assert "mmlspark_bulk_total" in snap["_dropped_families"]
+        assert snap["mmlspark_tiny_total"]["samples"][0]["value"] == 3
+        assert "deadbeef" not in out and "exemplars" not in out
+        # comfortably-sized registries pass through whole
+        small = json.loads(bench._metrics_snapshot_json(max_bytes=1 << 20))
+        assert "_dropped_families" not in small
+        assert "mmlspark_bulk_total" in small
+    finally:
+        set_registry(prev)
+
+
+def test_phase_children_emit_the_metrics_marker():
+    """The dispatcher (not each phase body) prints PHASE_METRICS after
+    every phase except the health probe, so a new phase cannot forget
+    the snapshot."""
+    import inspect
+
+    src = open(bench.__file__).read()
+    assert "_emit_phase_metrics()" in src
+    assert 'phase != "health"' in src
+    # and the parent folds it for every measured phase
+    fold_src = inspect.getsource(bench._run_measured_phases) + \
+        inspect.getsource(bench.main)
+    for phase in ("gbdt", "ooc", "hist_ab", "runner", "serving", "cpu"):
+        assert f'_record_phase_metrics("{phase}"' in fold_src, \
+            f"phase {phase} snapshot is no longer folded"
+    assert 'phase="ranker"' in fold_src and 'phase="resnet"' in fold_src
+
+
 def test_runner_below_gate_ratio_leaves_a_note():
     bench.RESULT["extras"].clear()
     try:

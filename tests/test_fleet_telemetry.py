@@ -1,0 +1,757 @@
+"""Fleet telemetry plane (ISSUE 11): federated /metrics merge semantics,
+SLO burn-rate windows, the autoscale signal, loadgen gates — deterministic
+units on FakeClock + canned expositions, plus the real-socket 2-worker
+E2E acceptance: mixed_load overload trips the SLO and the scale-up
+recommendation; draining recovers the verdict and decays the
+recommendation; a dead third worker never blinds any fleet endpoint."""
+import json
+import math
+import urllib.request
+
+import pytest
+
+from mmlspark_tpu.core.logging import recent_events
+from mmlspark_tpu.observability import (AutoscaleAdvisor, FleetView,
+                                        MetricsFederator, MetricsRegistry,
+                                        SLOEngine, parse_slo)
+from mmlspark_tpu.observability.federation import parse_prometheus
+from mmlspark_tpu.serving import (PipelineServer, TopologyService,
+                                  WorkerServer, check_gates, mixed_load)
+from mmlspark_tpu.utils.resilience import FakeClock
+from tests.serving_helpers import Doubler
+
+
+class SlowDoubler(Doubler):
+    """Doubler with a real (GIL-releasing) per-batch scoring cost, so a
+    bounded-admission server genuinely builds a queue and sheds under
+    concurrent load — a pure-Python fast stage serializes on the GIL and
+    never overloads."""
+
+    def _transform(self, df):
+        import time
+        time.sleep(0.01)
+        return super()._transform(df)
+
+
+def _post(url, payload, timeout=10):
+    req = urllib.request.Request(url, data=json.dumps(payload).encode(),
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+# ------------------------------------------------------------- merge rules
+
+def test_counters_sum_gauges_get_worker_labels_histograms_merge():
+    r0, r1 = MetricsRegistry(), MetricsRegistry()
+    for r in (r0, r1):
+        c = r.counter("mmlspark_t_reqs_total", "r", labels=("status",))
+        c.inc(5, status="received")
+        c.inc(1, status="shed")
+        r.gauge("mmlspark_t_depth", "d").set(3)
+        h = r.histogram("mmlspark_t_lat_seconds", "l",
+                        buckets=(0.001, 0.01, 0.1))
+        for v in (0.0005, 0.05, 0.5):
+            h.observe(v)
+    view = FleetView.from_texts({"w0": r0.to_prometheus(),
+                                 "w1": r1.to_prometheus()})
+    # counters: summed per label-set (the fleet total)
+    assert view.counter_sum("mmlspark_t_reqs_total",
+                            {"status": "received"}) == 10
+    assert view.counter_sum("mmlspark_t_reqs_total") == 12
+    # gauges: one series per worker, worker label added
+    assert view.gauge_values("mmlspark_t_depth") == \
+        [({"worker": "w0"}, 3.0), ({"worker": "w1"}, 3.0)]
+    # histograms: bucket-by-bucket merge on matching bounds
+    agg = view.histogram_aggregate("mmlspark_t_lat_seconds")
+    assert agg["count"] == 6 and agg["cum"][0.01] == 2 \
+        and agg["cum"][math.inf] == 6
+    assert view.quantile("mmlspark_t_lat_seconds", 50) == \
+        pytest.approx(0.055)
+    bad, total = view.fraction_over("mmlspark_t_lat_seconds", 0.01)
+    assert (bad, total) == (4.0, 6.0)
+    assert view.skipped_histograms == {}
+    # the rendered exposition reparses and carries the worker label
+    values, types, _ = parse_prometheus(view.to_prometheus())
+    assert types["mmlspark_t_lat_seconds"] == "histogram"
+    assert values[("mmlspark_t_depth",
+                   frozenset([("worker", "w1")]))] == 3.0
+    assert values[("mmlspark_t_reqs_total",
+                   frozenset([("status", "shed")]))] == 2.0
+    assert values[("mmlspark_t_lat_seconds_count", frozenset())] == 6.0
+
+
+def test_parse_prometheus_round_trips_escaped_and_comma_label_values():
+    """User-chosen label values (breaker names, checkpoint sites) may
+    carry commas, quotes, backslashes, newlines — the registry escapes
+    them on exposition and the production parser must unescape them back
+    to the SAME identity, never split a pair mid-value, and raise (not
+    assert — ``python -O`` strips asserts) on garbage."""
+    reg = MetricsRegistry()
+    nasty = 'db,primary "hot" \\ tier\none'
+    reg.counter("mmlspark_t_esc_total", "e", labels=("name",)).inc(
+        3, name=nasty)
+    values, _, _ = parse_prometheus(reg.to_prometheus())
+    assert values[("mmlspark_t_esc_total",
+                   frozenset([("name", nasty)]))] == 3.0
+    for garbage in ('metric{name="unterminated 1\n',
+                    "# TYPE m summary\n",
+                    'metric{name=noquotes} 1\n',
+                    "<html>proxy error page</html>\n"):
+        with pytest.raises(ValueError):
+            parse_prometheus(garbage)
+
+
+def test_histogram_bucket_mismatch_is_skipped_and_counted_never_merged():
+    """Acceptance: mismatched bucket bounds across workers are skipped +
+    counted — the matching worker's numbers survive untouched, the
+    mismatched worker contributes NOTHING to the family."""
+    r0, r2 = MetricsRegistry(), MetricsRegistry()
+    h0 = r0.histogram("mmlspark_t_lat_seconds", "l",
+                      buckets=(0.001, 0.01, 0.1))
+    h2 = r2.histogram("mmlspark_t_lat_seconds", "l",
+                      buckets=(0.001, 0.02, 0.1))  # different middle bound
+    for v in (0.0005, 0.05):
+        h0.observe(v)
+    h2.observe(0.05)
+    mismatches = []
+    view = FleetView.from_texts(
+        {"w0": r0.to_prometheus(), "w2": r2.to_prometheus()},
+        on_mismatch=lambda fam, sid: mismatches.append((fam, sid)))
+    assert view.skipped_histograms == {"mmlspark_t_lat_seconds": 1}
+    assert mismatches == [("mmlspark_t_lat_seconds", "w2")]
+    agg = view.histogram_aggregate("mmlspark_t_lat_seconds")
+    assert agg["count"] == 2, "mismatched worker must contribute nothing"
+    assert agg["bounds"] == (0.001, 0.01, 0.1, math.inf)
+
+
+def test_scrape_failures_book_counters_and_staleness_not_breakers():
+    """Acceptance: a failing federation scrape books per-worker failure
+    counters and staleness — and must NEVER touch serving-path breakers
+    (no registry breaker entries, no breaker gauge series)."""
+    clk = FakeClock()
+    reg = MetricsRegistry()
+    r0 = MetricsRegistry()
+    r0.counter("mmlspark_t_ok_total", "x").inc()
+    table = {"w0": {"host": "h", "port": 1}, "bad": {"host": "h", "port": 2}}
+
+    def fetcher(url, timeout_s, deadline):
+        if ":2/" in url:
+            raise ConnectionError("connection refused")
+        return r0.to_prometheus()
+
+    fed = MetricsFederator(workers_fn=lambda: table, registry=reg,
+                           clock=clk, stale_after_s=15.0, fetcher=fetcher)
+    view = fed.scrape_once()
+    assert view.workers["w0"]["ok"] and not view.workers["bad"]["ok"]
+    # None, not inf: these rows ride JSON endpoints and strict parsers
+    # reject the Infinity literal
+    assert view.workers["bad"]["age_s"] is None
+    scrapes = reg.family("mmlspark_federation_scrape_total")
+    assert scrapes.value(worker="bad", result="error") == 1
+    assert scrapes.value(worker="w0", result="ok") == 1
+    # never-scraped-ok counts stale immediately; a fresh ok does not
+    stale = reg.family("mmlspark_federation_stale_workers").labels(
+        federation="default")
+    assert stale.value == 1
+    clk.advance(20)  # now even w0's last ok is past the bound
+    assert stale.value == 2
+    # serving-path breaker hygiene: federation failures trip nothing
+    assert reg.breakers == {}
+    assert reg.family("mmlspark_breaker_state") is None
+
+
+# -------------------------------------------------------------- SLO engine
+
+def test_slo_grammar_parses_and_rejects():
+    s = parse_slo("p99(mmlspark_serving_request_latency_seconds"
+                  "{class=decode}) <= 0.15")
+    assert (s.kind, s.q, s.threshold) == ("latency", 99.0, 0.15)
+    assert s.labels == {"class": "decode"} and s.budget == pytest.approx(0.01)
+    s = parse_slo("p95(fam) <= 250ms")
+    assert s.threshold == pytest.approx(0.25) and s.budget == pytest.approx(0.05)
+    s = parse_slo('error_rate(reqs_total{status="shed"} / '
+                  "reqs_total{status=received}) <= 0.1%")
+    assert s.kind == "error_rate" and s.threshold == pytest.approx(0.001)
+    assert s.labels == {"status": "shed"}
+    assert s.total_labels == {"status": "received"}
+    assert s.budget == pytest.approx(0.001)
+    for bad in ("p99(fam", "p0(fam) <= 1", "p100(fam) <= 1",
+                "error_rate(a/b) <= -1", "latency(fam) <= 1", "nonsense"):
+        with pytest.raises(ValueError):
+            parse_slo(bad)
+    with pytest.raises(ValueError):  # duplicate names must fail loudly
+        SLOEngine(["p99(a) <= 1", "p99(a) <= 1"], registry=MetricsRegistry())
+
+
+def _lat_view(values, buckets=(0.001, 0.01, 0.1)):
+    reg = MetricsRegistry()
+    h = reg.histogram("mmlspark_t_lat_seconds", "l", buckets=buckets)
+    for v in values:
+        h.observe(v)
+    return FleetView.from_texts({"w0": reg.to_prometheus()})
+
+
+def test_slo_multiwindow_burn_trips_and_recovers_with_ring_events():
+    clk = FakeClock()
+    reg = MetricsRegistry()
+    eng = SLOEngine(["p99(mmlspark_t_lat_seconds) <= 0.01"], registry=reg,
+                    clock=clk, fast_window_s=300.0, slow_window_s=3600.0)
+    history = [0.001] * 50
+    eng.evaluate(_lat_view(history))            # baseline sample at t=0
+    clk.advance(60)
+    history += [0.5] * 10                        # burst of slow requests
+    out = eng.evaluate(_lat_view(history))
+    v = out["slos"][0]
+    assert v["burning"] and not v["ok"]
+    assert v["burn_rate"]["fast"] > 1 and v["burn_rate"]["slow"] > 1
+    assert v["budget_remaining"] == 0.0
+    assert reg.family("mmlspark_slo_burn_rate").value(
+        slo=v["slo"], window="fast") > 1
+    burns = [e for e in recent_events()
+             if e.get("event") == "slo_burn" and e.get("slo") == v["slo"]]
+    assert burns and burns[-1]["burn_fast"] > 1
+    # drain: no new events past the fast window -> verdict recovers
+    clk.advance(400)
+    out = eng.evaluate(_lat_view(history))
+    v = out["slos"][0]
+    assert v["ok"] and not v["burning"]
+    assert v["burn_rate"]["fast"] == 0.0
+    recs = [e for e in recent_events()
+            if e.get("event") == "slo_recovered" and e.get("slo") == v["slo"]]
+    assert recs, "recovery must book its ring event"
+
+
+def test_slo_needs_both_windows_burning_to_page():
+    """Google-SRE multi-window: a short burst against an hour of compliant
+    traffic burns the fast window but not the slow one — no page."""
+    clk = FakeClock(start=0.0)
+    eng = SLOEngine(["p99(mmlspark_t_lat_seconds) <= 0.01"],
+                    registry=MetricsRegistry(), clock=clk,
+                    fast_window_s=300.0, slow_window_s=3600.0)
+    history = []
+    eng.evaluate(_lat_view(history))             # t=0 baseline
+    clk.advance(3250)
+    history += [0.001] * 9000                    # an hour of good traffic
+    eng.evaluate(_lat_view(history))             # fast-window edge sample
+    clk.advance(250)
+    history += [0.001] * 1000
+    eng.evaluate(_lat_view(history))
+    clk.advance(60)
+    history += [0.5] * 50                        # fresh burst of slow ones
+    v = eng.evaluate(_lat_view(history))["slos"][0]
+    # fast window: 50 bad of ~1050 recent events -> burns hard
+    assert v["burn_rate"]["fast"] > 1, v
+    # slow window: the same 50 against ~10050 events -> inside budget
+    assert v["burn_rate"]["slow"] <= 1, v
+    assert not v["burning"], "one hot window alone must not page"
+
+
+def test_slo_holds_verdicts_on_shrunken_coverage_then_counter_resets():
+    """Degraded-telemetry discipline: a worker dropping out of the scrape
+    makes the fleet-cumulative series non-monotonic — that pass must HOLD
+    the previous verdicts (no false slo_recovered mid-incident), and once
+    coverage is stable at the new set the regressed total is treated as a
+    counter reset (history rebuilds, no negative windows)."""
+    clk = FakeClock()
+    eng = SLOEngine(["p99(mmlspark_t_lat_seconds) <= 0.01"],
+                    registry=MetricsRegistry(), clock=clk)
+
+    def two_worker_view(values0, values1, w1_ok=True):
+        regs = {"w0": values0, "w1": values1}
+        texts = {}
+        for sid, vals in regs.items():
+            reg = MetricsRegistry()
+            h = reg.histogram("mmlspark_t_lat_seconds", "l",
+                              buckets=(0.001, 0.01, 0.1))
+            for v in vals:
+                h.observe(v)
+            texts[sid] = reg.to_prometheus()
+        if not w1_ok:
+            texts.pop("w1")
+        view = FleetView.from_texts(texts)
+        if not w1_ok:
+            view.workers["w1"] = {"ok": False, "error": "error: refused"}
+        return view
+
+    eng.evaluate(two_worker_view([0.001] * 10, [0.001] * 10))
+    clk.advance(60)
+    v = eng.evaluate(two_worker_view([0.001] * 10 + [0.5] * 5,
+                                     [0.001] * 10 + [0.5] * 5))
+    assert v["slos"][0]["burning"]
+    # w1's scrape fails: totals would regress — the verdict holds instead
+    clk.advance(60)
+    ring_before = len([e for e in recent_events()
+                       if e.get("event") == "slo_recovered"])
+    held = eng.evaluate(two_worker_view([0.001] * 10 + [0.5] * 5, [],
+                                        w1_ok=False))
+    assert held["telemetry"] == "held_partial_view"
+    assert held["lost_workers"] == ["w1"]
+    assert held["slos"][0]["burning"], \
+        "a telemetry outage must never fire a false recovery"
+    assert len([e for e in recent_events()
+                if e.get("event") == "slo_recovered"]) == ring_before, \
+        "the held pass must not book a recovery ring event"
+    # next pass, coverage stable at {w0}: regressed total = counter reset;
+    # one rebuilt sample proves nothing, so the burning state HOLDS
+    clk.advance(60)
+    v = eng.evaluate(two_worker_view([0.001] * 10 + [0.5] * 5, [],
+                                     w1_ok=False))
+    assert "telemetry" not in v
+    assert v["slos"][0]["burn_rate"]["fast"] == 0.0, \
+        "post-reset windows rebuild from the new baseline"
+    assert v["slos"][0]["window_rebuilding"]
+    assert v["slos"][0]["burning"], \
+        "an empty rebuilt window must not fake a recovery"
+    # w1 REJOINS carrying its process-lifetime bad counts: coverage grew,
+    # so the windows re-baseline — no false slo_burn from lifetime counts,
+    # no false slo_recovered from the empty window
+    clk.advance(60)
+    ring_before = len([e for e in recent_events()
+                       if e.get("event", "").startswith("slo_")])
+    v = eng.evaluate(two_worker_view([0.001] * 10 + [0.5] * 5,
+                                     [0.5] * 100))
+    assert v["slos"][0]["burn_rate"]["fast"] == 0.0, \
+        "a rejoining worker's lifetime counts are not in-window events"
+    assert v["slos"][0]["burning"] and v["slos"][0]["window_rebuilding"]
+    assert len([e for e in recent_events()
+                if e.get("event", "").startswith("slo_")]) == ring_before
+    # a second stable pass with no new bad events settles the recovery
+    # on real differenced data
+    clk.advance(60)
+    v = eng.evaluate(two_worker_view([0.001] * 10 + [0.5] * 5,
+                                     [0.5] * 100))
+    assert v["slos"][0]["ok"] and not v["slos"][0]["window_rebuilding"]
+
+
+def test_slo_total_outage_then_join_rebaselines_and_caps_history_span():
+    """Two edges of the window discipline: (1) a TOTAL scrape outage must
+    leave the pending rebaseline armed, so a worker that joins during the
+    outage carrying lifetime counts cannot fire a false slo_burn; (2) a
+    high-cadence caller must not age the slow-window edge out of the
+    bounded history ring — fast evaluates coalesce instead of appending."""
+    clk = FakeClock()
+    eng = SLOEngine(["p99(mmlspark_t_lat_seconds) <= 0.01"],
+                    registry=MetricsRegistry(), clock=clk, history_cap=8)
+
+    def view_of(sid, values, extra_failed=()):
+        reg = MetricsRegistry()
+        h = reg.histogram("mmlspark_t_lat_seconds", "l",
+                          buckets=(0.001, 0.01, 0.1))
+        for v in values:
+            h.observe(v)
+        view = FleetView.from_texts({sid: reg.to_prometheus()})
+        for failed in extra_failed:
+            view.workers[failed] = {"ok": False, "error": "error: down"}
+        return view
+
+    eng.evaluate(view_of("w0", [0.001] * 20))
+    clk.advance(60)
+    # TOTAL outage: the only worker fails -> held pass
+    dead = FleetView()
+    dead.workers["w0"] = {"ok": False, "error": "error: down"}
+    held = eng.evaluate(dead)
+    assert held["telemetry"] == "held_partial_view"
+    clk.advance(60)
+    # w1 joined during the outage with lifetime-slow counts: the armed
+    # rebaseline must clear the pre-outage history -> no false burn
+    ring_before = len([e for e in recent_events()
+                       if e.get("event") == "slo_burn"])
+    v = eng.evaluate(view_of("w1", [0.5] * 500, extra_failed=("w0",)))
+    assert v["slos"][0]["burn_rate"]["fast"] == 0.0, v["slos"][0]
+    assert len([e for e in recent_events()
+                if e.get("event") == "slo_burn"]) == ring_before
+    # high cadence: 50 evaluates 1s apart into a cap-8 ring must coalesce
+    # (min spacing = 2*3600/8 = 900s) so the window baseline survives
+    history = [0.5] * 500
+    for _ in range(50):
+        clk.advance(1)
+        history = history + [0.001]
+        last = eng.evaluate(view_of("w1", history, extra_failed=("w0",)))
+    hist = eng._history[v["slos"][0]["slo"]]
+    assert len(hist) <= 3, "fast evaluates must coalesce, not evict"
+    assert hist[0][0] == 120.0, "the window baseline sample was evicted"
+    assert last["slos"][0]["burn_rate"]["fast"] == 0.0
+
+
+def test_high_cadence_ring_keeps_spaced_samples_and_recent_windows():
+    """Regression for the ring-collapse hazard: coalescing must anchor on
+    the last RETAINED sample, not the constantly-refreshed newest slot —
+    otherwise any cadence faster than the spacing collapses the ring to
+    [oldest, latest] and every window silently reads lifetime-wide."""
+    clk = FakeClock()
+    eng = SLOEngine(["p99(mmlspark_t_lat_seconds) <= 0.01"],
+                    registry=MetricsRegistry(), clock=clk,
+                    fast_window_s=4.0, slow_window_s=8.0, history_cap=8)
+    history = []
+    for _ in range(20):              # 1 Hz clean traffic, spacing bound 2 s
+        clk.advance(1)
+        history = history + [0.001] * 10
+        eng.evaluate(_lat_view(history))
+    name = eng.slos[0].name
+    hist = list(eng._history[name])
+    assert len(hist) > 2, "ring collapsed to [oldest, latest]"
+    assert all(hist[i + 1][0] - hist[i][0] >= 2.0
+               for i in range(len(hist) - 2)), \
+        "retained samples must stay >= min spacing apart"
+    for _ in range(4):               # 4 s of 100%-bad traffic
+        clk.advance(1)
+        history = history + [0.5] * 10
+        v = eng.evaluate(_lat_view(history))
+    frac = v["slos"][0]["bad_fraction"]["fast"]
+    # ~0.8 expected (bucket quantization puts the window edge one retained
+    # sample early); the collapsed-ring bug reads lifetime-wide ~0.17
+    assert frac > 0.6, \
+        f"fast window diluted to lifetime ({frac}) — window edge evicted"
+    assert v["slos"][0]["burning"]
+
+
+def test_autoscale_holds_when_the_whole_class_is_telemetry_blind():
+    """All of a class's scrapes failing must HOLD the recommendation
+    (reason telemetry_blind), never read absent gauges as calm and
+    scale down mid-outage."""
+    clk = FakeClock()
+    adv = AutoscaleAdvisor(registry=MetricsRegistry(), clock=clk,
+                           calm_s_for_downscale=10.0, cooldown_s=0.0)
+    fleet = {"score": [{"server_id": "w1", "host": "h", "port": 1}]}
+
+    def blind_view():
+        view = FleetView()
+        view.workers["w1"] = {"ok": False, "error": "error: timeout"}
+        return view
+
+    for _ in range(5):   # way past calm_s_for_downscale in fake time
+        clk.advance(20)
+        r = adv.recommend(blind_view(), fleet)["score"]
+    assert r["reason"] == "telemetry_blind" and r["desired"] == 1, r
+    assert r["pressure"] is None
+
+
+def test_histogram_aggregate_is_a_pure_read():
+    """Repeated queries must not inflate the merge-time mismatch count
+    the fleet endpoints serve."""
+    r0, r2 = MetricsRegistry(), MetricsRegistry()
+    r0.histogram("mmlspark_t_lat_seconds", "l",
+                 buckets=(0.001, 0.01)).observe(0.005)
+    r2.histogram("mmlspark_t_lat_seconds", "l",
+                 buckets=(0.001, 0.02)).observe(0.005)
+    view = FleetView.from_texts({"w0": r0.to_prometheus(),
+                                 "w2": r2.to_prometheus()})
+    assert view.skipped_histograms == {"mmlspark_t_lat_seconds": 1}
+    for _ in range(3):
+        view.quantile("mmlspark_t_lat_seconds", 99)
+        view.fraction_over("mmlspark_t_lat_seconds", 0.01)
+    assert view.skipped_histograms == {"mmlspark_t_lat_seconds": 1}
+
+
+def test_topology_stop_unhooks_the_stale_workers_gauge():
+    """The stale-workers callback closes over the service's routing table;
+    a stopped driver must detach its own series (scoped by the federation
+    label so a shared registry's other federators keep theirs), and a
+    restart must re-register it — the CheckpointManager re-open
+    convention."""
+    reg = MetricsRegistry()
+    svc = TopologyService(registry=reg, probe_interval_s=None).start()
+    fam = reg.family("mmlspark_federation_stale_workers")
+    assert fam is not None and len(fam._snapshot()) == 1
+    # a second, differently-named federator on the same registry survives
+    other = MetricsFederator(workers_fn=dict, registry=reg, name="other")
+    assert len(fam._snapshot()) == 2
+    svc.stop()
+    remaining = [key for key, _child in fam._snapshot()]
+    assert remaining == [("other",)], \
+        "stop must remove ONLY the stopped service's series"
+    svc.start()
+    assert len(fam._snapshot()) == 2, "restart must re-register the series"
+    svc.stop()
+    other.close()
+    assert fam._snapshot() == []
+
+
+# ---------------------------------------------------------------- autoscale
+
+def _serving_view(per_server):
+    """Canned fleet view with the serving families autoscale reads:
+    ``{addr: (ewma_s, depth, shed_cum, received_cum)}``."""
+    reg = MetricsRegistry()
+    g_e = reg.gauge("mmlspark_serving_queue_delay_ewma_seconds", "e",
+                    labels=("server",))
+    g_d = reg.gauge("mmlspark_serving_queue_depth", "d", labels=("server",))
+    c = reg.counter("mmlspark_serving_requests_total", "r",
+                    labels=("server", "status"))
+    for addr, (ewma, depth, shed, recv) in per_server.items():
+        g_e.set(ewma, server=addr)
+        g_d.set(depth, server=addr)
+        c.inc(shed, server=addr, status="shed")
+        c.inc(recv, server=addr, status="received")
+    return FleetView.from_texts({"w": reg.to_prometheus()})
+
+
+def test_autoscale_scale_up_cooldown_hysteresis_and_decay():
+    clk = FakeClock()
+    reg = MetricsRegistry()
+    adv = AutoscaleAdvisor(registry=reg, clock=clk,
+                           target_queue_delay_s=0.1, shed_tolerance=0.01,
+                           window_s=50.0, cooldown_s=60.0,
+                           calm_s_for_downscale=200.0)
+    fleet = {"score": [{"host": "h", "port": 1}, {"host": "h", "port": 2}]}
+    a1, a2 = "h:1", "h:2"
+
+    calm = {a1: (0.0, 0, 0, 0), a2: (0.0, 0, 0, 0)}
+    r = adv.recommend(_serving_view(calm), fleet)["score"]
+    assert (r["current"], r["desired"]) == (2, 2)
+
+    clk.advance(30)  # overload: half the window's requests shed
+    hot = {a1: (0.05, 3, 50, 100), a2: (0.02, 2, 30, 80)}
+    r = adv.recommend(_serving_view(hot), fleet)["score"]
+    assert r["reason"] == "scale_up" and r["desired"] > 2
+    assert r["signals"]["shed_rate"] == pytest.approx(80.0 / 180.0)
+    burst_desired = r["desired"]
+    assert reg.family("mmlspark_autoscale_desired_replicas").value(
+        **{"class": "score"}) == burst_desired
+
+    clk.advance(10)  # still hot but inside the cooldown: no flapping
+    hotter = {a1: (0.2, 9, 90, 150), a2: (0.2, 9, 70, 130)}
+    r = adv.recommend(_serving_view(hotter), fleet)["score"]
+    assert r["reason"] == "cooldown" and r["desired"] == burst_desired
+
+    clk.advance(80)  # drained: no new sheds inside the window -> decay
+    cold = {a1: (0.002, 0, 90, 160), a2: (0.002, 0, 70, 140)}
+    r = adv.recommend(_serving_view(cold), fleet)["score"]
+    assert r["reason"] == "decay" and 2 <= r["desired"] < burst_desired
+    decayed = r["desired"]
+
+    clk.advance(80)  # hysteresis band: neither hot nor calm -> hold
+    mid = {a1: (0.07, 0, 90, 165), a2: (0.07, 0, 70, 145)}
+    r = adv.recommend(_serving_view(mid), fleet)["score"]
+    assert r["reason"] == "hysteresis_band" and r["desired"] == decayed
+
+    # sustained calm decays to the live count, then one below it
+    desired = decayed
+    for _ in range(6):
+        clk.advance(80)
+        cold = {a1: (0.0, 0, 90, 165), a2: (0.0, 0, 70, 145)}
+        r = adv.recommend(_serving_view(cold), fleet)["score"]
+        assert r["desired"] <= desired
+        desired = r["desired"]
+    assert desired == 1 and r["reason"] == "scale_down"
+
+    # a class gone from the fleet takes its state and gauge series with it
+    adv.recommend(_serving_view(cold), {"other": fleet["score"]})
+    series = [s["labels"]["class"] for s in reg.to_dict()
+              ["mmlspark_autoscale_desired_replicas"]["samples"]]
+    assert series == ["other"]
+
+
+def test_autoscale_scrape_blip_does_not_fire_a_spurious_scale_up():
+    """A worker whose /metrics misses one federation scrape and then
+    rejoins carries its process-lifetime shed counts: the coverage change
+    must re-baseline the shed window, not read a lifetime's sheds as
+    in-window overload."""
+
+    def fleet_view(per_sid, failed=()):
+        texts = {}
+        for sid, (addr, ewma, depth, shed, recv) in per_sid.items():
+            reg = MetricsRegistry()
+            reg.gauge("mmlspark_serving_queue_delay_ewma_seconds", "e",
+                      labels=("server",)).set(ewma, server=addr)
+            reg.gauge("mmlspark_serving_queue_depth", "d",
+                      labels=("server",)).set(depth, server=addr)
+            c = reg.counter("mmlspark_serving_requests_total", "r",
+                            labels=("server", "status"))
+            c.inc(shed, server=addr, status="shed")
+            c.inc(recv, server=addr, status="received")
+            texts[sid] = reg.to_prometheus()
+        for sid in failed:
+            texts.pop(sid, None)
+        view = FleetView.from_texts(texts)
+        for sid in failed:
+            view.workers[sid] = {"ok": False, "error": "error: timeout"}
+        return view
+
+    clk = FakeClock()
+    adv = AutoscaleAdvisor(registry=MetricsRegistry(), clock=clk,
+                           shed_tolerance=0.02, window_s=300.0,
+                           cooldown_s=60.0,
+                           # keep deliberate scale-down out of the frame:
+                           # this test isolates the blip path
+                           calm_s_for_downscale=1e9)
+    fleet = {"score": [{"server_id": "w1", "host": "h", "port": 1},
+                       {"server_id": "w2", "host": "h", "port": 2}]}
+    # w2 carries historical sheds (30 of 100) from long before any window
+    base = {"w1": ("h:1", 0.0, 0, 0, 100), "w2": ("h:2", 0.0, 0, 30, 100)}
+    adv.recommend(fleet_view(base), fleet)
+    clk.advance(30)   # w2's scrape blips for one poll
+    r = adv.recommend(fleet_view(
+        {"w1": ("h:1", 0.0, 0, 0, 110), **{k: base[k] for k in ("w2",)}},
+        failed=("w2",)), fleet)["score"]
+    assert r["desired"] == 2, r
+    clk.advance(30)   # w2 rejoins with its full cumulative history
+    r = adv.recommend(fleet_view(
+        {"w1": ("h:1", 0.0, 0, 0, 120),
+         "w2": ("h:2", 0.0, 0, 30, 105)}), fleet)["score"]
+    assert r["signals"]["shed_rate"] == 0.0, \
+        "lifetime sheds must not read as in-window shed rate"
+    assert r["desired"] == 2 and r["reason"] != "scale_up", r
+
+
+# ------------------------------------------------------------ loadgen gates
+
+def test_check_gates_verdicts():
+    st = {"rps": 500.0, "completed": 100.0, "errors": 2.0, "non_2xx": 3.0,
+          "p50_ms": 1.0, "p99_ms": 9.0}
+    good = check_gates({"p99_ms": 10.0, "max_error_rate": 0.1,
+                        "min_rps": 100.0}, st)
+    assert good["passed"] and not good["failures"]
+    assert good["checks"]["max_error_rate"]["actual"] == \
+        pytest.approx(5.0 / 102.0)
+    bad = check_gates({"p99_ms": 5.0, "max_error_rate": 0.01}, st)
+    assert not bad["passed"] and len(bad["failures"]) == 2
+    with pytest.raises(ValueError):  # a typo'd gate must fail loudly
+        check_gates({"p99ms": 5.0}, st)
+    # a class that completed NOTHING must fail its latency gate, not pass
+    # it vacuously on the 0.0 placeholder percentile
+    dead = {"rps": 0.0, "completed": 0.0, "errors": 8.0, "non_2xx": 0.0,
+            "p50_ms": 0.0, "p99_ms": 0.0}
+    v = check_gates({"p99_ms": 100.0}, dead)
+    assert not v["passed"] and not v["checks"]["p99_ms"]["ok"]
+    # with the intended count known, lost requests (dead client threads)
+    # count per REQUEST, not per thread: 4 clients x 100 dying halfway
+    # is a ~50% error rate, not 4/200
+    half_dead = {"rps": 100.0, "completed": 196.0, "errors": 4.0,
+                 "non_2xx": 0.0, "p50_ms": 1.0, "p99_ms": 2.0,
+                 "intended": 400.0}
+    v = check_gates({"max_error_rate": 0.05}, half_dead)
+    assert not v["passed"]
+    assert v["checks"]["max_error_rate"]["actual"] == \
+        pytest.approx(204.0 / 400.0)
+
+
+def test_mixed_load_gates_pass_and_fail_per_class():
+    srv = PipelineServer(Doubler(), port=0, mode="continuous").start()
+    try:
+        res = mixed_load("127.0.0.1", srv.port, [
+            {"name": "easy", "path": srv.api_path, "body": "1.0",
+             "n_clients": 2, "per_client": 10,
+             "gates": {"p99_ms": 10000.0, "max_error_rate": 0.0}},
+            {"name": "strict", "path": srv.api_path, "body": "2.0",
+             "n_clients": 2, "per_client": 10,
+             "gates": {"p99_ms": 0.0001}},
+            {"name": "ungated", "path": srv.api_path, "body": "3.0",
+             "n_clients": 1, "per_client": 5},
+        ], warm=2)
+        assert res["easy"]["gates"]["passed"]
+        assert not res["strict"]["gates"]["passed"]
+        assert "p99_ms" in res["strict"]["gates"]["failures"][0]
+        assert "gates" not in res["ungated"]
+        assert res["combined"]["non_2xx"] == 0.0
+    finally:
+        srv.stop()
+
+
+# ----------------------------------------------------- E2E (real sockets)
+
+def test_fleet_overload_burns_slo_recommends_scale_up_then_recovers():
+    """ISSUE 11 acceptance: mixed_load overload on a real 2-worker fleet
+    -> /fleet/metrics serves merged worker-labelled families, /fleet/slo
+    reports the burning objective with fast-window burn > 1,
+    /fleet/autoscale recommends scale-up; after drain the verdict recovers
+    and the recommendation decays — SLO windows and autoscale cooldowns on
+    a FakeClock, a dead third worker never blinding any endpoint."""
+    clk = FakeClock()
+    reg = MetricsRegistry()
+    svc = TopologyService(
+        registry=reg, probe_interval_s=None, telemetry_clock=clk,
+        slos=["p99(mmlspark_serving_request_latency_seconds) <= 0.0002"],
+        autoscaler=AutoscaleAdvisor(
+            registry=reg, clock=clk, target_queue_delay_s=0.5,
+            shed_tolerance=0.01, window_s=300.0, cooldown_s=60.0)).start()
+    reg0, reg1 = MetricsRegistry(), MetricsRegistry()
+    w0 = WorkerServer(SlowDoubler(), server_id="w0",
+                      driver_address=svc.address,
+                      port=0, registry=reg0, request_class="score",
+                      max_queue_depth=2).start()
+    w1 = WorkerServer(Doubler(), server_id="w1", driver_address=svc.address,
+                      port=0, registry=reg1, request_class="score").start()
+    _post(f"{svc.address}/register",
+          {"server_id": "dead", "host": "127.0.0.1", "port": 9})
+    try:
+        svc.federation_tick()                       # t=0 baseline sample
+        # overload: two request classes contending for the depth-2 worker,
+        # with the ROADMAP's per-class p99 gate hook exercised for real
+        res = mixed_load("127.0.0.1", w0.server.port, [
+            {"name": "score", "path": "/score", "body": "1.5",
+             "n_clients": 6, "per_client": 25,
+             "gates": {"p99_ms": 0.0001, "max_error_rate": 0.0}},
+            {"name": "decode", "path": "/score", "body": "2.5",
+             "n_clients": 2, "per_client": 10},
+        ], warm=2)
+        assert not res["score"]["gates"]["passed"], \
+            "the overload must fail its per-class gate"
+        for i in range(3):                          # w1 sees light traffic
+            assert _post(w0.server.address.replace(
+                str(w0.server.port), str(w1.server.port)), i) == 2 * i
+        shed_total = reg0.family("mmlspark_serving_requests_total").value(
+            server=f"127.0.0.1:{w0.server.port}", status="shed")
+        assert shed_total > 0, "depth-2 admission must shed under 8 clients"
+
+        clk.advance(60)
+        out = svc.federation_tick()
+        v = out["slo"]["slos"][0]
+        assert v["burning"] and v["burn_rate"]["fast"] > 1, v
+        rec = out["autoscale"]["score"]
+        assert rec["current"] == 2 and rec["desired"] > 2, rec
+        burst_desired = rec["desired"]
+        # the dead worker is a failure row on every surface, never a blind
+        assert out["view"].workers["dead"]["ok"] is False
+        assert reg.family("mmlspark_federation_scrape_total").value(
+            worker="dead", result="error") >= 1
+
+        # the three endpoints over real HTTP, served from the poll result
+        text = urllib.request.urlopen(
+            f"{svc.address}/fleet/metrics?refresh=0", timeout=10
+            ).read().decode()
+        values, types, _ = parse_prometheus(text)
+        addr0 = f"127.0.0.1:{w0.server.port}"
+        assert values[("mmlspark_serving_queue_delay_ewma_seconds",
+                       frozenset([("server", addr0),
+                                  ("worker", "w0")]))] >= 0.0
+        assert values[("mmlspark_serving_requests_total",
+                       frozenset([("server", addr0),
+                                  ("status", "shed")]))] == shed_total
+        assert types["mmlspark_serving_request_latency_seconds"] == \
+            "histogram"
+        assert types["mmlspark_federation_scrape_total"] == "counter"
+        slo_http = json.loads(urllib.request.urlopen(
+            f"{svc.address}/fleet/slo?refresh=0", timeout=10
+            ).read().decode())
+        assert slo_http["slos"][0]["burning"]
+        assert slo_http["workers"]["dead"]["ok"] is False
+        auto_http = json.loads(urllib.request.urlopen(
+            f"{svc.address}/fleet/autoscale?refresh=0", timeout=10
+            ).read().decode())
+        assert auto_http["classes"]["score"]["desired"] == burst_desired
+        # /fleet/slow keeps its breaker semantics next to the new plane
+        slow = json.loads(urllib.request.urlopen(
+            f"{svc.address}/fleet/slow?k=3", timeout=10).read().decode())
+        assert len(slow["slowest"]) > 0
+        assert "fleet-slow:dead" in reg.breakers
+
+        # drain: a little clean traffic, then silence past the fast window
+        for i in range(5):
+            assert _post(w0.server.address, i) == 2 * i
+        clk.advance(60)
+        svc.federation_tick()                       # absorbs drain events
+        clk.advance(400)
+        out = svc.federation_tick()
+        v = out["slo"]["slos"][0]
+        assert v["ok"] and v["burn_rate"]["fast"] == 0.0, v
+        rec = out["autoscale"]["score"]
+        assert rec["desired"] < burst_desired, rec
+        clk.advance(400)
+        out = svc.federation_tick()
+        assert out["autoscale"]["score"]["desired"] <= rec["desired"]
+    finally:
+        w0.stop()
+        w1.stop()
+        svc.stop()

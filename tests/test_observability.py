@@ -31,50 +31,11 @@ from tests.serving_helpers import Doubler
 
 # --------------------------------------------------------------- exposition
 
-def parse_prometheus(text):
-    """Tiny exposition-format parser: returns ({(name, frozenset(labels)):
-    value}, {name: type}, {key: (exemplar_labels, exemplar_value)}).
-    Raises on malformed lines — including malformed OpenMetrics exemplar
-    suffixes (``... # {trace_id="x"} 0.042``) — so the round-trip tests
-    also validate the format itself."""
-    values, types, exemplars = {}, {}, {}
-
-    def parse_labels(rest, line):
-        labels = []
-        for pair in rest.split(","):
-            k, v = pair.split("=", 1)
-            assert v.startswith('"') and v.endswith('"'), line
-            labels.append((k, v[1:-1]))
-        return labels
-
-    for line in text.splitlines():
-        if not line:
-            continue
-        if line.startswith("# TYPE "):
-            _, _, name, kind = line.split(" ", 3)
-            assert kind in ("counter", "gauge", "histogram"), line
-            types[name] = kind
-            continue
-        if line.startswith("#"):
-            assert line.startswith("# HELP ") or line == "# EOF", line
-            continue
-        exemplar = None
-        if " # " in line:  # OpenMetrics exemplar suffix on a bucket line
-            line, _, ex = line.partition(" # ")
-            assert ex.startswith("{"), ex
-            ex_labels, _, ex_val = ex[1:].partition("} ")
-            exemplar = (dict(parse_labels(ex_labels, ex)), float(ex_val))
-        body, sval = line.rsplit(" ", 1)
-        if "{" in body:
-            name, rest = body.split("{", 1)
-            assert rest.endswith("}"), line
-            key = (name, frozenset(parse_labels(rest[:-1], line)))
-        else:
-            key = (body, frozenset())
-        values[key] = float(sval)
-        if exemplar is not None:
-            exemplars[key] = exemplar
-    return values, types, exemplars
+# promoted to the observability package (ISSUE 11): the federation scraper
+# and the round-trip tests must share ONE exposition grammar.  Re-exported
+# here because test_collector (and downstream suites) import it from this
+# module.
+from mmlspark_tpu.observability.federation import parse_prometheus  # noqa: E402,F401
 
 
 def test_prometheus_exposition_round_trip():

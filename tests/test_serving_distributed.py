@@ -200,6 +200,74 @@ def test_streaming_source_sink_round_trip():
         query.stop()
 
 
+def test_fleet_query_validation_rejects_malformed_params_with_400():
+    """ISSUE 11 bugfix: a malformed or negative ``?k=`` (and any malformed
+    param on the fleet endpoints) is a 400 verdict on the request — not a
+    silent default, not a handler 500.  Shared validation across
+    /fleet/slow and the new /fleet/metrics|slo|autoscale params."""
+    import urllib.error
+
+    from mmlspark_tpu.observability import MetricsRegistry
+
+    svc = TopologyService(registry=MetricsRegistry(),
+                          probe_interval_s=None).start()
+    try:
+        for bad in ("/fleet/slow?k=abc", "/fleet/slow?k=-1",
+                    "/fleet/slow?k=1.5", "/fleet/slow?deadline_ms=0",
+                    "/fleet/slow?deadline_ms=nope",
+                    "/fleet/metrics?refresh=2",
+                    "/fleet/metrics?deadline_ms=-5",
+                    "/fleet/slo?refresh=maybe",
+                    "/fleet/autoscale?refresh=yes"):
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(f"{svc.address}{bad}", timeout=10)
+            assert exc.value.code == 400, bad
+            assert "bad query param" in json.loads(
+                exc.value.read().decode())["error"]
+        # well-formed values still serve (unknown params stay ignored)
+        for ok in ("/fleet/slow?k=3&deadline_ms=1500", "/fleet/slow?novel=1",
+                   "/fleet/metrics?refresh=1", "/fleet/slo?refresh=0",
+                   "/fleet/autoscale"):
+            with urllib.request.urlopen(f"{svc.address}{ok}", timeout=10) as r:
+                assert r.status == 200, ok
+    finally:
+        svc.stop()
+
+
+def test_aggregate_stats_surfaces_checkpoint_age_fleet_wide():
+    """ISSUE 11 satellite: a checkpointing worker's
+    ``checkpoint_last_success_age_seconds`` (max across its sites — one
+    stalled site is an outage) rides its /stats and surfaces per worker in
+    ``aggregate_stats()`` with a fleet-level max, so "checkpoints stopped
+    landing" pages at the fleet, not per box."""
+    from mmlspark_tpu.observability import MetricsRegistry
+
+    reg_svc, reg0, reg1 = (MetricsRegistry() for _ in range(3))
+    # w0 checkpoints: a last-success-age gauge with two sites, one stalled
+    age = reg0.gauge("mmlspark_checkpoint_last_success_age_seconds",
+                     "age", labels=("site",))
+    age.set(12.5, site="gbdt")
+    age.set(900.0, site="dnn")
+    svc = TopologyService(registry=reg_svc, probe_interval_s=None).start()
+    workers = [WorkerServer(Doubler(), server_id=f"w{i}",
+                            driver_address=svc.address, port=0,
+                            registry=reg).start()
+               for i, reg in ((0, reg0), (1, reg1))]
+    try:
+        agg = svc.aggregate_stats()
+        assert agg["checkpoint_last_success_age_seconds"] == {"w0": 900.0}
+        assert agg["checkpoint_max_last_success_age_seconds"] == 900.0
+        # the non-checkpointing worker reports nothing rather than a fake 0
+        assert "checkpoint_last_success_age_seconds" not in \
+            agg["workers"]["w1"]
+        assert agg["workers"]["w0"][
+            "checkpoint_last_success_age_seconds"] == 900.0
+    finally:
+        for w in workers:
+            w.stop()
+        svc.stop()
+
+
 # ---------------------------------------------------------------- multi-proc
 
 def _serving_worker(mesh, process_id, driver_addr, model_cls=Doubler):

@@ -326,6 +326,80 @@ def test_runner_books_front_and_decode_metrics():
             f"ModelRunner no longer registers {family}"
 
 
+def test_federation_surface_is_instrumented():
+    """ISSUE 11 coverage: the fleet telemetry plane watches the workers,
+    so the registry must watch the fleet plane.  Source-level (like the
+    collector sweep): the scrape path must book per-worker outcomes, sweep
+    latency, and the bucket-mismatch counter; the SLO evaluator must book
+    burn/budget gauges and the ``slo_burn`` ring transition; the autoscale
+    recompute must book the desired-replica gauge and the per-direction
+    counter.  Live: constructing a TopologyService registers every fleet
+    family — federator + SLO + autoscale instruments."""
+    from mmlspark_tpu.observability import (MetricsRegistry, autoscale,
+                                            federation, slo)
+    from mmlspark_tpu.serving import TopologyService
+
+    scrape_src = inspect.getsource(federation.MetricsFederator.scrape_once)
+    for needle in ('_m["scrapes"]', '_m["scrape_seconds"]',
+                   '_m["bucket_mismatch"]'):
+        assert needle in scrape_src, f"scrape_once() lost {needle}"
+    eval_src = inspect.getsource(slo.SLOEngine.evaluate)
+    for needle in ('_m["burn_rate"]', '_m["budget_remaining"]',
+                   '"slo_burn"', "log_event"):
+        assert needle in eval_src, f"SLOEngine.evaluate() lost {needle}"
+    rec_src = inspect.getsource(autoscale.AutoscaleAdvisor.recommend)
+    for needle in ('_m["desired"]', '_m["recommendations"]'):
+        assert needle in rec_src, f"AutoscaleAdvisor.recommend() lost {needle}"
+
+    reg = MetricsRegistry()
+    TopologyService(registry=reg, probe_interval_s=None)  # never started
+    for family in ("mmlspark_federation_scrape_total",
+                   "mmlspark_federation_scrape_seconds",
+                   "mmlspark_federation_stale_workers",
+                   "mmlspark_federation_bucket_mismatch_total",
+                   "mmlspark_slo_burn_rate",
+                   "mmlspark_slo_budget_remaining",
+                   "mmlspark_autoscale_desired_replicas",
+                   "mmlspark_autoscale_recommendations_total"):
+        assert reg.family(family) is not None, \
+            f"TopologyService no longer registers {family}"
+
+
+def test_topology_endpoint_sweep():
+    """Every HTTP endpoint the TopologyService handler serves must appear
+    in the declared ``TOPOLOGY_ENDPOINTS`` table (and vice versa): a new
+    endpoint cannot land unlisted — the table is what the docs, the
+    query-validation tests, and this sweep all key off.  Live half: every
+    declared parameterless GET answers non-404 on a real socket."""
+    import json
+    import urllib.request
+
+    from mmlspark_tpu.serving import TopologyService
+    from mmlspark_tpu.serving.distributed import TOPOLOGY_ENDPOINTS
+
+    svc = TopologyService(probe_interval_s=None)
+    handler_src = inspect.getsource(svc._make_handler)
+    # literal paths compared/prefixed in the handler, normalized: the
+    # prefix-matched "/flag/" read is declared as "/flag/<key>"
+    import re
+    literals = set(re.findall(r'"(/[a-z/]+)"', handler_src))
+    normalized = {"/flag/<key>" if p == "/flag/" else p for p in literals}
+    declared = {p for paths in TOPOLOGY_ENDPOINTS.values() for p in paths}
+    assert normalized == declared, (
+        f"handler endpoints {sorted(normalized)} drifted from the declared "
+        f"table {sorted(declared)} — update TOPOLOGY_ENDPOINTS (and docs/"
+        "serving.md) with the change")
+
+    svc.start()
+    try:
+        for path in TOPOLOGY_ENDPOINTS["GET"]:
+            url = f"{svc.address}{path.replace('<key>', 'sweep')}"
+            with urllib.request.urlopen(url, timeout=10) as r:
+                assert r.status == 200, f"{path} -> {r.status}"
+    finally:
+        svc.stop()
+
+
 def test_every_stage_routes_verbs_through_log_verb():
     classes = all_stage_classes()
     assert len(classes) >= 80, f"only {len(classes)} stages discovered"
