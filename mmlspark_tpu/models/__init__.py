@@ -2,9 +2,9 @@ from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101
 from .bilstm import BiLSTMTagger, LSTMLayer
 from .transformer import TransformerEncoder, EncoderBlock, MultiHeadAttention
 from .gbdt import GBDTBooster
-from .runner import ModelRunner, DecodeResult, bucket_rows
+from .runner import ModelRunner, DecodeResult, PagePool, bucket_rows
 
 __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
            "BiLSTMTagger", "LSTMLayer", "TransformerEncoder", "EncoderBlock",
            "MultiHeadAttention", "GBDTBooster", "ModelRunner", "DecodeResult",
-           "bucket_rows"]
+           "PagePool", "bucket_rows"]

@@ -19,10 +19,23 @@ executable, and serves it behind two fronts:
 
 On top of it, generative scoring is a first-class workload:
 :meth:`ModelRunner.decode` runs a KV-cached batched decode loop — one
-prefill executable per (batch bucket, prompt bucket, cache length) plus ONE
-single-token step executable re-dispatched every token, with per-sequence
-lengths so ragged prompts decode exactly (``models/transformer.py`` owns
-the cache math; docs/runner.md states the correctness argument).
+prefill executable per (batch bucket, prompt bucket, cache geometry) plus
+ONE single-token step executable re-dispatched every token, with
+per-sequence lengths so ragged prompts decode exactly
+(``models/transformer.py`` owns the cache math; docs/runner.md states the
+correctness argument).  ISSUE 12 rebuilt the decode memory model: the step
+executables DONATE the cache (and finished-mask) buffers so per-token
+dispatch updates slots in place instead of allocating a fresh cache per
+layer per token; the default greedy/eos path samples + freezes on device
+(one (B,) token fetch per step, never the (B, V) logits); and
+``kv_layout="paged"`` replaces the dense per-sequence reservation with
+fixed-size pages from a shared :class:`PagePool` plus a per-sequence page
+table, so hundreds of concurrent sequences share cache HBM by ACTUAL
+length — the serving pattern the TPU-vs-GPU Gemma study in PAPERS.md
+benchmarks, and the memory substrate the continuous-batching ROADMAP item
+admits requests into.  The paged step is keyed on (batch bucket, page
+size, table width): cache length stops being a compile key, collapsing the
+per-``cache_len`` executable fan-out.
 
 Lowering contract (the lower-once/execute-many precedent is the Julia→TPU
 full-compilation work, PAPERS arxiv 1810.09868): every executable is keyed
@@ -42,7 +55,7 @@ import numpy as np
 from ..core import DataFrame, Transformer
 from ..core.schema import ColumnType
 
-__all__ = ["ModelRunner", "DecodeResult", "bucket_rows"]
+__all__ = ["ModelRunner", "DecodeResult", "PagePool", "bucket_rows"]
 
 #: fronts a batch can arrive through; metric label values
 FRONTS = ("transform", "serving", "decode")
@@ -67,16 +80,236 @@ def _pad_rows(x: np.ndarray, target: int) -> np.ndarray:
     return np.concatenate([x, pad], axis=0)
 
 
+def _greedy_freeze(logits, finished, eos_id):
+    """On-device greedy sampling + eos freeze — the ONE copy of the rule
+    shared by the fused decode step and the prefill sampler: frozen
+    sequences keep emitting ``eos_id``, and emitting it freezes."""
+    import jax.numpy as jnp
+    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if eos_id is not None:
+        tok = jnp.where(finished, eos_id, tok)
+        finished = finished | (tok == eos_id)
+    return tok, finished
+
+
+def _cached_apply(module, variables, toks, positions, table, cache):
+    """One call shape for every decode executable: ``table`` is ``None`` on
+    the dense layout (an empty pytree — part of the jit signature, no
+    tracing cost) and the kwarg is withheld so modules that only know
+    ``init_cache`` keep working."""
+    kw = {} if table is None else {"page_table": table}
+    return module.apply(variables, toks, positions=positions,
+                        kv_cache=cache, **kw)
+
+
 @dataclass
 class DecodeResult:
     """One batched decode: ``tokens[b, t]`` is the t-th generated token of
     sequence b; ``logits`` (collect_logits=True) holds the distribution
     that produced each token; ``steps`` counts device dispatches (prefill
-    excluded); ``lengths`` echoes the prompt lengths the loop honoured."""
+    excluded); ``lengths`` echoes the prompt lengths the loop honoured;
+    ``extras`` surfaces the resolved cache geometry — kv_layout,
+    real_tokens (unfrozen steps only), cache_bytes_per_seq, and for the
+    paged layout page_size / table_width / pages_peak /
+    page_occupancy_pct — so callers (``mixed_load``'s decode class, the
+    bench A/B) can report tokens/sec against the memory the decode
+    actually held."""
     tokens: np.ndarray                 # (B, T) int32
     lengths: np.ndarray                # (B,) prompt lengths
     steps: int
     logits: Optional[np.ndarray] = None  # (B, T, V) float32
+    extras: Optional[Dict[str, Any]] = None
+
+
+class PagePool:
+    """Fixed-size KV-cache page allocator — the shared-HBM memory model
+    behind ``ModelRunner.decode(kv_layout="paged")`` (ISSUE 12 tentpole).
+
+    The pool owns ``num_pages`` pages of ``page_size`` token slots each,
+    materialized on device as ``module.init_paged_cache`` slabs of
+    ``(num_pages, page_size, heads, head_dim)`` per layer, plus the
+    host-side free list that hands pages to sequences: allocate by TRUE
+    prompt length at prefill, extend one page at a time when a decode
+    frontier crosses a page boundary, free on eos/completion.  Page 0 is
+    the reserved trash page (pad rows and unallocated table entries point
+    there; it is never handed out), so ``capacity == num_pages - 1``.
+    Sequences therefore share cache HBM by actual length instead of
+    reserving ``batch × max_len`` slots each — the occupancy and
+    high-water gauges make the claim observable on ``/metrics``.
+
+    The device slabs are BORROWED by one decode loop at a time (the step
+    executables donate them in place, so two concurrent borrowers would
+    consume each other's buffers); :meth:`borrow_cache` blocks until the
+    previous borrower returns.  The accounting half (allocate/extend/free/
+    occupancy) is lock-protected and usable standalone — sizing studies
+    never have to build device slabs.
+    """
+
+    #: booking ops — each books pages moved, not call count
+    OPS = ("allocate", "extend", "free")
+
+    def __init__(self, module=None, num_pages: int = 0, page_size: int = 64,
+                 *, name: str = "pool", registry=None):
+        if num_pages < 2:
+            raise ValueError(f"num_pages {num_pages} < 2: page 0 is the "
+                             "reserved trash page, so a usable pool needs "
+                             "at least one allocatable page")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.module = module
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self._name = name
+        #: free physical pages; page 0 (trash) is never in this list
+        self._free = list(range(self.num_pages - 1, 0, -1))
+        self._cond = threading.Condition(threading.Lock())
+        self._cache = None          # built lazily, rebuilt if dropped
+        self._cache_nbytes = 0
+        self._borrowed = False
+        self.high_water = 0
+        #: True when the owning runner sized this pool implicitly (from a
+        #: decode's worst case) — such pools may be grown for a larger
+        #: batch; an explicitly budgeted pool is never resized behind the
+        #: caller's back
+        self.auto_sized = False
+        from ..observability import get_registry
+        reg = registry if registry is not None else get_registry()
+        self._registry = reg
+        # page_size is in the label set because one runner keeps a pool
+        # PER page size — without it the pools would stomp one another's
+        # occupancy series
+        ops = reg.counter(
+            "mmlspark_runner_page_ops_total",
+            "KV page-pool pages moved by op (allocate/extend/free)",
+            labels=("runner", "page_size", "op"))
+        self._c_ops = {op: ops.labels(runner=name,
+                                      page_size=str(self.page_size), op=op)
+                       for op in self.OPS}
+        self._g_used = reg.gauge(
+            "mmlspark_runner_page_pool_used_pages",
+            "KV pages currently held by live sequences",
+            labels=("runner", "page_size"))
+        self._g_hw = reg.gauge(
+            "mmlspark_runner_page_pool_high_water_pages",
+            "max KV pages ever simultaneously held",
+            labels=("runner", "page_size"))
+        self._book("allocate", 0)   # gauges live from construction
+
+    # ---------------------------------------------------------- accounting
+    @property
+    def capacity(self) -> int:
+        """Allocatable pages (the trash page is not allocatable)."""
+        return self.num_pages - 1
+
+    def token_capacity(self) -> int:
+        """Total token slots the pool can hold across all sequences."""
+        return self.capacity * self.page_size
+
+    def pages_in_use(self) -> int:
+        return self.capacity - len(self._free)
+
+    def occupancy_pct(self) -> float:
+        return 100.0 * self.pages_in_use() / max(self.capacity, 1)
+
+    def _book(self, op: str, n: int) -> None:
+        """Book one pool operation: the op counter plus the occupancy and
+        high-water gauges (called under the pool lock)."""
+        used = self.pages_in_use()
+        if used > self.high_water:
+            self.high_water = used
+        self._c_ops[op].inc(n)
+        ps = str(self.page_size)
+        self._g_used.set(float(used), runner=self._name, page_size=ps)
+        self._g_hw.set(float(self.high_water), runner=self._name,
+                       page_size=ps)
+
+    def allocate(self, n: int, op: str = "allocate"):
+        """Hand out ``n`` pages (prefill sizing: ``ceil(true_len / page_
+        size)`` per sequence).  Raises when the budget is exhausted —
+        admission control, not silent overcommit."""
+        with self._cond:
+            if n > len(self._free):
+                raise RuntimeError(
+                    f"page pool exhausted: need {n} page(s), "
+                    f"{len(self._free)} free of {self.capacity} "
+                    f"(page_size={self.page_size}) — free finished "
+                    "sequences, shrink the batch, or size the pool larger")
+            pages = [self._free.pop() for _ in range(n)]
+            self._book(op, n)
+            return pages
+
+    def extend(self, n: int = 1):
+        """Allocate at a decode page-boundary crossing (same free list,
+        booked as ``op="extend"`` so growth is attributable)."""
+        return self.allocate(n, op="extend")
+
+    def free(self, pages) -> None:
+        """Return pages to the pool (eos/completion).  Freed pages are not
+        zeroed: stale k/v in a reused page sits past the new owner's
+        frontier until overwritten, so it is never admissible."""
+        pages = [int(p) for p in pages]
+        if any(p <= 0 or p >= self.num_pages for p in pages):
+            raise ValueError(f"free() of invalid page in {pages} "
+                             "(page 0 is the reserved trash page)")
+        with self._cond:
+            self._free.extend(pages)
+            self._book("free", len(pages))
+
+    # ------------------------------------------------------- device slabs
+    def page_nbytes(self) -> int:
+        """Device bytes per page across all layers (0 until slabs built)."""
+        return self._cache_nbytes // self.num_pages if self._cache_nbytes \
+            else 0
+
+    def borrow_cache(self):
+        """Take exclusive ownership of the device slabs (building them on
+        first use), blocking while another decode holds them — the step
+        executables donate the buffers, so exactly one loop may own them."""
+        if self.module is None:
+            raise TypeError("this PagePool was built without a module — "
+                            "accounting only, no device slabs")
+        with self._cond:
+            while self._borrowed:
+                self._cond.wait()
+            self._borrowed = True
+            cache = self._cache
+            self._cache = None
+        if cache is None:
+            try:
+                cache = self.module.init_paged_cache(self.num_pages,
+                                                     self.page_size)
+                import jax
+                self._cache_nbytes = sum(
+                    int(l.nbytes) for l in jax.tree_util.tree_leaves(cache))
+            except Exception:
+                # a failed slab build (HBM exhaustion) must not leave the
+                # pool borrowed forever — every later borrower would block
+                self.return_cache(None)
+                raise
+        return cache
+
+    def resized(self, num_pages: int) -> "PagePool":
+        """A fresh pool with the same module/page size/metric identity but
+        ``num_pages`` pages.  Refuses while sequences hold pages or a
+        decode holds the slabs — resizing would orphan them."""
+        with self._cond:
+            if self._borrowed or self.pages_in_use():
+                raise RuntimeError(
+                    f"cannot resize a busy page pool ({self.pages_in_use()} "
+                    "page(s) held, borrowed="
+                    f"{self._borrowed}) — wait for in-flight decodes")
+        pool = PagePool(self.module, num_pages, self.page_size,
+                        name=self._name, registry=self._registry)
+        pool.auto_sized = self.auto_sized
+        return pool
+
+    def return_cache(self, cache) -> None:
+        """Give the slabs back (pass ``None`` after a failed loop — the
+        donated buffer state is unknown, so the next borrower rebuilds)."""
+        with self._cond:
+            self._borrowed = False
+            self._cache = cache
+            self._cond.notify()
 
 
 class ModelRunner:
@@ -152,8 +385,26 @@ class ModelRunner:
             labels=("runner",)).labels(runner=name)
         self._c_decode_tokens = reg.counter(
             "mmlspark_runner_decode_tokens_total",
-            "tokens generated (real sequences only)",
+            "per-sequence real generated tokens (unfrozen steps only; "
+            "eos-frozen tails and pad rows are not generated work)",
             labels=("runner",)).labels(runner=name)
+        # page-pool surface (paged decode): families registered at
+        # construction so the telemetry-coverage sweep gates on them even
+        # for runners that never decode; PagePool binds the children
+        # (page_size in the labels: one runner keeps a pool per page size)
+        reg.counter("mmlspark_runner_page_ops_total",
+                    "KV page-pool pages moved by op (allocate/extend/free)",
+                    labels=("runner", "page_size", "op"))
+        reg.gauge("mmlspark_runner_page_pool_used_pages",
+                  "KV pages currently held by live sequences",
+                  labels=("runner", "page_size"))
+        reg.gauge("mmlspark_runner_page_pool_high_water_pages",
+                  "max KV pages ever simultaneously held",
+                  labels=("runner", "page_size"))
+        #: (device key, page size) -> shared PagePool for paged decode
+        self._pools: Dict[Tuple, PagePool] = {}
+        #: resolved geometry of the most recent decode (DecodeResult.extras)
+        self.last_decode_extras: Optional[Dict[str, Any]] = None
 
     # ------------------------------------------------------------- lowering
     @staticmethod
@@ -260,59 +511,179 @@ class ModelRunner:
         default ``np.asarray(..., float32)``) and scores them through
         :meth:`apply_batch`; ``mode="decode"`` treats each request as a
         token-id prompt and returns generated token lists from
-        :meth:`decode` (``decode_kwargs`` forward, e.g.
-        ``max_new_tokens=``).  The server's continuous-mode drain is the
-        admission window: whatever is in flight when the scorer runs
-        becomes ONE bucketed device batch."""
+        :meth:`decode` (``decode_kwargs`` forward — ``max_new_tokens=``,
+        ``eos_id=``, and the cache layout: ``kv_layout="paged"`` with
+        ``page_size=``/``pool=`` serves the drain from shared page-pool
+        HBM by actual sequence length, instead of the dense per-sequence
+        max-length reservation; the resolved geometry rides
+        ``DecodeResult.extras`` / ``runner.last_decode_extras`` so
+        ``mixed_load``'s decode class can report tokens/sec against it).
+        The server's continuous-mode drain is the admission window:
+        whatever is in flight when the scorer runs becomes ONE bucketed
+        device batch."""
         if mode not in ("score", "decode"):
             raise ValueError("scorer mode must be score|decode")
         return _RunnerScorer(self, input_col, reply_col, prepare, encode,
                              mode, decode_kwargs)
 
     # ------------------------------------------------------------ decode front
+    def page_pool(self, page_size: int = 64,
+                  num_pages: Optional[int] = None) -> Optional["PagePool"]:
+        """The runner's shared :class:`PagePool` for ``page_size`` —
+        created on first use (sized by ``num_pages``; a paged decode
+        without an explicit pool sizes it to its own worst case and grows
+        it for larger batches) and reused by every later paged decode at
+        this page size, so the occupancy/high-water gauges describe the
+        shared cache HBM, not one call.  Passing ``num_pages`` when a pool
+        already exists RESIZES it (the explicit-budget escape hatch;
+        raises while sequences hold pages).  Returns ``None`` when no pool
+        exists yet and ``num_pages`` was not given."""
+        key = (self._device_key(), int(page_size))
+        with self._lock:
+            pool = self._pools.get(key)
+            if num_pages is not None:
+                if pool is None:
+                    pool = self._pools[key] = PagePool(
+                        self.module, num_pages, page_size, name=self.name,
+                        registry=self.registry)
+                elif pool.num_pages != int(num_pages):
+                    pool = self._pools[key] = pool.resized(int(num_pages))
+                pool.auto_sized = False
+            return pool
+
+    def _auto_pool(self, page_size: int, need_pages: int) -> PagePool:
+        """The implicit pool for a paged decode that brought no budget:
+        create at this call's worst case, or GROW an earlier auto-sized
+        pool that a larger batch has outrun (an explicitly budgeted pool
+        is never resized — its exhaustion is admission control).  Growth
+        is best-effort: if another decode holds pages right now, the
+        existing pool serves and may legitimately run out."""
+        key = (self._device_key(), int(page_size))
+        with self._lock:
+            pool = self._pools.get(key)
+            if pool is None:
+                pool = self._pools[key] = PagePool(
+                    self.module, need_pages, page_size, name=self.name,
+                    registry=self.registry)
+                pool.auto_sized = True
+            elif pool.auto_sized and pool.num_pages < need_pages:
+                try:
+                    pool = self._pools[key] = pool.resized(need_pages)
+                except RuntimeError:
+                    pass                      # busy: keep the current pool
+            return pool
+
     def _decode_executables(self, batch_b: int, prompt_b: int,
-                            cache_len: int):
-        """(prefill, step) executables for one decode signature.  Prefill is
-        keyed by (batch bucket, prompt bucket, cache length); the step by
-        (batch bucket, cache length) only — its input shapes are constant
-        across the whole generation loop, so EVERY token of EVERY request
-        at this signature re-dispatches one compiled program."""
+                            cache_len: Optional[int] = None, *,
+                            page_size: Optional[int] = None,
+                            table_w: Optional[int] = None,
+                            fused: bool = False,
+                            eos_id: Optional[int] = None):
+        """(prefill, step) executables for one decode signature.
+
+        Dense: prefill keys on (batch bucket, prompt bucket, cache length),
+        the step on (batch bucket, cache length) only.  Paged: prefill keys
+        on (batch bucket, prompt bucket, page size, table width) and the
+        step on (batch bucket, page size, table width) — cache LENGTH is no
+        longer a compile key, so decode signatures that differ only in
+        reservation collapse onto one step executable.  Either way the
+        step's input shapes are constant across the whole generation loop:
+        EVERY token of EVERY request at the signature re-dispatches one
+        compiled program.
+
+        Donation contract (ISSUE 12): prefill donates the cache buffers it
+        consumes, and the step donates the cache (and, on the fused path,
+        the finished mask) so the per-token dispatch updates slots in place
+        instead of allocating a fresh (B, S, H, D) per layer per token.
+        The host loop must treat every donated argument as CONSUMED — it
+        rebinds ``cache``/``finished`` from the step's outputs and never
+        touches the stale references (the donation-safety regression test
+        pins this).  ``fused=True`` builds the greedy/eos fast-path step
+        that samples + freezes on device and returns the (B,) next token
+        instead of (B, V) logits; ``eos_id`` is baked into that executable
+        (part of its key — low-cardinality by construction)."""
         import jax.numpy as jnp
         module = self.module
         dkey = self._device_key()
-        kp = ("prefill", dkey, batch_b, prompt_b, cache_len)
-        ks = ("step", dkey, batch_b, cache_len)
+        paged = page_size is not None
+        if paged:
+            kp = ("prefill_paged", dkey, batch_b, prompt_b, page_size,
+                  table_w)
+            ks = ("step_paged", dkey, batch_b, page_size, table_w)
+        else:
+            kp = ("prefill", dkey, batch_b, prompt_b, cache_len)
+            ks = ("step", dkey, batch_b, cache_len)
+        if fused:
+            ks = ks + ("fused", eos_id)
         prefill = self._executables.get(kp)
         step = self._executables.get(ks)
         if prefill is not None and step is not None:
             return prefill, step
+        sfx = "_paged" if paged else ""
         with self._lock:
             prefill = self._executables.get(kp)
             if prefill is None:
-                def _prefill(variables, toks, positions, lengths, cache,
-                             _m=module):
-                    logits, cache = _m.apply(variables, toks,
-                                             positions=positions,
-                                             kv_cache=cache)
+                def _prefill(variables, toks, positions, lengths, table,
+                             cache, _m=module):
+                    logits, cache = _cached_apply(_m, variables, toks,
+                                                  positions, table, cache)
                     # last REAL token's logits per sequence — gathered
-                    # on-device so the (B, P, V) tensor never crosses to host
+                    # on-device so the (B, P, V) tensor never crosses to
+                    # host
                     last = jnp.take_along_axis(
                         logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
                     return last, cache
 
                 prefill = self._executables[kp] = self._instrumented(
-                    _prefill, suffix=".prefill")
+                    _prefill, suffix=f".prefill{sfx}", donate_argnums=(5,))
             step = self._executables.get(ks)
             if step is None:
-                def _step(variables, tok, positions, cache, _m=module):
-                    logits, cache = _m.apply(variables, tok,
-                                             positions=positions,
-                                             kv_cache=cache)
-                    return logits[:, 0], cache
+                if fused:
+                    def _step(variables, tok, positions, table, finished,
+                              cache, _m=module, _eos=eos_id):
+                        logits, cache = _cached_apply(
+                            _m, variables, tok[:, None], positions[:, None],
+                            table, cache)
+                        nxt, finished = _greedy_freeze(logits[:, 0],
+                                                       finished, _eos)
+                        return nxt, finished, cache
 
-                step = self._executables[ks] = self._instrumented(
-                    _step, suffix=".decode_step")
+                    step = self._instrumented(
+                        _step, suffix=f".decode_step{sfx}",
+                        donate_argnums=(4, 5))
+                else:
+                    def _step(variables, tok, positions, table, cache,
+                              _m=module):
+                        logits, cache = _cached_apply(_m, variables, tok,
+                                                      positions, table,
+                                                      cache)
+                        return logits[:, 0], cache
+
+                    step = self._instrumented(
+                        _step, suffix=f".decode_step{sfx}",
+                        donate_argnums=(4,))
+                self._executables[ks] = step
         return prefill, step
+
+    def _sample_executable(self, batch_b: int, eos_id: Optional[int]):
+        """On-device greedy sampler for the fused fast path: argmax + eos
+        freeze without the (B, V) prefill logits ever crossing to host.
+        Donates the finished mask (aliased to the output mask); the logits
+        have no same-shaped output to alias, so donating them would only
+        warn."""
+        key = ("sample", self._device_key(), batch_b, eos_id)
+        fn = self._executables.get(key)
+        if fn is not None:
+            return fn
+        with self._lock:
+            fn = self._executables.get(key)
+            if fn is None:
+                def _sample(last, finished, _eos=eos_id):
+                    return _greedy_freeze(last, finished, _eos)
+
+                fn = self._executables[key] = self._instrumented(
+                    _sample, suffix=".decode_sample", donate_argnums=(1,))
+        return fn
 
     def decode(self, prompts: np.ndarray, lengths=None,
                max_new_tokens: int = 16, eos_id: Optional[int] = None,
@@ -320,25 +691,52 @@ class ModelRunner:
                collect_logits: bool = False,
                batch_bucket: Optional[int] = None,
                prompt_bucket: Optional[int] = None,
-               cache_len: Optional[int] = None) -> DecodeResult:
+               cache_len: Optional[int] = None,
+               kv_layout: str = "dense",
+               page_size: int = 64,
+               pool: Optional[PagePool] = None) -> DecodeResult:
         """KV-cached batched autoregressive generation.
 
         ``prompts`` is ``(B, P)`` int32 (rows padded to the longest prompt);
         ``lengths`` gives each sequence's true prompt length so ragged
         batches decode exactly — each sequence writes and reads the cache at
-        ITS own frontier.  Buckets: ``B`` pads to a power-of-two row bucket,
-        ``P`` to a power-of-two prompt bucket, and the cache length defaults
-        to the next power of two covering prompt + new tokens — three static
-        shapes, so one prefill compile and one step compile serve every
-        request at the signature.  ``sample_fn(logits) -> tokens`` defaults
-        to greedy argmax; ``eos_id`` freezes finished sequences (and ends
-        the loop early once ALL are finished)."""
+        ITS own frontier.  Buckets: ``B`` pads to a power-of-two row bucket
+        and ``P`` to a power-of-two prompt bucket.
+
+        Cache memory (``kv_layout``): ``"dense"`` reserves one
+        ``(cache_len,)`` slot row per sequence up front (``cache_len``
+        defaults to the next power of two covering prompt + new tokens);
+        ``"paged"`` allocates fixed-size pages from a shared
+        :class:`PagePool` by ACTUAL length — ``ceil(true_len/page_size)``
+        pages at prefill, one more at each page-boundary crossing, freed on
+        eos — so concurrency scales with the tokens actually held, not
+        ``B × max_len`` (pass ``pool=`` to share an explicitly sized
+        budget; otherwise the runner's implicit pool for ``page_size`` is
+        used, created at this call's worst case and grown when a larger
+        batch outruns it).
+
+        Sampling: ``sample_fn(logits) -> tokens`` defaults to greedy
+        argmax; ``eos_id`` freezes finished sequences (and ends the loop
+        early once ALL are finished).  When ``sample_fn`` is None and
+        ``collect_logits`` is False, sampling + eos freezing run ON DEVICE
+        and the step executables donate the cache/finished buffers: the
+        common path fetches one (B,) token per step instead of the (B, V)
+        logits, and the cache is updated in place instead of reallocated
+        per token.
+
+        Paged + eos caveat: once a frozen row's pages are freed its later
+        logits are unspecified (its tokens are forced to ``eos_id``, and a
+        ``sample_fn``'s output for frozen rows is discarded, so tokens are
+        unaffected).  ``collect_logits=True`` keeps frozen rows' pages
+        live instead, so the recorded distributions match the dense
+        layout within the committed tolerance at every step."""
         if self.module is None or not hasattr(self.module, "init_cache"):
             raise TypeError(
                 "decode() needs a module with init_cache (a KV-cache-capable "
                 "model, e.g. models.TransformerEncoder with causal=True, "
                 "pool='none'); this runner wraps "
                 f"{type(self.module).__name__ if self.module else 'a raw apply_fn'}")
+        import jax
         import jax.numpy as jnp
         prompts = np.asarray(prompts, np.int32)
         if prompts.ndim != 2:
@@ -346,6 +744,9 @@ class ModelRunner:
         B, P = prompts.shape
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if kv_layout not in ("dense", "paged"):
+            raise ValueError("kv_layout must be dense|paged")
+        paged = kv_layout == "paged" or pool is not None
         lengths = (np.full(B, P, np.int32) if lengths is None
                    else np.asarray(lengths, np.int32))
         if lengths.shape != (B,) or lengths.min() < 1 or lengths.max() > P:
@@ -354,57 +755,218 @@ class ModelRunner:
         P_b = prompt_bucket or 1 << (P - 1).bit_length()
         if B_b < B or P_b < P:
             raise ValueError("bucket smaller than the batch/prompt it serves")
-        S = cache_len or 1 << (P_b + max_new_tokens - 1).bit_length()
-        if S < P_b + max_new_tokens:
-            raise ValueError("cache_len must cover prompt_bucket + "
-                             "max_new_tokens")
+        # greedy/eos fast path: sample + freeze on device (donated buffers)
+        fused = sample_fn is None and not collect_logits
         toks = np.zeros((B_b, P_b), np.int32)
         toks[:B, :P] = prompts
         lens = np.concatenate([lengths, np.ones(B_b - B, np.int32)])
         self._c_pad.inc((B_b - B) * P_b + B * (P_b - P))
-        prefill, step = self._decode_executables(B_b, P_b, S)
         variables = self.variables
-        cache = self.module.init_cache(B_b, S)
+
+        table = None
+        seq_pages: list = []
+        if paged:
+            if not hasattr(self.module, "init_paged_cache"):
+                raise TypeError(
+                    "kv_layout='paged' needs a module with init_paged_cache "
+                    "(e.g. models.TransformerEncoder); "
+                    f"{type(self.module).__name__} has none")
+            if cache_len is not None:
+                raise ValueError(
+                    "cache_len is a dense-layout parameter (it sizes the "
+                    "per-sequence reservation); the paged layout sizes "
+                    "cache by pages — use page_size/pool instead")
+            if pool is not None:
+                page_size = pool.page_size
+            page_size = int(page_size)
+            if page_size < 1:
+                raise ValueError("page_size must be >= 1")
+            table_w = -(-(P_b + max_new_tokens) // page_size)
+            max_len = getattr(self.module, "max_len", None)
+            if max_len is not None and P_b + max_new_tokens > max_len:
+                raise ValueError(
+                    f"prompt_bucket + max_new_tokens = "
+                    f"{P_b + max_new_tokens} exceeds the module's max_len "
+                    f"{max_len} (positional table bound)")
+            if pool is None:
+                pool = self._auto_pool(page_size, B_b * table_w + 1)
+            prefill, step = self._decode_executables(
+                B_b, P_b, page_size=page_size, table_w=table_w,
+                fused=fused, eos_id=eos_id)
+            table = np.zeros((B_b, table_w), np.int32)
+            seq_pages = [[] for _ in range(B_b)]
+            try:
+                # allocate by TRUE length — pad rows (and unallocated table
+                # entries) stay on the trash page and never hold pool pages
+                for b in range(B):
+                    n_pages = -(-int(lengths[b]) // page_size)
+                    pgs = pool.allocate(n_pages)
+                    seq_pages[b] = list(pgs)
+                    table[b, :n_pages] = pgs
+                cache = pool.borrow_cache()
+            except Exception:
+                # a failed allocation or slab build must not leak the pages
+                # already handed to earlier rows (borrow_cache resets its
+                # own borrowed flag on failure)
+                leftover = [p for pgs in seq_pages for p in pgs]
+                if leftover:
+                    pool.free(leftover)
+                raise
+            pages_prefill = sum(len(p) for p in seq_pages)
+            peak_pages = pool.pages_in_use()
+        else:
+            S = cache_len or 1 << (P_b + max_new_tokens - 1).bit_length()
+            if S < P_b + max_new_tokens:
+                raise ValueError(
+                    f"cache_len {S} is below prompt_bucket + max_new_tokens "
+                    f"= {P_b + max_new_tokens}: the dense layout reserves "
+                    "one full (cache_len,) slot row per sequence up front, "
+                    "so the reservation must cover the longest possible "
+                    "generation — raise cache_len, or switch to "
+                    "kv_layout='paged' to size by actual length instead")
+            prefill, step = self._decode_executables(
+                B_b, P_b, cache_len=S, fused=fused, eos_id=eos_id)
+            cache = self.module.init_cache(B_b, S)
+            cache_nbytes = sum(int(l.nbytes)
+                               for l in jax.tree_util.tree_leaves(cache))
         positions = np.broadcast_to(np.arange(P_b, dtype=np.int32),
                                     (B_b, P_b))
-        last, cache = prefill(variables, jnp.asarray(toks),
-                              jnp.asarray(positions), jnp.asarray(lens),
-                              cache)
-        self._c_batches["decode"].inc()
         sample = sample_fn or (lambda lg: np.argmax(lg, axis=-1))
         out_tokens = np.zeros((B_b, max_new_tokens), np.int32)
         out_logits = [] if collect_logits else None
         # pad rows are born finished: their garbage samples must never hold
-        # the eos early-exit open (or inflate the step counters)
+        # the eos early-exit open (or inflate the step/token counters)
         finished = np.zeros(B_b, bool)
         finished[B:] = True
         steps = 0
-        for t in range(max_new_tokens):
-            lg = np.asarray(last)                      # (B_b, V) host fetch
-            if collect_logits:
-                out_logits.append(lg)
-            tok = np.asarray(sample(lg), np.int32)
-            if eos_id is not None:
-                tok = np.where(finished, eos_id, tok)
-                finished |= tok == eos_id
-            out_tokens[:, t] = tok
-            if t == max_new_tokens - 1 or \
-                    (eos_id is not None and bool(finished.all())):
-                break
-            # token t sits at absolute position lengths + t; the step
-            # writes it at that frontier and returns logits for t+1
-            pos = (lens + t).astype(np.int32)[:, None]
-            last, cache = step(variables, jnp.asarray(tok[:, None]),
-                               jnp.asarray(pos), cache)
-            steps += 1
-            self._c_decode_steps.inc()
+        real_tokens = 0
+        ok = False
+        # every executable shares one signature; table is None (an empty
+        # pytree) on the dense layout, and the device copy is re-uploaded
+        # only when extend/free dirties it
+        table_dev = jnp.asarray(table) if paged else None
+        table_dirty = False
+        try:
+            last, cache = prefill(
+                variables, jnp.asarray(toks), jnp.asarray(positions),
+                jnp.asarray(lens), table_dev, cache)
+            self._c_batches["decode"].inc()
+            if fused:
+                tok_d, fin_d = self._sample_executable(B_b, eos_id)(
+                    last, jnp.asarray(finished))
+            for t in range(max_new_tokens):
+                if fused:
+                    # the ONLY host fetches on the fast path: the (B,) token
+                    # ids + (B,) finished flags; logits stay on device
+                    tok = np.asarray(tok_d)
+                    fin_now = np.asarray(fin_d)
+                else:
+                    lg = np.asarray(last)                  # (B_b, V) fetch
+                    if collect_logits:
+                        out_logits.append(lg)
+                    tok = np.asarray(sample(lg), np.int32)
+                    if eos_id is not None:
+                        tok = np.where(finished, eos_id, tok)
+                        fin_now = finished | (tok == eos_id)
+                    else:
+                        fin_now = finished
+                # tokens emitted while a sequence was already frozen are eos
+                # padding, not generated work (ISSUE 12 bugfix: the old
+                # B * n_generated charge inflated fleet tokens/sec and the
+                # autoscale signal on early-finishing batches)
+                real_tokens += B - int(finished[:B].sum())
+                out_tokens[:, t] = tok
+                if paged and eos_id is not None and not collect_logits:
+                    # free on eos: pages return to the pool mid-flight; the
+                    # frozen row keeps stepping, but its zeroed table rows
+                    # point every further write at the trash page (its
+                    # post-freeze logits become unspecified — tokens are
+                    # forced to eos either way).  collect_logits keeps
+                    # frozen rows live instead, so the recorded
+                    # distributions match the dense layout exactly.
+                    for b in np.nonzero(fin_now[:B] & ~finished[:B])[0]:
+                        if seq_pages[b]:
+                            pool.free(seq_pages[b])
+                            seq_pages[b] = []
+                            table[b, :] = 0
+                            table_dirty = True
+                finished = fin_now
+                if t == max_new_tokens - 1 or \
+                        (eos_id is not None and bool(finished.all())):
+                    break
+                # token t sits at absolute position lengths + t; the step
+                # writes it at that frontier and returns logits for t+1
+                # (host path) or the sampled token t+1 (fused path)
+                pos = (lens + t).astype(np.int32)
+                if paged:
+                    # extend at page boundaries: the write position must be
+                    # backed by a real page BEFORE the step dispatches.
+                    # Frozen rows stop extending once freed — except under
+                    # collect_logits, where they stay live (logits parity)
+                    for b in range(B):
+                        if finished[b] and not collect_logits:
+                            continue
+                        pi = int(pos[b]) // page_size
+                        if pi >= len(seq_pages[b]):
+                            new_page = pool.extend()[0]
+                            seq_pages[b].append(new_page)
+                            table[b, pi] = new_page
+                            table_dirty = True
+                    peak_pages = max(peak_pages, pool.pages_in_use())
+                    if table_dirty:
+                        # re-upload only when extend/free actually changed
+                        # the table — steady-state steps reuse the resident
+                        # copy (the table arg is never donated)
+                        table_dev = jnp.asarray(table)
+                        table_dirty = False
+                if fused:
+                    # donated dispatch: fin_d/cache are CONSUMED here — the
+                    # loop rebinds all three outputs and must never touch
+                    # the stale references again
+                    tok_d, fin_d, cache = step(variables, tok_d,
+                                               jnp.asarray(pos), table_dev,
+                                               fin_d, cache)
+                else:
+                    last, cache = step(variables, jnp.asarray(tok[:, None]),
+                                       jnp.asarray(pos[:, None]), table_dev,
+                                       cache)
+                steps += 1
+                self._c_decode_steps.inc()
+            ok = True
+        finally:
+            if paged:
+                leftover = [p for pgs in seq_pages for p in pgs]
+                if leftover:
+                    pool.free(leftover)
+                # after a mid-step failure the donated slab state is
+                # unknown — drop it so the next borrower rebuilds zeros
+                pool.return_cache(cache if ok else None)
         n_generated = t + 1
-        self._c_decode_tokens.inc(B * n_generated)
+        self._c_decode_tokens.inc(real_tokens)
         self._c_rows["decode"].inc(B)
+        extras: Dict[str, Any] = {
+            "kv_layout": "paged" if paged else "dense",
+            "real_tokens": real_tokens,
+            "batch_bucket": B_b,
+        }
+        if paged:
+            extras.update(
+                page_size=page_size, table_width=table_w,
+                pool_pages=pool.capacity, pages_prefill=pages_prefill,
+                pages_peak=peak_pages,
+                page_occupancy_pct=round(
+                    100.0 * peak_pages / max(pool.capacity, 1), 2),
+                cache_bytes_per_seq=pool.page_nbytes() * peak_pages
+                / max(B, 1))
+        else:
+            extras.update(cache_len=S,
+                          cache_bytes_per_seq=cache_nbytes / max(B, 1))
+        self.last_decode_extras = extras
         logits = (np.stack(out_logits, axis=1)[:B] if collect_logits
                   else None)
         return DecodeResult(tokens=out_tokens[:B, :n_generated],
-                            lengths=lengths, steps=steps, logits=logits)
+                            lengths=lengths, steps=steps, logits=logits,
+                            extras=extras)
 
 
 class _RunnerScorer(Transformer):

@@ -20,6 +20,21 @@ Per-sequence write positions make ragged prompts exact: each sequence's new
 k/v land at ITS next slot, and attention masks keys strictly by absolute
 position, so padded prompt tails are overwritten before any real query can
 attend to them (see docs/runner.md, "Decode correctness").
+
+Paged decode (ISSUE 12): the same cached attention also runs over a PAGED
+cache — pool slabs of ``(num_pages, page_size, heads, head_dim)``
+(``init_paged_cache``) addressed through a per-sequence ``page_table``
+(B, W) int32, the serving pattern the TPU-vs-GPU Gemma study in PAPERS.md
+benchmarks.  The write scatters into ``(table[pos // ps], pos % ps)``; the
+read gathers each sequence's pages back into position order, so gathered
+slot s is absolute position s and the SAME strict ``s <= q_pos``
+admissibility mask applies — prefill logits are identical to the dense
+path.  Page 0 is the reserved trash page: pad rows and any write whose
+logical page is unallocated land there (unallocated table entries are 0)
+and no real sequence is ever given it, so garbage writes cannot corrupt
+live pages; pad-tail writes into a sequence's own allocated last page are
+past its frontier and overwritten by decode steps before they become
+admissible, the same argument as dense (docs/runner.md).
 """
 from __future__ import annotations
 
@@ -32,7 +47,7 @@ from ..parallel import ring_attention as ra
 
 
 def _cache_update(cache_kv, k_new, v_new, positions):
-    """Scatter this call's per-token k/v into the cache slots.
+    """Scatter this call's per-token k/v into the dense cache slots.
 
     ``cache_kv`` = (k, v) each (B, S, H, D); ``k_new``/``v_new`` (B, L, H, D);
     ``positions`` (B, L) absolute slot per token — per-sequence, so ragged
@@ -41,6 +56,25 @@ def _cache_update(cache_kv, k_new, v_new, positions):
     bidx = jnp.arange(ck.shape[0])[:, None]            # (B, 1)
     ck = ck.at[bidx, positions].set(k_new.astype(ck.dtype))
     cv = cv.at[bidx, positions].set(v_new.astype(cv.dtype))
+    return ck, cv
+
+
+def _paged_cache_update(cache_kv, k_new, v_new, positions, page_table):
+    """Scatter this call's per-token k/v into shared POOL pages.
+
+    ``cache_kv`` = (k, v) each (num_pages, page_size, H, D) — pool-level,
+    shared by every sequence; ``page_table`` (B, W) int32 maps a sequence's
+    logical page j (absolute positions [j*page_size, (j+1)*page_size)) to
+    its physical pool page.  Unallocated table entries are 0, the reserved
+    trash page, so pad rows and pad-tail prompt positions write garbage
+    into a page no real sequence ever reads."""
+    ck, cv = cache_kv
+    page_size = ck.shape[1]
+    bidx = jnp.arange(page_table.shape[0])[:, None]    # (B, 1)
+    phys = page_table[bidx, positions // page_size]    # (B, L) physical page
+    slot = positions % page_size                       # (B, L) slot in page
+    ck = ck.at[phys, slot].set(k_new.astype(ck.dtype))
+    cv = cv.at[phys, slot].set(v_new.astype(cv.dtype))
     return ck, cv
 
 
@@ -54,7 +88,7 @@ class MultiHeadAttention(nn.Module):
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x, positions=None, kv_cache=None):
+    def __call__(self, x, positions=None, kv_cache=None, page_table=None):
         B, L, _ = x.shape
         H, D = self.num_heads, self.head_dim
         qkv = nn.Dense(3 * H * D, dtype=self.dtype, name="qkv")(x)
@@ -71,18 +105,33 @@ class MultiHeadAttention(nn.Module):
                 raise ValueError("kv_cache requires explicit positions")
             q, k, v = jnp.split(qkv.reshape(B, L, 3, H, D), 3, axis=2)
             q, k, v = q[:, :, 0], k[:, :, 0], v[:, :, 0]   # (B, L, H, D)
-            ck, cv = _cache_update(kv_cache, k, v, positions)
-            s = jnp.einsum("blhd,bshd->bhls", q, ck) / jnp.sqrt(D)
+            if page_table is not None:
+                # paged path: k/v land in pool pages addressed through the
+                # table; the read gathers each sequence's pages back into
+                # (B, W*page_size, H, D), where gathered slot s IS absolute
+                # position s (logical page j covers [j*ps, (j+1)*ps)), so
+                # the admissibility mask below is identical to dense.
+                ck, cv = _paged_cache_update(kv_cache, k, v, positions,
+                                             page_table)
+                W, page_size = page_table.shape[1], ck.shape[1]
+                keys = ck[page_table].reshape(B, W * page_size, H, D)
+                vals = cv[page_table].reshape(B, W * page_size, H, D)
+            else:
+                ck, cv = _cache_update(kv_cache, k, v, positions)
+                keys, vals = ck, cv
+            s = jnp.einsum("blhd,bshd->bhls", q, keys) / jnp.sqrt(D)
             # keys admissible strictly by absolute position: slot s serves
             # query l iff s <= positions[b, l].  Slots past a sequence's
             # frontier hold zeros or stale pad-token k/v, but every decode
             # step writes its token at the frontier BEFORE attending, so
-            # admissible slots are always freshly written.
-            key_pos = jnp.arange(ck.shape[1])[None, None, None, :]
+            # admissible slots are always freshly written.  (Paged: slots
+            # whose logical page is unallocated sit past every frontier by
+            # construction, so the trash page is never admissible.)
+            key_pos = jnp.arange(keys.shape[1])[None, None, None, :]
             admissible = key_pos <= positions[:, None, :, None]
             s = jnp.where(admissible, s, -1e30)
             out = jnp.einsum("bhls,bshd->blhd", nn.softmax(s, axis=-1),
-                             cv.astype(s.dtype))
+                             vals.astype(s.dtype))
             out = out.reshape(B, L, H * D)
             return nn.Dense(x.shape[-1], dtype=self.dtype,
                             name="proj")(out), (ck, cv)
@@ -117,13 +166,14 @@ class EncoderBlock(nn.Module):
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x, positions=None, kv_cache=None):
+    def __call__(self, x, positions=None, kv_cache=None, page_table=None):
         h = nn.LayerNorm(dtype=self.dtype)(x)
         attn = MultiHeadAttention(self.num_heads, self.head_dim,
                                   self.attention_mode, self.causal,
                                   dtype=self.dtype)
         if kv_cache is not None:
-            h, kv_cache = attn(h, positions=positions, kv_cache=kv_cache)
+            h, kv_cache = attn(h, positions=positions, kv_cache=kv_cache,
+                               page_table=page_table)
         else:
             h = attn(h)
         x = x + h
@@ -152,7 +202,7 @@ class TransformerEncoder(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, features: bool = False,
-                 positions=None, kv_cache=None):
+                 positions=None, kv_cache=None, page_table=None):
         B, L = tokens.shape
         x = nn.Embed(self.vocab_size, self.embed_dim, dtype=self.dtype)(tokens)
         pos = self.param("pos_embed", nn.initializers.normal(0.02),
@@ -172,7 +222,8 @@ class TransformerEncoder(nn.Module):
                                  dtype=self.dtype, name=f"block_{i}")
             if kv_cache is not None:
                 x, layer_kv = block(x, positions=positions,
-                                    kv_cache=kv_cache[i])
+                                    kv_cache=kv_cache[i],
+                                    page_table=page_table)
                 new_cache.append(layer_kv)
             else:
                 x = block(x)
@@ -197,5 +248,25 @@ class TransformerEncoder(nn.Module):
                              f"{self.max_len} (positional table bound)")
         head_dim = self.embed_dim // self.num_heads
         shape = (batch, cache_len, self.num_heads, head_dim)
+        return tuple((jnp.zeros(shape, self.dtype), jnp.zeros(shape, self.dtype))
+                     for _ in range(self.num_layers))
+
+    def init_paged_cache(self, num_pages: int, page_size: int):
+        """Zeroed PAGED KV-cache pytree: ``num_layers`` pairs of
+        ``(num_pages, page_size, heads, head_dim)`` pool slabs, shared by
+        every sequence through a per-sequence page table (see
+        ``models/runner.py::PagePool``).  Page 0 is reserved as the trash
+        page for pad rows and pad-tail prompt writes, so a usable pool
+        needs ``num_pages >= 2``.  Unlike ``init_cache``, the pool is sized
+        by TOTAL tokens across sequences, not ``batch * cache_len`` — the
+        memory model that lets concurrency scale with actual lengths."""
+        if num_pages < 2:
+            raise ValueError(f"num_pages {num_pages} < 2: page 0 is the "
+                             "reserved trash page, so a usable pool needs "
+                             "at least one allocatable page")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        head_dim = self.embed_dim // self.num_heads
+        shape = (num_pages, page_size, self.num_heads, head_dim)
         return tuple((jnp.zeros(shape, self.dtype), jnp.zeros(shape, self.dtype))
                      for _ in range(self.num_layers))

@@ -211,6 +211,46 @@ def test_runner_markers_fold_into_extras():
         bench.RESULT["extras"].clear()
 
 
+def test_runner_paged_marker_folds_with_gate_and_proxy_note():
+    """ISSUE 12: the paged-vs-dense decode A/B folds its tokens/sec pair,
+    occupancy, and HBM-per-seq extras; the on-chip 1.2x gate notes a miss,
+    and a CPU-proxy run (trailing flag 1) notes parity-only cover instead
+    of applying the gate."""
+    proc = _child(
+        "print('RUNNER_PAGED 500.0 650.0 1.3 62.5 8192.0 0')\n")
+    got = bench._collect_multi(proc, ("RUNNER_PAGED",), idle=10, hard=20)
+    bench.RESULT["extras"].clear()
+    try:
+        assert bench._record_runner(got)
+        ex = bench.RESULT["extras"]
+        assert ex["decode_dense_tokens_per_sec"] == 500.0
+        assert ex["decode_paged_tokens_per_sec"] == 650.0
+        assert ex["decode_paged_vs_dense"] == 1.3
+        assert ex["decode_page_occupancy_pct"] == 62.5
+        assert ex["decode_hbm_bytes_per_seq"] == 8192.0
+        assert "runner" not in ex.get("phase_notes", {})
+    finally:
+        bench.RESULT["extras"].clear()
+    # below the on-chip gate -> attributable note
+    bench.RESULT["extras"].clear()
+    try:
+        assert bench._record_runner(
+            {"RUNNER_PAGED": [500.0, 550.0, 1.1, 60.0, 8192.0, 0]})
+        note = bench.RESULT["extras"]["phase_notes"]["runner"]
+        assert "1.2x" in note
+    finally:
+        bench.RESULT["extras"].clear()
+    # CPU proxy flag -> parity note, the gate does NOT apply
+    bench.RESULT["extras"].clear()
+    try:
+        assert bench._record_runner(
+            {"RUNNER_PAGED": [500.0, 400.0, 0.8, 60.0, 8192.0, 1]})
+        note = bench.RESULT["extras"]["phase_notes"]["runner"]
+        assert "proxy" in note and "queued" in note
+    finally:
+        bench.RESULT["extras"].clear()
+
+
 def test_phase_metrics_snapshot_folds_into_extras():
     """ISSUE 11: each phase child prints a bounded PHASE_METRICS registry
     snapshot; the parent folds it under extras.phase_metrics so bench

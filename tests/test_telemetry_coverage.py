@@ -326,6 +326,48 @@ def test_runner_books_front_and_decode_metrics():
             f"ModelRunner no longer registers {family}"
 
 
+def test_page_pool_surface_books_metrics():
+    """ISSUE 12 coverage: the page pool is the decode memory substrate —
+    fleet HBM occupancy and the continuous-batching admission decision
+    both read its gauges, so the accounting must be un-droppable.
+    Source-level (like the stage sweep): allocate/extend/free must book
+    through ``_book`` (extend attributably, as its own op), the decode
+    loop must actually ride the pool's three verbs, and every decode
+    executable family must declare donated buffers — the static half of
+    the donation-safety regression (the behavioural half lives in
+    tests/test_paged_decode.py).  Live: runner construction registers the
+    pool families even for runners that never decode."""
+    from mmlspark_tpu.models import runner as runner_mod
+    from mmlspark_tpu.observability import MetricsRegistry
+
+    alloc_src = inspect.getsource(runner_mod.PagePool.allocate)
+    assert "_book(op" in alloc_src, "allocate() lost its booking"
+    extend_src = inspect.getsource(runner_mod.PagePool.extend)
+    assert '"extend"' in extend_src, "extend() no longer books its own op"
+    free_src = inspect.getsource(runner_mod.PagePool.free)
+    assert '_book("free"' in free_src, "free() lost its booking"
+    decode_src = inspect.getsource(runner_mod.ModelRunner.decode)
+    for needle in ("pool.allocate", "pool.extend", "pool.free"):
+        assert needle in decode_src, f"decode() lost {needle}"
+    # donation contract: the prefill and both step variants declare
+    # donate_argnums (a refactor that drops one silently reverts to
+    # per-token full-cache allocation on TPU)
+    exe_src = inspect.getsource(runner_mod.ModelRunner._decode_executables)
+    assert exe_src.count("donate_argnums") >= 3, \
+        "decode executables lost donate_argnums declarations"
+    sample_src = inspect.getsource(runner_mod.ModelRunner._sample_executable)
+    assert "donate_argnums" in sample_src
+
+    reg = MetricsRegistry()
+    runner_mod.ModelRunner(apply_fn=lambda v, x: x, variables={},
+                           name="sweep12", registry=reg)
+    for family in ("mmlspark_runner_page_ops_total",
+                   "mmlspark_runner_page_pool_used_pages",
+                   "mmlspark_runner_page_pool_high_water_pages"):
+        assert reg.family(family) is not None, \
+            f"ModelRunner no longer registers {family}"
+
+
 def test_federation_surface_is_instrumented():
     """ISSUE 11 coverage: the fleet telemetry plane watches the workers,
     so the registry must watch the fleet plane.  Source-level (like the
