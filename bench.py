@@ -510,6 +510,160 @@ def phase_runner(n=2000, hw=32, batch=128, reps=3, vocab=512, dec_batch=8,
     print(f"RUNNER_PAGED {d_tps} {p_tps} {p_tps / max(d_tps, 1e-9)} "
           f"{occ} {hbm} {int(bool(proxy))}", flush=True)
 
+    # --- continuous-vs-ticked decode A/B under ragged Poisson arrivals
+    # (ISSUE 13): the SAME request trace — Poisson arrivals (in step units,
+    # idle gaps fast-forwarded for free on both sides), ragged prompts and
+    # ragged token budgets — served two ways.  Ticked: the pre-13 serving
+    # drain — when the in-flight batch finishes, take whatever has arrived
+    # (up to `slots`) and decode it as one batch bound by its SLOWEST
+    # member's budget; arrivals mid-batch wait for the next tick.
+    # Continuous: ContinuousDecoder — arrivals join free slots between
+    # steps, finished sequences leave and free their slot mid-flight.
+    # Both sides do identical useful work (each request's budget tokens),
+    # so the wall ratio is the batching win; acceptance on-chip >= 1.5x
+    # (the CPU proxy records the ratio + a parity note).  The trace also
+    # counter-checks the no-new-compile-keys rule: joins after warmup must
+    # cause ZERO new step-executable compiles.
+    from collections import deque as _deque
+    from mmlspark_tpu.models import SlotsExhausted
+    slots = 4 if proxy else 8
+    n_req = 20 if proxy else 48
+    page = 16
+    rngc = np.random.default_rng(17)
+    # WIDELY ragged budgets are the ticked drain's waste driver (every
+    # member runs to the group max); 1.25x-capacity Poisson arrivals keep
+    # both engines saturated, so wall ratio == dispatched-work ratio and
+    # the free idle fast-forward below almost never triggers
+    min_b = max(2, new_tokens // 8)
+    reqs = []
+    rate = 1.25 * slots / ((min_b + new_tokens) / 2.0)
+    arrive = 0.0
+    for _ in range(n_req):
+        arrive += rngc.exponential(1.0 / rate)
+        plen = int(rngc.integers(max(2, prompt // 4), prompt + 1))
+        reqs.append((rngc.integers(0, vocab, plen).astype(np.int32),
+                     plen, int(rngc.integers(min_b, new_tokens + 1)),
+                     int(arrive)))
+    useful = sum(r[2] for r in reqs)
+
+    disp = {"ticked": (0, 0), "cont": (0, 0)}   # (prefills, steps)
+
+    def ticked_engine():
+        t0 = time.perf_counter()
+        clock_steps, i = 0, 0
+        n_pre = n_steps = 0
+        while i < len(reqs):
+            if reqs[i][3] > clock_steps:
+                clock_steps = reqs[i][3]          # idle: jump to arrival
+            group = []
+            while i < len(reqs) and reqs[i][3] <= clock_steps \
+                    and len(group) < slots:
+                group.append(reqs[i])
+                i += 1
+            gmax = max(r[2] for r in group)
+            stacked = np.zeros((len(group), prompt), np.int32)
+            lens = np.asarray([r[1] for r in group], np.int32)
+            for j, r in enumerate(group):
+                stacked[j, :r[1]] = r[0]
+            res = dec.decode(stacked, lengths=lens, max_new_tokens=gmax,
+                             kv_layout="paged", page_size=page,
+                             batch_bucket=slots, prompt_bucket=prompt)
+            n_pre += 1
+            n_steps += res.steps
+            clock_steps += gmax                   # batch held the engine
+        disp["ticked"] = (n_pre, n_steps)
+        return useful / (time.perf_counter() - t0)
+
+    def continuous_engine():
+        decoder = dec.decode_stream(slots=slots, prompt_bucket=prompt,
+                                    max_new_tokens=new_tokens,
+                                    page_size=page)
+        b0 = dec._c_batches["decode"].value   # join-prefill dispatch base
+        pend = _deque(reqs)
+        handles = []
+        t0 = time.perf_counter()
+        virtual = 0
+        while pend or decoder._live or decoder._arrivals:
+            now_step = decoder.steps + virtual
+            while pend and pend[0][3] <= now_step:
+                try:
+                    handles.append(decoder.submit(
+                        pend[0][0], max_new_tokens=pend[0][2]))
+                except SlotsExhausted:
+                    break                          # backpressure: next leave
+                pend.popleft()
+            if decoder._live or decoder._arrivals:
+                decoder.step()
+            elif pend:
+                virtual = pend[0][3] - decoder.steps  # idle fast-forward
+        wall = time.perf_counter() - t0
+        disp["cont"] = (int(dec._c_batches["decode"].value - b0),
+                        decoder.steps)
+        decoder.close()
+        return useful / wall, handles
+
+    # warmup: the stream executables + ONE ticked decode per distinct
+    # table width any group's gmax in [min_b, new_tokens] can produce (a
+    # width compiled mid-run would tax the ticked wall unfairly)
+    dec.decode_stream(slots=slots, prompt_bucket=prompt,
+                      max_new_tokens=new_tokens, page_size=page).warmup()
+    widths = {}
+    for m in range(min_b, new_tokens + 1):
+        widths.setdefault(-(-(prompt + m) // page), m)
+    for warm_nt in widths.values():
+        wp = rngc.integers(0, vocab, (slots, prompt)).astype(np.int32)
+        dec.decode(wp, max_new_tokens=warm_nt, kv_layout="paged",
+                   page_size=page, batch_bucket=slots, prompt_bucket=prompt)
+    _log("[bench] runner cont warm done")
+
+    def step_compiles():
+        return sum(getattr(w, "compiles", 0) for w in dec._wrappers
+                   if "decode_step" in getattr(w, "name", ""))
+
+    # median of `reps` passes per engine (same protocol as the other
+    # arms: single ~1s walls on this shared box swing 3x with neighbor
+    # load, and the RATIO is the acceptance number)
+    t_rates = []
+    for _ in range(reps):
+        t_rates.append(ticked_engine())
+        _log(f"[bench] runner ticked tokens/s {t_rates[-1]:.1f}")
+    t_rates.sort()
+    t_tps = t_rates[len(t_rates) // 2]
+    # the join-compile gate brackets the CONTINUOUS traces only: a ticked
+    # compile (warmup gap) must never be misattributed to joins
+    n_step0 = step_compiles()
+    c_rates = []
+    for _ in range(reps):
+        c_tps, handles = continuous_engine()
+        c_rates.append(c_tps)
+        _log(f"[bench] runner continuous tokens/s {c_rates[-1]:.1f}")
+    c_rates.sort()
+    c_tps = c_rates[len(c_rates) // 2]
+    # device work per useful token is the machine-independent half of the
+    # story: the ticked drain burns slowest-member padding steps (every
+    # step at full batch width) and full-width prefills, while the
+    # continuous engine steps only live work and prefills each arrival
+    # alone.  Token-forward units: prefill = rows*prompt, step = batch
+    # width.  On the CPU proxy at this tiny shape, per-dispatch host
+    # overhead flattens the wall ratio toward 1 — the 1.5x gate is an
+    # on-chip number, where this compute ratio dominates the wall.
+    t_tf = disp["ticked"][0] * slots * prompt + disp["ticked"][1] * slots
+    c_tf = disp["cont"][0] * prompt + disp["cont"][1] * slots
+    _log(f"[bench] runner cont device work (token-forwards): "
+         f"ticked {t_tf} vs continuous {c_tf} "
+         f"({t_tf / max(c_tf, 1):.2f}x saved)")
+    # read the counter BEFORE the parity references below: their one-shot
+    # bb=1 signatures legitimately compile and must not be charged to joins
+    new_steps = step_compiles() - n_step0
+    parity = 1
+    for (p, _plen, budget, _a), h in list(zip(reqs, handles))[:3]:
+        ref = dec.decode(p[None], max_new_tokens=budget,
+                         kv_layout="paged", page_size=page)
+        if list(ref.tokens[0]) != h.tokens:
+            parity = 0
+    print(f"RUNNER_CONT {t_tps} {c_tps} {c_tps / max(t_tps, 1e-9)} "
+          f"{parity} {new_steps} {int(bool(proxy))}", flush=True)
+
 
 def phase_ooc(n=200_000, f=50, iters=8, tiles=4, reps=3) -> None:
     """Out-of-core streamed-vs-in-memory A/B at a fits-in-memory shape —
@@ -999,6 +1153,35 @@ def _record_runner(got: dict) -> bool:
             _note("runner", f"paged/dense {pg[2]:.3f} below the 1.2x "
                             "on-chip gate")
         ok = True
+    ct = got.get("RUNNER_CONT")
+    if ct and not isinstance(ct, str) and len(ct) >= 3:
+        # continuous-vs-ticked decode A/B (ISSUE 13): on-chip gate
+        # continuous >= 1.5x ticked tokens/sec under ragged Poisson
+        # arrivals; joins must cause zero step-executable compiles either
+        # way, and the CPU proxy records ratio + parity instead of gating
+        ex["decode_ticked_tokens_per_sec"] = round(ct[0], 1)
+        ex["decode_cont_tokens_per_sec"] = round(ct[1], 1)
+        ex["decode_cont_vs_ticked"] = round(ct[2], 3)
+        proxy_run = len(ct) >= 6 and ct[5] >= 1
+        if len(ct) >= 4:
+            ex["decode_cont_parity"] = "ok" if ct[3] >= 1 else "MISMATCH"
+            if ct[3] < 1:
+                _note("runner", "continuous decode tokens DIVERGED from "
+                                "one-shot decode() — parity gate failed")
+        if len(ct) >= 5:
+            ex["decode_cont_join_step_compiles"] = int(ct[4])
+            if ct[4] > 0:
+                _note("runner", f"{int(ct[4])} step-executable compile(s) "
+                                "during the continuous trace — joins must "
+                                "not mint compile keys")
+        if proxy_run:
+            _note("runner", "continuous-vs-ticked measured on the CPU "
+                            "proxy (ratio + parity cover) — the 1.5x "
+                            "on-chip gate rides the queued relay round")
+        elif ct[2] < 1.5:
+            _note("runner", f"continuous/ticked {ct[2]:.3f} below the "
+                            "1.5x on-chip gate")
+        ok = True
     return ok
 
 
@@ -1196,7 +1379,7 @@ def _run_measured_phases(tpu_ok: bool, cpu_rps: float) -> None:
         # the generative-serving number).
         got = _collect_multi(_spawn("runner", _tpu_env()),
                              ("RUNNER_AB", "RUNNER_DECODE", "RUNNER_PAGED",
-                              "PHASE_METRICS"),
+                              "RUNNER_CONT", "PHASE_METRICS"),
                              idle=600, hard=1100)
         _record_phase_metrics("runner", got)
         if not _record_runner(got):
@@ -1233,7 +1416,7 @@ def _run_measured_phases(tpu_ok: bool, cpu_rps: float) -> None:
     if "runner_vs_legacy" not in RESULT["extras"]:
         got = _collect_multi(_spawn("runner", _cpu_env(), ["--proxy", "1"]),
                              ("RUNNER_AB", "RUNNER_DECODE", "RUNNER_PAGED",
-                              "PHASE_METRICS"),
+                              "RUNNER_CONT", "PHASE_METRICS"),
                              idle=500, hard=900)
         _record_phase_metrics("runner", got)
         if not _record_runner(got):

@@ -2,9 +2,13 @@ from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101
 from .bilstm import BiLSTMTagger, LSTMLayer
 from .transformer import TransformerEncoder, EncoderBlock, MultiHeadAttention
 from .gbdt import GBDTBooster
-from .runner import ModelRunner, DecodeResult, PagePool, bucket_rows
+from .runner import (ModelRunner, DecodeResult, PagePool,
+                     ContinuousDecoder, StreamHandle, PagePoolExhausted,
+                     SlotsExhausted, ShedReply, bucket_rows)
 
 __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
            "BiLSTMTagger", "LSTMLayer", "TransformerEncoder", "EncoderBlock",
            "MultiHeadAttention", "GBDTBooster", "ModelRunner", "DecodeResult",
-           "PagePool", "bucket_rows"]
+           "PagePool", "ContinuousDecoder", "StreamHandle",
+           "PagePoolExhausted", "SlotsExhausted", "ShedReply",
+           "bucket_rows"]

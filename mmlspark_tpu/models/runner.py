@@ -47,18 +47,48 @@ across ragged batch sizes is impossible by construction and visible on
 from __future__ import annotations
 
 import threading
+import time
+from collections import deque
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..core import DataFrame, Transformer
 from ..core.schema import ColumnType
 
-__all__ = ["ModelRunner", "DecodeResult", "PagePool", "bucket_rows"]
+__all__ = ["ModelRunner", "DecodeResult", "PagePool", "ContinuousDecoder",
+           "StreamHandle", "PagePoolExhausted", "SlotsExhausted", "ShedReply",
+           "bucket_rows"]
 
 #: fronts a batch can arrive through; metric label values
 FRONTS = ("transform", "serving", "decode")
+
+
+class PagePoolExhausted(RuntimeError):
+    """The page pool cannot cover an allocation — admission control, not a
+    crash.  ``shed`` duck-types the serving layer's shed path (serving maps
+    it to 503 + Retry-After without importing this module)."""
+    shed = True
+
+
+class SlotsExhausted(RuntimeError):
+    """No free decode slot for a new arrival — the continuous engine's
+    admission-control twin of :class:`PagePoolExhausted`."""
+    shed = True
+
+
+class ShedReply:
+    """Per-row shed sentinel: a scorer that must refuse ONE row of a batch
+    (mid-decode page denial) returns this in the reply column, and the
+    serving layer maps it to 503 + Retry-After.  Duck-typed on
+    ``shed_reason`` so serving never imports the models package."""
+
+    __slots__ = ("shed_reason", "retry_after_s")
+
+    def __init__(self, reason: str, retry_after_s: Optional[float] = None):
+        self.shed_reason = reason
+        self.retry_after_s = retry_after_s
 
 
 def bucket_rows(m: int, batch_size: int) -> int:
@@ -145,8 +175,9 @@ class PagePool:
     never have to build device slabs.
     """
 
-    #: booking ops — each books pages moved, not call count
-    OPS = ("allocate", "extend", "free")
+    #: booking ops — each books pages moved, not call count ("denied"
+    #: books pages REFUSED: the admission-control outcome, ISSUE 13)
+    OPS = ("allocate", "extend", "free", "denied")
 
     def __init__(self, module=None, num_pages: int = 0, page_size: int = 64,
                  *, name: str = "pool", registry=None):
@@ -229,7 +260,10 @@ class PagePool:
         admission control, not silent overcommit."""
         with self._cond:
             if n > len(self._free):
-                raise RuntimeError(
+                # book the refusal before raising: the denied outcome is
+                # the admission-control signal dashboards alert on
+                self._book("denied", n)
+                raise PagePoolExhausted(
                     f"page pool exhausted: need {n} page(s), "
                     f"{len(self._free)} free of {self.capacity} "
                     f"(page_size={self.page_size}) — free finished "
@@ -401,6 +435,22 @@ class ModelRunner:
         reg.gauge("mmlspark_runner_page_pool_high_water_pages",
                   "max KV pages ever simultaneously held",
                   labels=("runner", "page_size"))
+        # continuous-engine surface (ISSUE 13): families registered at
+        # construction so the telemetry sweep gates on them even for
+        # runners that never open a decode stream; ContinuousDecoder binds
+        # the children
+        reg.counter("mmlspark_runner_slots_joined_total",
+                    "requests spliced into the in-flight decode batch",
+                    labels=("runner",))
+        reg.counter("mmlspark_runner_slots_left_total",
+                    "slots released by outcome (ok/denied/expired/cancelled)",
+                    labels=("runner", "outcome"))
+        reg.gauge("mmlspark_runner_slot_occupancy_pct",
+                  "reserved+live decode slots as % of the in-flight bucket",
+                  labels=("runner",))
+        reg.histogram("mmlspark_runner_ttft_seconds",
+                      "submit-to-first-token latency of continuous decode",
+                      labels=("runner",))
         #: (device key, page size) -> shared PagePool for paged decode
         self._pools: Dict[Tuple, PagePool] = {}
         #: resolved geometry of the most recent decode (DecodeResult.extras)
@@ -505,7 +555,8 @@ class ModelRunner:
     def scorer(self, input_col: str = "request", reply_col: str = "reply",
                prepare: Optional[Callable] = None,
                encode: Optional[Callable] = None,
-               mode: str = "score", **decode_kwargs) -> "Transformer":
+               mode: str = "score", continuous: bool = False,
+               report_ttft: bool = False, **decode_kwargs) -> "Transformer":
         """A ``Transformer`` front for ``PipelineServer`` / the streaming
         facade.  ``mode="score"`` stacks request rows (via ``prepare``,
         default ``np.asarray(..., float32)``) and scores them through
@@ -520,11 +571,28 @@ class ModelRunner:
         ``mixed_load``'s decode class can report tokens/sec against it).
         The server's continuous-mode drain is the admission window:
         whatever is in flight when the scorer runs becomes ONE bucketed
-        device batch."""
+        device batch.
+
+        ``continuous=True`` (decode mode only, ISSUE 13) upgrades the drain
+        from batch ticks to SLOT-level continuous batching: the scorer owns
+        a :class:`ContinuousDecoder` (``decode_kwargs`` become
+        :meth:`decode_stream` kwargs — ``slots=``, ``prompt_bucket=``,
+        ``max_new_tokens=``, ``eos_id=``, ``page_size=``, ``pool=``) and
+        exposes ``continuous_submit`` so ``PipelineServer``/the streaming
+        facade admit each request into a free slot of the in-flight batch
+        the moment it is drained — no flush tick, and a finished sequence
+        replies while the batch keeps decoding.  Admission failure (no free
+        slot, page pool exhausted) sheds with 503 + Retry-After.
+        ``report_ttft=True`` wraps decode replies as ``{"tokens",
+        "ttft_ms"}`` — the in-band first-token latency ``mixed_load``'s
+        ``ttft_p99_ms`` gate reads (for the ticked drain there is no
+        client-visible token before the batch resolves, so its honest TTFT
+        is the full latency)."""
         if mode not in ("score", "decode"):
             raise ValueError("scorer mode must be score|decode")
         return _RunnerScorer(self, input_col, reply_col, prepare, encode,
-                             mode, decode_kwargs)
+                             mode, decode_kwargs, continuous=continuous,
+                             report_ttft=report_ttft)
 
     # ------------------------------------------------------------ decode front
     def page_pool(self, page_size: int = 64,
@@ -840,6 +908,10 @@ class ModelRunner:
         finished[B:] = True
         steps = 0
         real_tokens = 0
+        #: row -> tokens emitted when its pool extend was DENIED (ISSUE 13
+        #: bugfix: a budgeted pool exhausting mid-decode freezes the row and
+        #: yields a clean partial result instead of raising out of the loop)
+        denied_at: Dict[int, int] = {}
         ok = False
         # every executable shares one signature; table is None (an empty
         # pytree) on the dense layout, and the device copy is re-uploaded
@@ -860,6 +932,15 @@ class ModelRunner:
                     # ids + (B,) finished flags; logits stay on device
                     tok = np.asarray(tok_d)
                     fin_now = np.asarray(fin_d)
+                    if denied_at:
+                        # the device-resident finished mask never learns of
+                        # a host-side page denial — fold it back in, or the
+                        # denied row thaws next iteration (re-inflating the
+                        # decode-tokens counter and holding the eos
+                        # early-exit open forever)
+                        fin_now = fin_now.copy()
+                        for b in denied_at:
+                            fin_now[b] = True
                 else:
                     lg = np.asarray(last)                  # (B_b, V) fetch
                     if collect_logits:
@@ -892,7 +973,8 @@ class ModelRunner:
                             table_dirty = True
                 finished = fin_now
                 if t == max_new_tokens - 1 or \
-                        (eos_id is not None and bool(finished.all())):
+                        ((eos_id is not None or denied_at)
+                         and bool(finished.all())):
                     break
                 # token t sits at absolute position lengths + t; the step
                 # writes it at that frontier and returns logits for t+1
@@ -904,11 +986,32 @@ class ModelRunner:
                     # Frozen rows stop extending once freed — except under
                     # collect_logits, where they stay live (logits parity)
                     for b in range(B):
-                        if finished[b] and not collect_logits:
+                        if b in denied_at or \
+                                (finished[b] and not collect_logits):
                             continue
                         pi = int(pos[b]) // page_size
                         if pi >= len(seq_pages[b]):
-                            new_page = pool.extend()[0]
+                            try:
+                                new_page = pool.extend()[0]
+                            except PagePoolExhausted:
+                                # mid-decode exhaustion of a budgeted pool
+                                # is admission control: freeze the row,
+                                # release its pages for the survivors, and
+                                # return its generation so far (the denial
+                                # is already booked as op="denied"; serving
+                                # maps the row to a 503 shed)
+                                denied_at[b] = t + 1
+                                if not finished.flags.writeable:
+                                    # the fused path's finished vector is a
+                                    # read-only view of the device fetch
+                                    finished = finished.copy()
+                                finished[b] = True
+                                if seq_pages[b]:
+                                    pool.free(seq_pages[b])
+                                    seq_pages[b] = []
+                                table[b, :] = 0
+                                table_dirty = True
+                                continue
                             seq_pages[b].append(new_page)
                             table[b, pi] = new_page
                             table_dirty = True
@@ -942,6 +1045,11 @@ class ModelRunner:
                 # unknown — drop it so the next borrower rebuilds zeros
                 pool.return_cache(cache if ok else None)
         n_generated = t + 1
+        # a denied row's post-denial slots hold whatever the trash-page
+        # dispatches produced — overwrite with eos padding so the partial
+        # result is clean up to (and silent past) its truncation point
+        for b, cut in denied_at.items():
+            out_tokens[b, cut:] = eos_id if eos_id is not None else 0
         self._c_decode_tokens.inc(real_tokens)
         self._c_rows["decode"].inc(B)
         extras: Dict[str, Any] = {
@@ -949,6 +1057,10 @@ class ModelRunner:
             "real_tokens": real_tokens,
             "batch_bucket": B_b,
         }
+        if denied_at:
+            extras["denied_rows"] = sorted(denied_at)
+            extras["denied_at"] = {int(b): int(c)
+                                   for b, c in sorted(denied_at.items())}
         if paged:
             extras.update(
                 page_size=page_size, table_width=table_w,
@@ -968,6 +1080,632 @@ class ModelRunner:
                             lengths=lengths, steps=steps, logits=logits,
                             extras=extras)
 
+    # ------------------------------------------------------ continuous front
+    def decode_stream(self, *, slots: int = 4, prompt_bucket: int = 16,
+                      max_new_tokens: int = 16,
+                      eos_id: Optional[int] = None, page_size: int = 64,
+                      pool: Optional[PagePool] = None,
+                      clock: Optional[Callable[[], float]] = None
+                      ) -> "ContinuousDecoder":
+        """A persistent in-flight decode loop over the paged pool (ISSUE 13
+        tentpole): a fixed ``slots``-wide batch whose per-slot state (page-
+        table row, length, finished flag) supports slot-level JOIN (a new
+        arrival prefills into freshly allocated pages and splices into the
+        running batch between steps) and LEAVE (eos/budget frees the slot's
+        pages mid-flight; the slot is immediately admissible again).
+
+        The stream reuses the ONE-SHOT executables at its geometry — the
+        PR 12 step is keyed on (batch bucket, page size, table width), and
+        each join prefills the arrival ALONE at the one-shot
+        (1, prompt_bucket) prefill signature into its own pages — so
+        admission introduces NO new compile keys (``warmup()`` covers all
+        three signatures) and greedy tokens stay bit-identical to
+        :meth:`decode`.  Greedy/eos fast path only
+        (``sample_fn``/``collect_logits`` stay one-shot).
+
+        Drive it with :meth:`ContinuousDecoder.submit` + either
+        :meth:`ContinuousDecoder.start` (background engine thread — what
+        serving uses) or manual :meth:`ContinuousDecoder.step` calls
+        (deterministic tests)."""
+        return ContinuousDecoder(self, slots=slots,
+                                 prompt_bucket=prompt_bucket,
+                                 max_new_tokens=max_new_tokens,
+                                 eos_id=eos_id, page_size=page_size,
+                                 pool=pool, clock=clock)
+
+
+class StreamHandle:
+    """One request in flight on a :class:`ContinuousDecoder`.
+
+    Lifecycle: ``queued`` (slot + prompt pages reserved at submit) →
+    ``live`` (spliced into the batch; ``t_first_s``/``ttft_s`` set) → a
+    terminal outcome: ``ok`` (eos or token budget), ``denied`` (page pool
+    exhausted mid-flight — the generation so far is on ``tokens``),
+    ``expired`` (deadline passed mid-flight), ``cancelled`` (decoder
+    closed) or ``error`` (engine failure).  ``done`` fires at the terminal
+    transition; ``on_done(handle)`` (if given) runs on the engine thread
+    right after it."""
+
+    __slots__ = ("prompt", "length", "max_new_tokens", "deadline_s",
+                 "on_done", "slot", "tokens", "status", "done",
+                 "t_submit_s", "t_first_s", "pages")
+
+    def __init__(self, prompt: np.ndarray, length: int, max_new_tokens: int,
+                 deadline_s: Optional[float], on_done: Optional[Callable]):
+        self.prompt = prompt
+        self.length = int(length)
+        self.max_new_tokens = int(max_new_tokens)
+        self.deadline_s = deadline_s
+        self.on_done = on_done
+        self.slot = -1
+        self.tokens: List[int] = []
+        self.status = "queued"
+        self.done = threading.Event()
+        self.t_submit_s = 0.0
+        self.t_first_s: Optional[float] = None
+        self.pages: List[int] = []
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        """Submit-to-first-token latency (None until the join prefill)."""
+        if self.t_first_s is None:
+            return None
+        return max(0.0, self.t_first_s - self.t_submit_s)
+
+    def result(self, timeout: Optional[float] = None) -> DecodeResult:
+        """Block until terminal and return a one-row :class:`DecodeResult`
+        (partial for denied/expired/cancelled outcomes)."""
+        if not self.done.wait(timeout):
+            raise TimeoutError("decode stream request still in flight")
+        toks = np.asarray(self.tokens, np.int32).reshape(1, -1)
+        return DecodeResult(
+            tokens=toks,
+            lengths=np.asarray([self.length], np.int32),
+            steps=max(0, len(self.tokens) - 1),
+            extras={"status": self.status, "ttft_s": self.ttft_s})
+
+
+class ContinuousDecoder:
+    """Slot-level continuous batching on the paged KV pool (ISSUE 13).
+
+    A fixed in-flight batch of ``slots`` rows decodes on ONE fused step
+    executable; requests join free slots between steps and leave (freeing
+    their pages) the moment they finish, so tokens/sec tracks the arrival
+    process instead of the slowest member of a drained batch.  Per-slot
+    state is the paged-decode substrate from PR 12: a page-table row, a
+    true length, and a finished flag — empty slots are pad rows (finished,
+    table row on the trash page).
+
+    Join = a (1, prompt_bucket) prefill of the arrival alone into its
+    freshly allocated pages, between steps — device work proportional to
+    the arrival, never the batch width, and live rows' pages untouched
+    (the prefill's table names only the joiner's pages).  Because every
+    signature is exactly a one-shot :meth:`ModelRunner.decode` executable
+    (and :meth:`warmup` pre-compiles all three), admission can never
+    compile — the no-new-compile-keys rule the bench A/B counter-checks.
+
+    Admission control at :meth:`submit`: no free slot raises
+    :class:`SlotsExhausted`; the prompt's pages are allocated up front so
+    pool exhaustion raises :class:`PagePoolExhausted` (booked as
+    ``op="denied"``) — serving maps both to 503 + Retry-After.  A
+    mid-flight extend denial resolves that slot as ``denied`` with its
+    partial generation.
+
+    Metrics: ``mmlspark_runner_slots_{joined,left}_total``,
+    ``mmlspark_runner_slot_occupancy_pct``, and the
+    ``mmlspark_runner_ttft_seconds`` histogram, all labelled by runner.
+
+    Threading: ``submit`` is thread-safe; :meth:`step` must have ONE
+    driver — the :meth:`start` engine thread, or a single test/bench loop.
+    The decoder borrows the pool's device slabs at the first join and
+    returns them at :meth:`close` (one-shot paged decodes on the same pool
+    block until then, by the PR 12 borrow contract).
+    """
+
+    OUTCOMES = ("ok", "denied", "expired", "cancelled", "error")
+
+    def __init__(self, runner: ModelRunner, *, slots: int = 4,
+                 prompt_bucket: int = 16, max_new_tokens: int = 16,
+                 eos_id: Optional[int] = None, page_size: int = 64,
+                 pool: Optional[PagePool] = None,
+                 clock: Optional[Callable[[], float]] = None):
+        module = runner.module
+        if module is None or not hasattr(module, "init_paged_cache"):
+            raise TypeError(
+                "decode_stream() needs a module with init_paged_cache "
+                "(e.g. models.TransformerEncoder with causal=True)")
+        if slots < 1 or prompt_bucket < 1 or max_new_tokens < 1:
+            raise ValueError("slots, prompt_bucket and max_new_tokens "
+                             "must all be >= 1")
+        self.runner = runner
+        self.slots = int(slots)
+        self.prompt_bucket = int(prompt_bucket)
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.clock = clock or time.monotonic
+        if pool is not None:
+            page_size = pool.page_size
+        if page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        self.page_size = int(page_size)
+        self.table_w = -(-(self.prompt_bucket + self.max_new_tokens)
+                         // self.page_size)
+        max_len = getattr(module, "max_len", None)
+        if max_len is not None and \
+                self.prompt_bucket + self.max_new_tokens > max_len:
+            raise ValueError(
+                f"prompt_bucket + max_new_tokens = "
+                f"{self.prompt_bucket + self.max_new_tokens} exceeds the "
+                f"module's max_len {max_len} (positional table bound)")
+        self._explicit_pool = pool is not None
+        self.pool = pool if pool is not None else runner._auto_pool(
+            self.page_size, self.slots * self.table_w + 1)
+        # the one-shot executables AT THE STREAM GEOMETRY — shared cache
+        # entries, so a warmed one-shot decode warms the stream and vice
+        # versa, and joins can never mint a new compile key.  The step
+        # runs at the full batch bucket; joins prefill each arrival ALONE
+        # at the (1, prompt_bucket) signature — device work proportional
+        # to the arrival, not the batch width (a full-width join prefill
+        # costs slots× the compute per join), with the same one-shot
+        # bit-parity by row independence.
+        _, self._step = runner._decode_executables(
+            self.slots, self.prompt_bucket, page_size=self.page_size,
+            table_w=self.table_w, fused=True, eos_id=eos_id)
+        self._prefill1, _ = runner._decode_executables(
+            1, self.prompt_bucket, page_size=self.page_size,
+            table_w=self.table_w, fused=True, eos_id=eos_id)
+        self._sample1 = runner._sample_executable(1, eos_id)
+        # per-slot state: empty slots behave as pad rows
+        self._tok = np.zeros(self.slots, np.int32)
+        self._fin = np.ones(self.slots, bool)
+        self._lens = np.ones(self.slots, np.int32)
+        self._emitted = np.zeros(self.slots, np.int32)
+        self._table = np.zeros((self.slots, self.table_w), np.int32)
+        self._table_dev = None
+        self._table_dirty = True
+        #: device-resident copies of _tok/_fin for the steady state — the
+        #: previous step's outputs feed the next dispatch directly (as the
+        #: one-shot fused loop does); a join/leave invalidates them so the
+        #: next dispatch re-uploads the mutated host state
+        self._tok_dev = None
+        self._fin_dev = None
+        self._handles: List[Optional[StreamHandle]] = [None] * self.slots
+        self._free: List[int] = list(range(self.slots - 1, -1, -1))
+        self._arrivals: "deque[StreamHandle]" = deque()
+        self._cond = threading.Condition(threading.Lock())
+        self._cache = None
+        self._live = 0
+        self._closed = False
+        self._poisoned = False
+        self._torn = False
+        self._thread: Optional[threading.Thread] = None
+        self.steps = 0       # fused step dispatches (join prefills excluded)
+        self.joined = 0
+        self.left = 0
+        reg, name = runner.registry, runner.name
+        self._name = name
+        self._c_joined = reg.counter(
+            "mmlspark_runner_slots_joined_total",
+            "requests spliced into the in-flight decode batch",
+            labels=("runner",)).labels(runner=name)
+        fam_left = reg.counter(
+            "mmlspark_runner_slots_left_total",
+            "slots released by outcome (ok/denied/expired/cancelled)",
+            labels=("runner", "outcome"))
+        self._c_left = {o: fam_left.labels(runner=name, outcome=o)
+                        for o in self.OUTCOMES}
+        self._g_occ = reg.gauge(
+            "mmlspark_runner_slot_occupancy_pct",
+            "reserved+live decode slots as % of the in-flight bucket",
+            labels=("runner",))
+        self._h_ttft = reg.histogram(
+            "mmlspark_runner_ttft_seconds",
+            "submit-to-first-token latency of continuous decode",
+            labels=("runner",)).labels(runner=name)
+        self._book_occupancy()
+
+    # -------------------------------------------------------------- admission
+    @property
+    def closed(self) -> bool:
+        """True once :meth:`close` ran or the engine aborted — a closed
+        decoder refuses submits; callers holding one should rebuild."""
+        return self._closed
+
+    def occupancy(self) -> int:
+        """Slots reserved or live (free slots are ``slots - occupancy``)."""
+        with self._cond:
+            return self.slots - len(self._free)
+
+    def _book_occupancy(self) -> None:
+        """Occupancy gauge — called with ``_cond`` held."""
+        occ = self.slots - len(self._free)
+        self._g_occ.set(100.0 * occ / self.slots, runner=self._name)
+
+    def _adopt_current_pool_locked(self) -> None:
+        """A FULLY idle stream re-binds to the runner's CURRENT implicit
+        pool for its page size (``_cond`` held): ``page_pool(num_pages=)``
+        resizes and ``_auto_pool`` growth REPLACE the runner's pool
+        object, and a stream that kept the old reference would allocate
+        from an orphaned budget (the operator's resize silently not
+        applying) while both pools stomp one occupancy series.  Only when
+        zero slots are reserved and the slabs are returned, so in-flight
+        state never spans two pools; a stream built on an explicit
+        ``pool=`` keeps it — that budget is the caller's contract."""
+        if self._explicit_pool or self._cache is not None \
+                or self._live or self._arrivals \
+                or len(self._free) != self.slots:
+            return
+        current = self.runner._pools.get(
+            (self.runner._device_key(), self.page_size))
+        if current is not None and current is not self.pool:
+            self.pool = current
+
+    def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
+               deadline_s: Optional[float] = None,
+               on_done: Optional[Callable] = None) -> StreamHandle:
+        """Admit one request: reserve a free slot and allocate its prompt
+        pages NOW (the admission decision), splice into the batch at the
+        next step boundary.  Raises :class:`SlotsExhausted` /
+        :class:`PagePoolExhausted` when the engine is full — admission
+        control, the serving layer's 503 signal."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        length = int(prompt.size)
+        if not 1 <= length <= self.prompt_bucket:
+            raise ValueError(
+                f"prompt length {length} outside [1, "
+                f"{self.prompt_bucket}] (the stream's prompt bucket)")
+        budget = (self.max_new_tokens if max_new_tokens is None
+                  else int(max_new_tokens))
+        if not 1 <= budget <= self.max_new_tokens:
+            raise ValueError(
+                f"max_new_tokens {budget} outside [1, "
+                f"{self.max_new_tokens}] (the stream's table bound)")
+        n_pages = -(-length // self.page_size)
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("decoder is closed")
+            self._adopt_current_pool_locked()
+            if not self._free:
+                raise SlotsExhausted(
+                    f"no free decode slot ({self.slots} in flight) — "
+                    "retry after a sequence finishes, or run more slots")
+            # pages allocated inside the slot reservation so the two
+            # admission resources can never disagree (denied is booked by
+            # the pool before the raise)
+            pages = self.pool.allocate(n_pages)
+            slot = self._free.pop()
+            handle = StreamHandle(prompt, length, budget, deadline_s,
+                                  on_done)
+            handle.slot = slot
+            handle.pages = list(pages)
+            handle.t_submit_s = self.clock()
+            self._arrivals.append(handle)
+            self._book_occupancy()
+            self._cond.notify_all()
+        return handle
+
+    # ----------------------------------------------------------------- engine
+    def _borrow(self) -> None:
+        if self._cache is None:
+            self._cache = self.pool.borrow_cache()
+
+    def _return_cache_if_idle(self) -> None:
+        """Hand the borrowed slabs back while the engine is EMPTY (no live
+        slot, no queued arrival): an idle engine holds no pages, so its
+        slab contents are irrelevant — returning them lets one-shot paged
+        decodes (and other streams on the same pool) interleave instead of
+        blocking on the borrow until :meth:`close`.  The next join simply
+        re-borrows."""
+        if self._cache is None:
+            return
+        with self._cond:
+            if self._arrivals:
+                return
+        cache, self._cache = self._cache, None
+        self.pool.return_cache(cache)
+
+    def warmup(self) -> None:
+        """Compile the join-prefill/sampler/step executables with
+        all-trash dispatches (zero page tables: no pool pages held, no
+        slot state touched), so the first real join never pays a compile.
+        The signatures are shared with one-shot :meth:`ModelRunner.decode`
+        at this geometry, so a warmed one-shot also warms the stream."""
+        import jax.numpy as jnp
+        self._borrow()
+        S, P_b = self.slots, self.prompt_bucket
+        variables = self.runner.variables
+        try:
+            positions = jnp.broadcast_to(jnp.arange(P_b, dtype=jnp.int32),
+                                         (1, P_b))
+            table1 = jnp.zeros((1, self.table_w), jnp.int32)
+            last, self._cache = self._prefill1(
+                variables, jnp.zeros((1, P_b), jnp.int32), positions,
+                jnp.ones(1, jnp.int32), table1, self._cache)
+            self._sample1(last, jnp.ones(1, bool))
+            _t, _f, self._cache = self._step(
+                variables, jnp.zeros(S, jnp.int32),
+                jnp.zeros(S, jnp.int32),
+                jnp.zeros((S, self.table_w), jnp.int32),
+                jnp.ones(S, bool), self._cache)
+        except Exception:
+            self._poisoned = True  # donated slab state unknown (see step)
+            raise
+        if self._live == 0:
+            self._return_cache_if_idle()
+
+    def step(self) -> int:
+        """One engine round: splice queued arrivals (join prefill), advance
+        every live slot one fused step, release finished slots (leave).
+        ONE driver only — the :meth:`start` thread or a single test/bench
+        loop.  Returns the number of live slots remaining."""
+        with self._cond:
+            joiners = list(self._arrivals)
+            self._arrivals.clear()
+        leavers: List[StreamHandle] = []
+        try:
+            if joiners:
+                self._join(joiners, leavers)
+            if self._live:
+                self._advance(leavers)
+        except Exception:
+            # a failed dispatch leaves the donated slab state unknown —
+            # poison the borrow so close()/abort return None and the next
+            # borrower rebuilds zeros instead of consuming a dead buffer
+            self._poisoned = True
+            raise
+        self._finish(leavers)
+        if self._live == 0:
+            self._return_cache_if_idle()
+        return self._live
+
+    def _finish(self, leavers: List[StreamHandle]) -> None:
+        for h in leavers:
+            h.done.set()
+            if h.on_done is not None:
+                try:
+                    h.on_done(h)
+                except Exception:  # noqa: BLE001 — a reply callback must
+                    pass           # never kill the shared engine
+
+    def _join(self, joiners: List[StreamHandle],
+              leavers: List[StreamHandle]) -> None:
+        """Splice arrivals into their reserved slots.  Each joiner
+        prefills ALONE at the (1, prompt_bucket) signature into its
+        freshly allocated pool pages — per-row computation depends only
+        on that row's pages and mask, so the tokens are bit-identical to
+        one-shot prefill while the device work is proportional to the
+        ARRIVAL, not the batch width (a full-width join prefill costs
+        slots× the compute per join and dominated the trace's device
+        passes); live rows' pages are untouched because the prefill's
+        table argument only names the joiner's pages."""
+        import jax.numpy as jnp
+        runner = self.runner
+        self._borrow()
+        P_b, W = self.prompt_bucket, self.table_w
+        positions = np.broadcast_to(np.arange(P_b, dtype=np.int32),
+                                    (1, P_b))
+        pos_dev = jnp.asarray(positions)
+        for h in joiners:
+            s = h.slot
+            toks = np.zeros((1, P_b), np.int32)
+            toks[0, :h.length] = h.prompt
+            jtable = np.zeros((1, W), np.int32)
+            n = len(h.pages)
+            jtable[0, :n] = h.pages
+            self._table[s, :] = 0
+            self._table[s, :n] = h.pages
+            self._table_dirty = True
+            self._handles[s] = h
+            last, self._cache = self._prefill1(
+                runner.variables, jnp.asarray(toks), pos_dev,
+                jnp.asarray([h.length], np.int32), jnp.asarray(jtable),
+                self._cache)
+            tok_d, fin_d = self._sample1(last, jnp.zeros(1, bool))
+            tok0 = int(np.asarray(tok_d)[0])
+            fin0 = bool(np.asarray(fin_d)[0])
+            runner._c_batches["decode"].inc()
+            now = self.clock()
+            h.status = "live"
+            h.t_first_s = now
+            self._h_ttft.observe(max(0.0, now - h.t_submit_s))
+            self._c_joined.inc()
+            self.joined += 1
+            self._live += 1
+            self._lens[s] = h.length
+            self._emitted[s] = 1
+            self._tok[s] = tok0
+            self._fin[s] = fin0
+            self._tok_dev = None     # splice mutated host state
+            self._fin_dev = None
+            h.tokens.append(tok0)
+            runner._c_decode_tokens.inc()
+            runner._c_rows["decode"].inc()
+            if fin0 or h.max_new_tokens <= 1:
+                self._release(s, "ok", leavers)
+
+    def _advance(self, leavers: List[StreamHandle]) -> None:
+        """One fused step over the batch: deadline leaves first (never
+        spend a dispatch on a dead client), page-boundary extends (a
+        denial leaves the slot with its partial generation), then the
+        SAME donated step executable one-shot decode dispatches."""
+        import jax.numpy as jnp
+        runner = self.runner
+        now = self.clock()
+        for s, h in enumerate(self._handles):
+            if h is not None and h.deadline_s is not None \
+                    and now > h.deadline_s:
+                self._release(s, "expired", leavers)
+        if not self._live:
+            return
+        pos = np.zeros(self.slots, np.int32)
+        for s, h in enumerate(self._handles):
+            if h is None:
+                continue
+            p = int(self._lens[s] + self._emitted[s] - 1)
+            pos[s] = p
+            pi = p // self.page_size
+            if pi >= len(h.pages):
+                try:
+                    new_page = self.pool.extend()[0]
+                except PagePoolExhausted:
+                    # mid-flight denial: the slot leaves with what it has
+                    # (op="denied" already booked by the pool), its pages
+                    # fund the survivors
+                    self._release(s, "denied", leavers)
+                    continue
+                h.pages.append(new_page)
+                self._table[s, pi] = new_page
+                self._table_dirty = True
+        if not self._live:
+            return
+        if self._table_dirty or self._table_dev is None:
+            self._table_dev = jnp.asarray(self._table)
+            self._table_dirty = False
+        tok_in = self._tok_dev if self._tok_dev is not None \
+            else jnp.asarray(self._tok)
+        fin_in = self._fin_dev if self._fin_dev is not None \
+            else jnp.asarray(self._fin)
+        tok_d, fin_d, self._cache = self._step(
+            runner.variables, tok_in, jnp.asarray(pos),
+            self._table_dev, fin_in, self._cache)
+        # fin_in was donated (consumed) by the dispatch: rebind both device
+        # copies to the step's outputs; a release below invalidates them
+        self._tok_dev, self._fin_dev = tok_d, fin_d
+        tok, fin = np.asarray(tok_d), np.asarray(fin_d)
+        self.steps += 1
+        runner._c_decode_steps.inc()
+        for s, h in enumerate(self._handles):
+            if h is None:
+                continue
+            self._tok[s] = tok[s]
+            self._fin[s] = bool(fin[s])
+            self._emitted[s] += 1
+            h.tokens.append(int(tok[s]))
+            runner._c_decode_tokens.inc()
+            if self._fin[s] or len(h.tokens) >= h.max_new_tokens:
+                self._release(s, "ok", leavers)
+
+    def _release(self, s: int, outcome: str,
+                 leavers: List[StreamHandle]) -> None:
+        """Leave: free the slot's pages mid-flight, reset it to pad-row
+        state (trash table row, finished), and hand the slot back to
+        admission — the batch keeps stepping around it."""
+        h = self._handles[s]
+        self._handles[s] = None
+        h.status = outcome
+        if h.pages:
+            self.pool.free(h.pages)
+            h.pages = []
+        self._table[s, :] = 0
+        self._table_dirty = True
+        self._fin[s] = True
+        self._tok[s] = 0
+        self._lens[s] = 1
+        self._emitted[s] = 0
+        self._tok_dev = None     # host state mutated: next dispatch
+        self._fin_dev = None     # re-uploads instead of reusing device copies
+        self._c_left[outcome].inc()
+        self.left += 1
+        self._live -= 1
+        leavers.append(h)
+        with self._cond:
+            self._free.append(s)
+            self._book_occupancy()
+            self._cond.notify_all()
+
+    # -------------------------------------------------------------- lifecycle
+    def start(self) -> "ContinuousDecoder":
+        """Run the engine on a background thread: steps while any slot is
+        live, sleeps on the condition otherwise."""
+        with self._cond:
+            if self._torn:
+                raise RuntimeError("decoder is closed — build a fresh "
+                                   "stream (decode_stream()) instead")
+            if self._thread is not None:
+                return self
+            self._closed = False
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name=f"mmlspark-decode-stream-{self._name}")
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._closed and not self._arrivals \
+                        and self._live == 0:
+                    self._cond.wait(0.1)
+                if self._closed:
+                    return
+            try:
+                self.step()
+            except Exception:  # noqa: BLE001 — a poisoned step must not
+                self._abort()  # strand clients on done.wait
+                raise
+
+    def _abort(self) -> None:
+        """Engine failure: resolve every queued/live handle as ``error``
+        and drop the borrowed slabs (donated state unknown — the next
+        borrower rebuilds zeros)."""
+        with self._cond:
+            self._closed = True
+            self._poisoned = True
+            # the engine thread is exiting through this very call: clear
+            # the handle so close() does not block joining ourselves
+            self._thread = None
+            self._cond.notify_all()
+        self._teardown("error")
+
+    def _teardown(self, outcome: str) -> None:
+        """Release every queued/live handle with ``outcome`` and return
+        (or drop, when poisoned) the borrowed slabs.  Claimed exactly once
+        — ``_abort`` on the engine thread and ``close()`` on the caller
+        can otherwise race the release loop into double-freed pages and a
+        twice-listed free slot."""
+        with self._cond:
+            if self._torn:
+                return
+            self._torn = True
+            arrivals = list(self._arrivals)
+            self._arrivals.clear()
+        leavers: List[StreamHandle] = []
+        for h in arrivals:
+            self._cancel_arrival(h, outcome, leavers)
+        for s, h in enumerate(self._handles):
+            if h is not None:
+                self._release(s, outcome, leavers)
+        self._finish(leavers)
+        cache, self._cache = self._cache, None
+        if cache is not None:
+            self.pool.return_cache(None if self._poisoned else cache)
+
+    def _cancel_arrival(self, h: StreamHandle, outcome: str,
+                        leavers: List[StreamHandle]) -> None:
+        h.status = outcome
+        if h.pages:
+            self.pool.free(h.pages)
+            h.pages = []
+        self._c_left[outcome].inc()
+        self.left += 1
+        leavers.append(h)
+        with self._cond:
+            self._free.append(h.slot)
+            self._book_occupancy()
+
+    def close(self) -> None:
+        """Stop the engine, cancel queued arrivals and live slots (partial
+        tokens stay on their handles), free their pages, and return the
+        borrowed device slabs to the pool.  A closed decoder is final —
+        holders rebuild (``_RunnerScorer._ensure_decoder`` does)."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=60)
+        self._teardown("cancelled")
+
 
 class _RunnerScorer(Transformer):
     """Private serving front: built by :meth:`ModelRunner.scorer`, scored by
@@ -977,7 +1715,8 @@ class _RunnerScorer(Transformer):
 
     def __init__(self, runner: ModelRunner, input_col: str, reply_col: str,
                  prepare: Optional[Callable], encode: Optional[Callable],
-                 mode: str, decode_kwargs: Dict[str, Any]):
+                 mode: str, decode_kwargs: Dict[str, Any],
+                 continuous: bool = False, report_ttft: bool = False):
         super().__init__()
         self.runner = runner
         self.input_col, self.reply_col = input_col, reply_col
@@ -985,6 +1724,161 @@ class _RunnerScorer(Transformer):
         self.encode = encode or (lambda y: y)
         self.mode = mode
         self.decode_kwargs = dict(decode_kwargs)
+        self.continuous = bool(continuous)
+        self.report_ttft = bool(report_ttft)
+        self._decoder: Optional[ContinuousDecoder] = None
+        self._dec_lock = threading.Lock()
+        if self.continuous:
+            if mode != "decode":
+                raise ValueError("continuous=True requires mode='decode' "
+                                 "(scoring rows already admit into the "
+                                 "server's in-flight drain)")
+            # instance attribute, not a class method: its PRESENCE is the
+            # protocol — PipelineServer/streaming route entries here only
+            # when the model exposes it, so a score-mode scorer (or any
+            # other Transformer) never matches
+            self.continuous_submit = self._continuous_submit
+
+    # ---------------------------------------------------- continuous protocol
+    def _ensure_decoder(self) -> ContinuousDecoder:
+        with self._dec_lock:
+            if self._decoder is None or self._decoder.closed:
+                # a decoder whose engine aborted (poisoned dispatch) is
+                # permanently closed — rebuild rather than brick every
+                # later request on "decoder is closed"
+                self._decoder = self.runner.decode_stream(
+                    **self.decode_kwargs).start()
+            return self._decoder
+
+    def continuous_close(self) -> None:
+        """Stop the owned decode stream (PipelineServer.stop() calls this
+        when present); a later request lazily reopens it."""
+        with self._dec_lock:
+            decoder, self._decoder = self._decoder, None
+        if decoder is not None:
+            decoder.close()
+
+    def _reply_body(self, tokens, ttft_s: Optional[float]):
+        body = self.encode(np.asarray(tokens, np.int32))
+        if isinstance(body, np.ndarray):
+            # the default identity encode would otherwise reach the HTTP
+            # writer as an ndarray and serialize as a numpy string repr
+            body = body.tolist()
+        if self.report_ttft:
+            body = {"tokens": body,
+                    "ttft_ms": None if ttft_s is None
+                    else round(1000.0 * ttft_s, 3)}
+        return body
+
+    def _continuous_submit(self, payload, resolve, queue_age_s=0.0,
+                           deadline_budget_s=None) -> None:
+        """The serving seam (ISSUE 13): admit ONE request into the
+        in-flight batch.  ``resolve(reply=, status=, verdict=,
+        retry_after_s=, ttft_s=)`` fires on the engine thread at the
+        request's terminal outcome; admission failures raise out of here
+        with ``.shed`` set so the caller sheds 503 + Retry-After.
+
+        The caller's timing crosses the seam DOMAIN-FREE — ``queue_age_s``
+        (time already spent queued at the caller) and
+        ``deadline_budget_s`` (seconds of budget remaining) are relative,
+        never absolute timestamps, so a server on an injectable clock and
+        a decoder on ``time.monotonic`` can never be compared against each
+        other.  Reported TTFT = queue age + the engine's
+        submit-to-first-token."""
+        decoder = self._ensure_decoder()
+        prompt = np.asarray(payload, np.int32).reshape(-1)
+        deadline_s = None if deadline_budget_s is None \
+            else decoder.clock() + max(0.0, deadline_budget_s)
+        pre_s = max(0.0, queue_age_s or 0.0)
+
+        def on_done(h: StreamHandle) -> None:
+            if h.status == "ok":
+                ttft_s = None if h.ttft_s is None else pre_s + h.ttft_s
+                resolve(reply=self._reply_body(h.tokens, ttft_s),
+                        status=200, verdict="ok", ttft_s=ttft_s)
+            elif h.status == "denied":
+                resolve(reply={"error": "shed: page pool exhausted "
+                                        "mid-decode"},
+                        status=503, verdict="shed_page_pool",
+                        retry_after_s=1.0)
+            elif h.status == "expired":
+                resolve(reply={"error": "deadline expired mid-decode"},
+                        status=504, verdict="deadline_expired_decoding")
+            else:  # cancelled / error — the engine went away under us
+                resolve(reply={"error": f"decode {h.status}"},
+                        status=500, verdict="error")
+
+        decoder.submit(prompt, deadline_s=deadline_s, on_done=on_done)
+
+    # ------------------------------------------------------------- batch path
+    def _decode_batch(self, col, n: int, out: np.ndarray, age) -> None:
+        """Ticked/batch decode: one one-shot decode over the drained rows.
+        Mid-decode page denials surface per row as :class:`ShedReply`
+        (serving maps them to 503); ``report_ttft`` wraps replies with the
+        honest ticked TTFT — the full latency (queue age at drain + decode
+        wall, both RELATIVE durations so the server's clock domain never
+        leaks in), since no token is client-visible before the batch
+        resolves."""
+        t0 = time.monotonic()
+        prompts = [np.asarray(v, np.int32).reshape(-1) for v in col]
+        lengths = np.asarray([len(q) for q in prompts], np.int32)
+        P = int(lengths.max())
+        stacked = np.zeros((n, P), np.int32)
+        for i, q in enumerate(prompts):
+            stacked[i, :len(q)] = q
+        res = self.runner.decode(stacked, lengths=lengths,
+                                 **self.decode_kwargs)
+        denied = set((res.extras or {}).get("denied_rows", ()))
+        wall_s = time.monotonic() - t0
+        for i in range(n):
+            if i in denied:
+                out[i] = ShedReply("page pool exhausted mid-decode")
+            elif age is not None:
+                out[i] = self._reply_body(
+                    res.tokens[i], max(0.0, float(age[i])) + wall_s)
+            else:
+                out[i] = self._reply_body(res.tokens[i], None)
+
+    def _decode_batch_continuous(self, col, n: int, out: np.ndarray,
+                                 age) -> None:
+        """Batch front of a continuous scorer (streaming fallback, batch
+        transform): rows ride the live stream — submit each into a slot,
+        waiting for a free one when the batch is wider than the engine —
+        so the executable cache, pool accounting and metrics stay one
+        story."""
+        decoder = self._ensure_decoder()
+        handles: List[Optional[StreamHandle]] = [None] * n
+        outstanding: List[StreamHandle] = []
+        for i in range(n):
+            prompt = np.asarray(col[i], np.int32).reshape(-1)
+            while True:
+                try:
+                    handles[i] = decoder.submit(prompt)
+                    outstanding.append(handles[i])
+                    break
+                except SlotsExhausted:
+                    # the batch is wider than the engine (or concurrent
+                    # serving traffic holds every slot): wait for capacity
+                    # instead of shedding our own batch
+                    if outstanding:
+                        outstanding.pop(0).done.wait()
+                    else:
+                        time.sleep(0.005)
+                except PagePoolExhausted as ex:
+                    out[i] = ShedReply(str(ex))
+                    break
+        for i in range(n):
+            h = handles[i]
+            if h is None:
+                continue
+            h.done.wait()
+            if h.status == "ok":
+                pre_s = max(0.0, float(age[i])) if age is not None else 0.0
+                out[i] = self._reply_body(
+                    h.tokens, None if h.ttft_s is None
+                    else pre_s + h.ttft_s)
+            else:
+                out[i] = ShedReply(f"decode {h.status}")
 
     def _transform(self, df: DataFrame) -> DataFrame:
         def per_part(p):
@@ -993,17 +1887,11 @@ class _RunnerScorer(Transformer):
             out = np.empty(n, dtype=object)
             if n == 0:
                 return {**p, self.reply_col: out}
-            if self.mode == "decode":
-                prompts = [np.asarray(v, np.int32).reshape(-1) for v in col]
-                lengths = np.asarray([len(q) for q in prompts], np.int32)
-                P = int(lengths.max())
-                stacked = np.zeros((n, P), np.int32)
-                for i, q in enumerate(prompts):
-                    stacked[i, :len(q)] = q
-                res = self.runner.decode(stacked, lengths=lengths,
-                                         **self.decode_kwargs)
-                for i in range(n):
-                    out[i] = self.encode(res.tokens[i])
+            age = p.get("_enq_age_s") if hasattr(p, "get") else None
+            if self.mode == "decode" and self.continuous:
+                self._decode_batch_continuous(col, n, out, age)
+            elif self.mode == "decode":
+                self._decode_batch(col, n, out, age)
             else:
                 x = np.stack([self.prepare(v) for v in col])
                 y = self.runner.apply_batch(x, front="serving")

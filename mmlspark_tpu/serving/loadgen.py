@@ -17,10 +17,22 @@ Per-class gates (ISSUE 11): a workload may carry ``"gates": {"p99_ms":
 result gains a ``"gates"`` verdict — pass/fail per class with every
 limit/actual pair, the ROADMAP's "per-class p99 gates" hook reused by the
 fleet E2E suite and bench.
+
+TTFT gates (ISSUE 13): decode classes care about FIRST-token latency, not
+just whole-response p99 — a ticked drain that batches arrivals serves a
+fine p99 at low load while every request waits out the flush tick before
+its first token.  A workload carrying ``"ttft_key": "ttft_ms"`` has each
+2xx reply body parsed as JSON and that field collected (the continuous
+decode scorer reports it in-band via ``report_ttft=True``: engine-measured
+admission→first-token; the ticked scorer reports its honest value — the
+full latency, since no token is client-visible before the batch resolves).
+The class's stats gain ``ttft_p50_ms``/``ttft_p99_ms``/``ttft_count`` and
+the gate spec accepts ``ttft_p99_ms``/``ttft_p50_ms`` upper bounds.
 """
 from __future__ import annotations
 
 import http.client
+import json
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
@@ -54,6 +66,14 @@ def check_gates(gates: Dict[str, float],
             # its latency gate, the exact silent failure gates exist for
             ok = stats["completed"] > 0 and stats[name] <= limit
             book(name, stats[name], limit, ok)
+        elif name in ("ttft_p99_ms", "ttft_p50_ms"):
+            # same no-vacuous-pass rule, on the TTFT sample count: a class
+            # whose replies never carried the ttft field (no ttft_key, or
+            # a server that does not report it) must FAIL its ttft gate
+            # rather than pass on a 0.0 placeholder
+            actual = stats.get(name, 0.0)
+            ok = stats.get("ttft_count", 0.0) > 0 and actual <= limit
+            book(name, actual, limit, ok)
         elif name == "max_error_rate":
             intended = stats.get("intended", 0.0)
             if intended > 0:
@@ -69,7 +89,8 @@ def check_gates(gates: Dict[str, float],
             book(name, stats["rps"], limit, stats["rps"] >= limit)
         else:
             raise ValueError(f"unknown gate {name!r}; expected one of "
-                             "p99_ms/p50_ms/max_error_rate/min_rps")
+                             "p99_ms/p50_ms/ttft_p99_ms/ttft_p50_ms/"
+                             "max_error_rate/min_rps")
     return {"passed": not failures, "failures": failures, "checks": checks}
 
 
@@ -81,7 +102,10 @@ def mixed_load(host: str, port: int,
 
     Each workload is ``{"name", "path", "body", "headers", "n_clients",
     "per_client"}`` (``n_clients`` default 4, ``per_client`` default 100)
-    plus an optional ``"gates"`` spec (see :func:`check_gates`).  Every
+    plus an optional ``"gates"`` spec (see :func:`check_gates`) and an
+    optional ``"ttft_key"`` naming the reply-body field carrying in-band
+    first-token latency (adds ``ttft_p50_ms``/``ttft_p99_ms``/
+    ``ttft_count`` to the class's stats; see the module docstring).  Every
     client opens its own persistent connection, fires ``warm`` untimed
     requests, then waits on ONE barrier shared by every workload — the
     clock starts when the whole mixed fleet is warm, so the classes
@@ -104,6 +128,7 @@ def mixed_load(host: str, port: int,
     lats: Dict[str, List[float]] = {w["name"]: [] for w in workloads}
     errors: Dict[str, List[str]] = {w["name"]: [] for w in workloads}
     non_2xx: Dict[str, int] = {w["name"]: 0 for w in workloads}
+    ttfts: Dict[str, List[float]] = {w["name"]: [] for w in workloads}
     lock = threading.Lock()
     total_clients = sum(int(w.get("n_clients", 4)) for w in workloads)
     barrier = threading.Barrier(total_clients + 1)
@@ -111,7 +136,9 @@ def mixed_load(host: str, port: int,
     def fire(w: Dict[str, Any]):
         name = w["name"]
         body, headers = w["body"], w.get("headers") or {}
+        ttft_key = w.get("ttft_key")
         mine: List[float] = []
+        mine_ttft: List[float] = []
         mine_bad = 0
         try:
             conn = http.client.HTTPConnection(host, port, timeout=30)
@@ -135,10 +162,21 @@ def mixed_load(host: str, port: int,
                 t0 = time.perf_counter()
                 conn.request("POST", w["path"], body, headers)
                 resp = conn.getresponse()
-                resp.read()
+                data = resp.read()
                 mine.append(time.perf_counter() - t0)
                 if not 200 <= resp.status < 300:
                     mine_bad += 1
+                elif ttft_key:
+                    # in-band TTFT: the decode scorer reports first-token
+                    # latency inside the reply body (see module docstring);
+                    # a reply without the field just contributes no sample
+                    # — the ttft gate fails on a zero sample count
+                    try:
+                        val = json.loads(data.decode()).get(ttft_key)
+                        if val is not None:
+                            mine_ttft.append(float(val))
+                    except (ValueError, AttributeError):
+                        pass
         except Exception as e:  # noqa: BLE001 - count what completed
             with lock:
                 errors[name].append(repr(e))
@@ -146,6 +184,7 @@ def mixed_load(host: str, port: int,
             with lock:
                 lats[name].extend(mine)
                 non_2xx[name] += mine_bad
+                ttfts[name].extend(mine_ttft)
 
     threads = [threading.Thread(target=fire, args=(w,))
                for w in workloads for _ in range(int(w.get("n_clients", 4)))]
@@ -167,6 +206,12 @@ def mixed_load(host: str, port: int,
                 "p50_ms": 1000 * vals[len(vals) // 2] if vals else 0.0,
                 "p99_ms": 1000 * vals[int(len(vals) * 0.99)] if vals else 0.0}
 
+    def ttft_stats(vals: List[float]) -> Dict[str, float]:
+        vals = sorted(vals)
+        return {"ttft_count": float(len(vals)),
+                "ttft_p50_ms": vals[len(vals) // 2] if vals else 0.0,
+                "ttft_p99_ms": vals[int(len(vals) * 0.99)] if vals else 0.0}
+
     all_lats = [v for vs in lats.values() for v in vs]
     all_errs = [e for es in errors.values() for e in es]
     assert all_lats, f"no request completed; errors={all_errs[:3]}"
@@ -175,6 +220,8 @@ def mixed_load(host: str, port: int,
     for w in workloads:
         name = w["name"]
         st = stats(lats[name], errors[name], non_2xx[name])
+        if w.get("ttft_key"):
+            st.update(ttft_stats(ttfts[name]))
         # the class's intended request count: the honest error-rate
         # denominator (a dead client loses all its remaining requests)
         st["intended"] = float(int(w.get("n_clients", 4))
@@ -185,6 +232,9 @@ def mixed_load(host: str, port: int,
         result[name] = st
     result["combined"] = stats(all_lats, all_errs, sum(non_2xx.values()))
     result["combined"]["intended"] = intended_total
+    all_ttfts = [v for vs in ttfts.values() for v in vs]
+    if all_ttfts:
+        result["combined"].update(ttft_stats(all_ttfts))
     return result
 
 

@@ -147,6 +147,16 @@ class PipelineServer:
         if mode not in ("continuous", "micro_batch"):
             raise ValueError("mode must be continuous|micro_batch")
         self.model = model
+        # continuous admission protocol (ISSUE 13): a model exposing
+        # `continuous_submit(payload, resolve, queue_age_s=,
+        # deadline_budget_s=)` (the runner's continuous decode scorer)
+        # gets each drained entry
+        # handed to it the moment the drain sees it — the entry resolves
+        # per request from the model's own engine instead of with the
+        # batch, so a finished sequence replies while the rest keep
+        # decoding.  Duck-typed so serving never imports the models
+        # package (a pure-python pipeline must not pay a jax import).
+        self._continuous_submit = getattr(model, "continuous_submit", None)
         self.input_col, self.reply_col = input_col, reply_col
         self.host, self.port, self.api_path = host, port, api_path
         self.mode = mode
@@ -627,13 +637,33 @@ class PipelineServer:
                 verdicts[e.uid] = "shed_queue_age"
             else:
                 live.append(e)
+        # continuous admission (ISSUE 13): entries go to the model's own
+        # in-flight engine one by one and resolve from it per request —
+        # admission failures (no free slot / page pool exhausted) shed THIS
+        # entry with 503 + Retry-After and ride the normal resolution loop
+        deferred: set = set()
+        if live and self.mode == "continuous" and \
+                self._continuous_submit is not None:
+            for e in live:
+                if self._submit_continuous(e, max(0.0, now - e.t_enq)):
+                    deferred.add(e.uid)
+                elif e.status == 503:
+                    verdicts[e.uid] = "shed_decode_admission"
+            live = []
         score_s = 0.0
         if live:
             col = np.empty(len(live), dtype=object)
             for i, e in enumerate(live):
                 col[i] = e.payload
             ids = np.asarray([e.uid for e in live], dtype=object)
-            df = DataFrame([{self.input_col: col, "id": ids}])
+            # `_enq_age_s` (queue age at drain — a RELATIVE duration, so
+            # the server's injectable clock never leaks its domain into
+            # the scorer) rides along so a TTFT-reporting scorer can
+            # anchor first-token latency at admission (extra columns pass
+            # through any transformer untouched)
+            df = DataFrame([{self.input_col: col, "id": ids,
+                             "_enq_age_s": np.asarray(
+                                 [max(0.0, now - e.t_enq) for e in live])}])
             # scoring runs under the TIGHTEST deadline in the batch so any
             # HTTP fan-out inside the pipeline (io/http, cognitive) clips
             # its own timeouts/retries to what the most impatient caller
@@ -653,16 +683,39 @@ class PipelineServer:
                         out = self.model.transform(df).collect()
                 replies = out[self.reply_col]
                 for e, r in zip(live, replies):
-                    e.reply = self.reply_encoder(r)
+                    # per-row shed sentinel (duck-typed `shed_reason`): a
+                    # scorer refusing ONE row — mid-decode page denial —
+                    # sheds that request without failing its batchmates
+                    reason = getattr(r, "shed_reason", None)
+                    if reason is not None:
+                        e.status = 503
+                        e.reply = {"error": f"shed: {reason}"}
+                        e.retry_after_s = getattr(r, "retry_after_s", None) \
+                            or self.shed_retry_after_s
+                        verdicts[e.uid] = "shed_row"
+                    else:
+                        e.reply = self.reply_encoder(r)
             except Exception as ex:  # noqa: BLE001 — reply errors per-request
-                for e in live:
-                    e.status, e.reply = 500, {"error": str(ex)}
+                if getattr(ex, "shed", False):
+                    # backpressure raised out of the scorer (pool/slot
+                    # exhaustion at admission): tell callers to back off
+                    # instead of reporting a server fault
+                    for e in live:
+                        e.status = 503
+                        e.reply = {"error": f"shed: {ex}"}
+                        e.retry_after_s = self.shed_retry_after_s
+                        verdicts[e.uid] = "shed_backpressure"
+                else:
+                    for e in live:
+                        e.status, e.reply = 500, {"error": str(ex)}
             score_s = max(0.0, self.clock() - t_score0)
             for e in live:
                 self._h_phase_score.observe(score_s, e.trace_id)
         with self.stats.lock:
-            self._pending -= len(batch)
+            self._pending -= (len(batch) - len(deferred))
         for e in batch:
+            if e.uid in deferred:
+                continue
             # one serving.request span per entry, back-dated to its enqueue
             # time on the server clock: queue wait + score in one record,
             # joined to the caller's trace.  `server` scopes /debug/slow to
@@ -683,6 +736,63 @@ class PipelineServer:
             e.span_id = span.span_id  # before done.set(): the handler may
             export_span(span, self.registry)  # echo it in `traceparent`
             e.done.set()
+
+    def _submit_continuous(self, e: _Entry, queue_s: float) -> bool:
+        """Hand one admitted entry to the model's continuous engine.
+
+        Returns True when the engine owns resolution (the entry's span,
+        pending slot and done event are settled by the ``resolve`` callback
+        on the engine thread, per request); False when admission failed —
+        the entry's status is set here (503 for shed-typed failures, 500
+        otherwise) and it rides the caller's normal resolution loop.
+
+        Timing crosses the seam as RELATIVE durations (queue age, deadline
+        budget) — the model's engine runs on its own clock and must never
+        compare this server's (injectable) clock values."""
+        t_submit = self.clock()
+
+        def resolve(reply=None, status=200, verdict="ok",
+                    retry_after_s=None, ttft_s=None):
+            # 200 replies ride the server's reply_encoder exactly like the
+            # batch path — a custom encoder applies to both drains
+            e.status = status
+            e.reply = self.reply_encoder(reply) if status == 200 else reply
+            if retry_after_s is not None:
+                e.retry_after_s = retry_after_s
+            score_s = max(0.0, self.clock() - t_submit)
+            self._h_phase_score.observe(score_s, e.trace_id)
+            with self.stats.lock:
+                self._pending -= 1
+            attrs = {"status": status,
+                     "queue_s": round(queue_s, 6),
+                     "score_s": round(score_s, 6),
+                     "server": self._server_label,
+                     "verdict": verdict}
+            if ttft_s is not None:
+                attrs["ttft_s"] = round(ttft_s, 6)
+            span = Span("serving.request", trace_id=e.trace_id,
+                        clock=self.clock, start_s=e.t_enq, attributes=attrs)
+            if status != 200:
+                span.status = f"http:{status}"
+            span.finish()
+            e.span_id = span.span_id  # before done.set(): traceparent echo
+            export_span(span, self.registry)
+            e.done.set()
+
+        try:
+            self._continuous_submit(
+                e.payload, resolve=resolve,
+                queue_age_s=max(0.0, t_submit - e.t_enq),
+                deadline_budget_s=max(0.0, e.t_deadline - t_submit))
+            return True
+        except Exception as ex:  # noqa: BLE001 — admission failure shapes
+            if getattr(ex, "shed", False):
+                e.status = 503
+                e.reply = {"error": f"shed: {ex}"}
+                e.retry_after_s = self.shed_retry_after_s
+            else:
+                e.status, e.reply = 500, {"error": str(ex)}
+            return False
 
     def _worker(self):
         while not self._stop.is_set():
@@ -735,6 +845,12 @@ class PipelineServer:
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
+        # a continuous-decode scorer owns a live engine thread + borrowed
+        # pool slabs: close it with the server (in-flight entries resolve
+        # as cancelled; a restarted scorer lazily reopens the stream)
+        closer = getattr(self.model, "continuous_close", None)
+        if closer is not None:
+            closer()
         # unhook the callback gauges: their closures capture this server,
         # so leaving them registered would pin a stopped server (and emit
         # frozen queue/EWMA series) for process lifetime.  Counter and

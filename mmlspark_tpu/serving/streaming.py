@@ -175,13 +175,20 @@ class HTTPStreamSource:
                            self.value_col: vals}])
 
     def reply(self, ids, replies, encoder=None) -> None:
-        """Sink half: complete requests by id (``ServingUDFs.sendReplyUDF``)."""
+        """Sink half: complete requests by id (``ServingUDFs.sendReplyUDF``).
+        A per-row shed sentinel (duck-typed ``shed_reason`` — the decode
+        scorer's mid-flight page denial, ISSUE 13) completes as a 503 shed
+        instead of encoding the sentinel object into a 200 body."""
         encoder = encoder or _default_encode
         with self._lock:
             entries = [self._pending.get(str(u)) for u in ids]
         for e, r in zip(entries, replies):
             if e is not None:
-                e.reply = encoder(r)
+                reason = getattr(r, "shed_reason", None)
+                if reason is not None:
+                    e.status, e.reply = 503, {"error": f"shed: {reason}"}
+                else:
+                    e.reply = encoder(r)
                 e.done.set()
 
 
@@ -201,12 +208,24 @@ class StreamingQuery:
         self.last_error: Optional[str] = None
 
     def _loop(self):
+        # continuous admission (ISSUE 13): a model exposing
+        # `continuous_submit` (the runner's continuous decode scorer) gets
+        # each drained row the moment the trigger sees it, and every row
+        # replies from the model's own engine as IT finishes — the trigger
+        # loop goes back to draining instead of blocking on the batch
+        submit = getattr(self.model, "continuous_submit", None)
         while not self._stop.is_set():
             batch = self.source.get_batch(self.max_rows)
             if batch is None:
                 time.sleep(self.interval_s)
                 continue
-            ids = batch.collect()[self.source.id_col]
+            cols = batch.collect()
+            ids = cols[self.source.id_col]
+            if submit is not None:
+                vals = cols[self.source.value_col]
+                for u, v in zip(ids, vals):
+                    self._submit_one(submit, str(u), v)
+                continue
             try:
                 out = self.model.transform(batch).collect()
                 self.source.reply(ids, out[self.reply_col])
@@ -219,6 +238,28 @@ class StreamingQuery:
                         en.status, en.reply = 500, {"error": str(e)}
                         en.done.set()
 
+    def _submit_one(self, submit, uid: str, payload) -> None:
+        """Admit one row into the model's in-flight engine; shed-typed
+        admission failures reply 503 so the client backs off."""
+        def resolve(reply=None, status=200, verdict=None,
+                    retry_after_s=None, ttft_s=None):
+            with self.source._lock:
+                entry = self.source._pending.get(uid)
+            if entry is not None:
+                entry.status = status
+                # 200s ride the same default encoding as the batch sink
+                entry.reply = _default_encode(reply) if status == 200 \
+                    else reply
+                entry.done.set()
+
+        try:
+            submit(payload, resolve=resolve)
+        except Exception as e:  # noqa: BLE001 — per-row admission verdict
+            self.last_error = str(e)
+            status = 503 if getattr(e, "shed", False) else 500
+            prefix = "shed: " if status == 503 else ""
+            resolve(reply={"error": f"{prefix}{e}"}, status=status)
+
     def start(self) -> "StreamingQuery":
         self.source.start()
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -228,6 +269,9 @@ class StreamingQuery:
     def stop(self) -> None:
         self._stop.set()
         self.source.stop()
+        closer = getattr(self.model, "continuous_close", None)
+        if closer is not None:
+            closer()
 
     def await_termination(self, timeout_s: float) -> None:
         time.sleep(timeout_s)

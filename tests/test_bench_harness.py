@@ -251,6 +251,68 @@ def test_runner_paged_marker_folds_with_gate_and_proxy_note():
         bench.RESULT["extras"].clear()
 
 
+def test_runner_cont_marker_folds_with_gate_parity_and_compile_checks():
+    """ISSUE 13: the continuous-vs-ticked A/B folds its tokens/sec pair +
+    ratio, the parity and join-compile counter checks note failures
+    attributably, the on-chip 1.5x gate notes a miss, and a CPU-proxy run
+    records ratio + parity instead of gating.  The marker is additive —
+    an older child without it still folds the other runner markers."""
+    proc = _child(
+        "print('RUNNER_CONT 82.0 140.0 1.707 1 0 0')\n")
+    got = bench._collect_multi(proc, ("RUNNER_CONT",), idle=10, hard=20)
+    bench.RESULT["extras"].clear()
+    try:
+        assert bench._record_runner(got)
+        ex = bench.RESULT["extras"]
+        assert ex["decode_ticked_tokens_per_sec"] == 82.0
+        assert ex["decode_cont_tokens_per_sec"] == 140.0
+        assert ex["decode_cont_vs_ticked"] == 1.707
+        assert ex["decode_cont_parity"] == "ok"
+        assert ex["decode_cont_join_step_compiles"] == 0
+        assert "runner" not in ex.get("phase_notes", {})
+    finally:
+        bench.RESULT["extras"].clear()
+    # below the on-chip gate -> attributable note
+    try:
+        assert bench._record_runner(
+            {"RUNNER_CONT": [100.0, 120.0, 1.2, 1, 0, 0]})
+        assert "1.5x" in bench.RESULT["extras"]["phase_notes"]["runner"]
+    finally:
+        bench.RESULT["extras"].clear()
+    # parity mismatch leaves its note (and the extra says MISMATCH)
+    try:
+        assert bench._record_runner(
+            {"RUNNER_CONT": [100.0, 180.0, 1.8, 0, 0, 0]})
+        ex = bench.RESULT["extras"]
+        assert ex["decode_cont_parity"] == "MISMATCH"
+        assert "DIVERGED" in ex["phase_notes"]["runner"]
+    finally:
+        bench.RESULT["extras"].clear()
+    # a join-minted step compile leaves its note
+    try:
+        assert bench._record_runner(
+            {"RUNNER_CONT": [100.0, 180.0, 1.8, 1, 2, 0]})
+        ex = bench.RESULT["extras"]
+        assert ex["decode_cont_join_step_compiles"] == 2
+        assert "compile" in ex["phase_notes"]["runner"]
+    finally:
+        bench.RESULT["extras"].clear()
+    # CPU proxy flag -> cover note, the 1.5x gate does NOT apply
+    try:
+        assert bench._record_runner(
+            {"RUNNER_CONT": [100.0, 120.0, 1.2, 1, 0, 1]})
+        note = bench.RESULT["extras"]["phase_notes"]["runner"]
+        assert "proxy" in note and "1.5x" in note
+    finally:
+        bench.RESULT["extras"].clear()
+    # marker-optional back-compat: RUNNER_AB alone still folds
+    try:
+        assert bench._record_runner({"RUNNER_AB": [1000.0, 980.0, 0.98]})
+        assert "decode_cont_vs_ticked" not in bench.RESULT["extras"]
+    finally:
+        bench.RESULT["extras"].clear()
+
+
 def test_phase_metrics_snapshot_folds_into_extras():
     """ISSUE 11: each phase child prints a bounded PHASE_METRICS registry
     snapshot; the parent folds it under extras.phase_metrics so bench

@@ -1,0 +1,672 @@
+"""Continuous batching: slot-level join/leave on the paged pool (ISSUE 13).
+
+The acceptance contracts this file pins:
+
+- continuous-vs-one-shot greedy BIT-parity: tokens from the in-flight
+  engine equal one-shot ``decode()`` for every request, across ragged
+  arrivals (joins mid-flight into reused slots), eos leaves, and
+  page-boundary joins/extends — and joins after warmup cause ZERO new
+  step-executable compiles (the no-new-compile-keys rule);
+- slot-reuse accounting: a freed slot's pages are reusable while the
+  batch keeps running (a pool sized so later requests only fit if leaves
+  free mid-flight), and occupancy returns to zero at quiescence;
+- admission control: slot/page exhaustion raises shed-typed errors at
+  submit (503 in serving), a budgeted pool exhausting MID-decode yields a
+  clean partial result (one-shot) / a ``denied`` leave (stream) with
+  ``page_ops_total{op="denied"}`` booked — never an exception out of the
+  scorer thread;
+- FakeClock TTFT/occupancy metric semantics, and the serving fronts end
+  to end over real sockets: per-request replies from the in-flight batch,
+  in-band ``ttft_ms``, and the ``mixed_load`` ttft gate passing on a
+  continuous server at a load where the ticked drain fails it.
+"""
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+
+def post_json(port, path, obj, timeout=30, return_headers=False,
+              method_get=False):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    if method_get:
+        conn.request("GET", path)
+    else:
+        conn.request("POST", path, json.dumps(obj),
+                     {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    raw = resp.read().decode()
+    conn.close()
+    body = raw if method_get else json.loads(raw)
+    if return_headers:
+        return resp.status, body, dict(resp.getheaders())
+    return resp.status, body
+
+
+def _tiny_lm(vocab=48, layers=2, seed=0, max_len=128):
+    import jax
+    import jax.numpy as jnp
+    from mmlspark_tpu.models import TransformerEncoder
+    mod = TransformerEncoder(vocab_size=vocab, num_classes=vocab,
+                             embed_dim=32, num_heads=2, num_layers=layers,
+                             mlp_dim=64, max_len=max_len, causal=True,
+                             pool="none")
+    variables = mod.init(jax.random.PRNGKey(seed),
+                         jnp.zeros((1, 4), jnp.int32))
+    return mod, variables
+
+
+def _runner(name, layers=2, registry=None):
+    from mmlspark_tpu.models import ModelRunner
+    mod, variables = _tiny_lm(layers=layers)
+    return ModelRunner(module=mod, variables=variables, name=name,
+                      registry=registry)
+
+
+#: parity tests share one runner so executables stay warm across tests
+_SHARED = {}
+
+
+def _shared_runner():
+    runner = _SHARED.get("runner")
+    if runner is None:
+        runner = _SHARED["runner"] = _runner("cont.shared", layers=1)
+    return runner
+
+
+def _drain(dec, pending=None):
+    """Drive a (non-started) decoder to quiescence, submitting ``pending``
+    [(prompt, budget)] with backpressure (wait for a leave on
+    SlotsExhausted)."""
+    from mmlspark_tpu.models import SlotsExhausted
+    handles = []
+    pending = list(pending or [])
+    while pending or dec._arrivals or dec._live:
+        while pending:
+            try:
+                p, b = pending[0]
+                handles.append(dec.submit(p, max_new_tokens=b))
+                pending.pop(0)
+            except SlotsExhausted:
+                break
+        dec.step()
+    return handles
+
+
+# ---------------------------------------------------------------------------
+# bit-parity + the no-new-compile-keys rule
+# ---------------------------------------------------------------------------
+
+def test_continuous_bit_identical_to_one_shot_across_ragged_arrivals():
+    """The acceptance gate: requests joining the in-flight batch at
+    arbitrary step boundaries — including REUSED slots whose previous
+    owner's pages went back to the pool, and prompts/budgets that cross
+    page boundaries (page_size=4) — generate tokens BIT-identical to
+    one-shot ``decode()`` of each prompt alone.  And the whole trace,
+    joins included, causes zero new step-executable compiles after
+    warmup."""
+    runner = _shared_runner()
+    dec = runner.decode_stream(slots=4, prompt_bucket=8, max_new_tokens=9,
+                               page_size=4)
+    dec.warmup()
+    n0 = runner.compile_stats()["compiles"]
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 48, int(rng.integers(2, 9))).astype(np.int32)
+               for _ in range(8)]
+    budgets = [9, 4, 7, 2, 9, 5, 3, 8]
+    handles = _drain(dec, list(zip(prompts, budgets)))
+    assert runner.compile_stats()["compiles"] == n0, \
+        "a join minted a new compile key"
+    slots_used = {h.slot for h in handles}
+    assert len(handles) == 8 and len(slots_used) <= 4  # slots were reused
+    for p, b, h in zip(prompts, budgets, handles):
+        assert h.status == "ok"
+        ref = runner.decode(p[None], max_new_tokens=b, kv_layout="paged",
+                            page_size=4)
+        np.testing.assert_array_equal(np.asarray(h.tokens), ref.tokens[0])
+        # result() round-trips the same tokens as a DecodeResult
+        np.testing.assert_array_equal(h.result(timeout=1).tokens[0],
+                                      ref.tokens[0])
+    dec.close()
+
+
+def test_eos_leave_matches_one_shot_and_frees_the_slot():
+    """An eos mid-generation leaves the slot immediately (one-shot keeps
+    dispatching frozen rows; the stream's truncation-at-freeze is the same
+    token sequence), and the freed slot takes the next arrival while the
+    other slot keeps decoding."""
+    runner = _shared_runner()
+    rng = np.random.default_rng(3)
+    p = rng.integers(0, 48, 6).astype(np.int32)
+    # pick the token the model actually emits as the eos id, so greedy
+    # deterministically "finishes" mid-generation
+    probe = runner.decode(p[None], max_new_tokens=8, kv_layout="paged",
+                          page_size=4)
+    eos = int(probe.tokens[0][2])               # freezes at the 3rd token
+    ref = runner.decode(p[None], max_new_tokens=8, eos_id=eos,
+                        kv_layout="paged", page_size=4)
+    dec = runner.decode_stream(slots=2, prompt_bucket=8, max_new_tokens=8,
+                               eos_id=eos, page_size=4)
+    q = rng.integers(0, 48, 5).astype(np.int32)
+    h1 = dec.submit(p, max_new_tokens=8)
+    h2 = dec.submit(q, max_new_tokens=8)
+    seen_free = False
+    h3 = None
+    while dec._arrivals or dec._live:
+        dec.step()
+        if h1.done.is_set() and h3 is None and dec._live:
+            seen_free = True                   # h2 still decoding
+            h3 = dec.submit(q, max_new_tokens=8)
+    assert h1.status == "ok" and seen_free and h3 is not None
+    np.testing.assert_array_equal(np.asarray(h1.tokens),
+                                  ref.tokens[0][:len(h1.tokens)])
+    # the stream stops at the freeze; one-shot pads frozen rows with eos
+    assert h1.tokens[-1] == eos
+    assert set(ref.tokens[0][len(h1.tokens):].tolist()) <= {eos}
+    ref_q = runner.decode(q[None], max_new_tokens=8, eos_id=eos,
+                          kv_layout="paged", page_size=4)
+    for h in (h2, h3):
+        assert h.done.wait(1) and h.status == "ok"
+        np.testing.assert_array_equal(np.asarray(h.tokens),
+                                      ref_q.tokens[0][:len(h.tokens)])
+    dec.close()
+
+
+# ---------------------------------------------------------------------------
+# slot reuse / pool accounting
+# ---------------------------------------------------------------------------
+
+def test_freed_slot_pages_fund_later_requests_while_batch_runs():
+    """A pool sized so the trace only completes if leaves free pages
+    MID-flight: request A (short budget) leaves while B keeps decoding,
+    and A's pages are what C's prefill + B's later extends consume."""
+    from mmlspark_tpu.models import PagePool
+    from mmlspark_tpu.observability import MetricsRegistry
+
+    reg = MetricsRegistry()
+    runner = _runner("cont.reuse", layers=1, registry=reg)
+    pool = PagePool(runner.module, num_pages=7, page_size=2,
+                    name="cont.reuse", registry=reg)
+    dec = runner.decode_stream(slots=2, prompt_bucket=4, max_new_tokens=6,
+                               pool=pool)
+    rng = np.random.default_rng(5)
+    A = rng.integers(0, 48, 4).astype(np.int32)   # 2 pages at prefill
+    B = rng.integers(0, 48, 4).astype(np.int32)   # 2 pages + extends
+    C = rng.integers(0, 48, 4).astype(np.int32)   # needs A's freed pages
+    hA = dec.submit(A, max_new_tokens=2)           # leaves after 1 step
+    hB = dec.submit(B, max_new_tokens=6)           # 4 of 6 pages held
+    hC = None
+    while dec._arrivals or dec._live:
+        dec.step()
+        if hA.done.is_set() and hC is None:
+            hC = dec.submit(C, max_new_tokens=2)   # only fits if A freed
+    assert hA.status == hB.status == hC.status == "ok"
+    for p, b, h in ((A, 2, hA), (B, 6, hB), (C, 2, hC)):
+        ref = runner.decode(p[None], max_new_tokens=b, kv_layout="paged",
+                            page_size=2, pool=pool)
+        np.testing.assert_array_equal(np.asarray(h.tokens), ref.tokens[0])
+    assert pool.pages_in_use() == 0 and pool.high_water <= pool.capacity
+    fam = reg.family("mmlspark_runner_page_ops_total")
+    ops = {op: fam.labels(runner="cont.reuse", page_size="2", op=op).value
+           for op in ("allocate", "extend", "free", "denied")}
+    assert ops["denied"] == 0
+    assert ops["free"] == ops["allocate"] + ops["extend"]
+    dec.close()
+
+
+def test_admission_control_sheds_on_slots_and_pages():
+    """submit() is the admission decision: no free slot raises
+    SlotsExhausted, an unfundable prompt raises PagePoolExhausted with the
+    denial booked as op="denied" — both carry the serving layer's shed
+    duck-type."""
+    from mmlspark_tpu.models import (PagePool, PagePoolExhausted,
+                                     SlotsExhausted)
+    from mmlspark_tpu.observability import MetricsRegistry
+
+    reg = MetricsRegistry()
+    runner = _runner("cont.admit", layers=1, registry=reg)
+    pool = PagePool(runner.module, num_pages=4, page_size=2,
+                    name="cont.admit", registry=reg)
+    dec = runner.decode_stream(slots=2, prompt_bucket=4, max_new_tokens=2,
+                               pool=pool)
+    p = np.asarray([1, 2, 3, 4], np.int32)
+    dec.submit(p)
+    dec.submit(np.asarray([1], np.int32))
+    with pytest.raises(SlotsExhausted) as ei:
+        dec.submit(p)
+    assert getattr(ei.value, "shed", False) is True
+    assert dec.occupancy() == 2
+    dec.close()   # cancelled arrivals release their slots + pages
+    assert pool.pages_in_use() == 0 and dec.occupancy() == 0
+    # page admission: 2 slots free but the pool can't fund a 2-page prompt
+    dec2 = runner.decode_stream(slots=2, prompt_bucket=4, max_new_tokens=2,
+                                pool=pool)
+    pool.allocate(2)                              # external hold
+    with pytest.raises(PagePoolExhausted) as ei2:
+        dec2.submit(p)                            # needs 2, 1 free
+    assert getattr(ei2.value, "shed", False) is True
+    fam = reg.family("mmlspark_runner_page_ops_total")
+    assert fam.labels(runner="cont.admit", page_size="2",
+                      op="denied").value == 2.0
+    assert dec2.occupancy() == 0                  # failed submit holds nothing
+    dec2.close()
+
+
+def test_idle_stream_adopts_resized_pool():
+    """Review regression: `page_pool(num_pages=)` (and auto-pool growth)
+    REPLACE the runner's pool object — a stream keeping the old reference
+    would allocate from an orphaned budget, the operator's resize silently
+    not applying.  An idle stream re-binds at its next submit."""
+    runner = _runner("cont.resize", layers=1)
+    dec = runner.decode_stream(slots=2, prompt_bucket=4, max_new_tokens=2,
+                               page_size=2)
+    h = dec.submit(np.asarray([1, 2], np.int32))
+    _drain(dec)
+    assert h.status == "ok"
+    old = dec.pool
+    new = runner.page_pool(2, num_pages=64)       # operator resize hatch
+    assert new is not old and new.num_pages == 64
+    h2 = dec.submit(np.asarray([3, 4], np.int32))
+    assert dec.pool is new and new.pages_in_use() > 0
+    _drain(dec)
+    assert h2.status == "ok" and new.pages_in_use() == 0
+    dec.close()
+
+
+# ---------------------------------------------------------------------------
+# mid-decode pool exhaustion: clean partial results (ISSUE 13 bugfix)
+# ---------------------------------------------------------------------------
+
+def test_budgeted_pool_exhausting_mid_decode_yields_partial_result():
+    """One-shot half of the satellite bugfix: an explicitly budgeted pool
+    that cannot fund a page-boundary extend FREEZES the row — tokens up to
+    the denial match the unconstrained run, the tail is eos padding, the
+    denial is booked, and nothing raises out of the decode."""
+    from mmlspark_tpu.models import PagePool
+    from mmlspark_tpu.observability import MetricsRegistry
+
+    reg = MetricsRegistry()
+    runner = _runner("cont.partial", layers=1, registry=reg)
+    free = runner.decode(np.asarray([[3, 1, 4, 1]], np.int32),
+                         max_new_tokens=6, kv_layout="paged", page_size=2)
+    # 2 prefill pages + ZERO headroom: the first extend (frontier at
+    # position 4) must be denied
+    pool = PagePool(runner.module, num_pages=3, page_size=2,
+                    name="cont.partial", registry=reg)
+    res = runner.decode(np.asarray([[3, 1, 4, 1]], np.int32),
+                        max_new_tokens=6, pool=pool)
+    assert res.extras["denied_rows"] == [0]
+    cut = res.extras["denied_at"][0]
+    assert 1 <= cut < 6
+    np.testing.assert_array_equal(res.tokens[0][:cut], free.tokens[0][:cut])
+    assert set(res.tokens[0][cut:].tolist()) <= {0}      # clean eos/0 tail
+    assert pool.pages_in_use() == 0                      # denial freed them
+    fam = reg.family("mmlspark_runner_page_ops_total")
+    assert fam.labels(runner="cont.partial", page_size="2",
+                      op="denied").value > 0
+
+
+def test_fused_path_denial_stays_frozen_and_tokens_stay_honest():
+    """Review regression: on the FUSED path the device-resident finished
+    mask never learns of a host-side page denial — without folding it back
+    in, the denied row thaws on the next device fetch, its trash-page
+    tokens re-inflate `real_tokens`/`mmlspark_runner_decode_tokens_total`
+    (the exact inflation the PR 12 bugfix removed), and the eos early-exit
+    can never fire.  Two rows, fused greedy, a pool that denies one row's
+    first extend: the denied row must contribute exactly its pre-denial
+    token to the counters while the survivor completes its full budget."""
+    from mmlspark_tpu.models import PagePool
+    from mmlspark_tpu.observability import MetricsRegistry
+
+    reg = MetricsRegistry()
+    runner = _runner("cont.thaw", layers=1, registry=reg)
+    prompts = np.random.default_rng(7).integers(0, 48, (2, 4)).astype(np.int32)
+    free = runner.decode(prompts, max_new_tokens=6, kv_layout="paged",
+                         page_size=2)
+    # capacity 5: prefill holds 2+2, row 0 takes the free page at the
+    # first extend, row 1 is DENIED there (cut=1); its freed pages fund
+    # row 0's remaining extends
+    pool = PagePool(runner.module, num_pages=6, page_size=2,
+                    name="cont.thaw", registry=reg)
+    fam = reg.family("mmlspark_runner_decode_tokens_total")
+    before = fam.labels(runner="cont.thaw").value
+    res = runner.decode(prompts, max_new_tokens=6, pool=pool)
+    assert res.extras["denied_rows"] == [1]
+    assert res.extras["denied_at"] == {1: 1}
+    np.testing.assert_array_equal(res.tokens[0], free.tokens[0])
+    np.testing.assert_array_equal(res.tokens[1][:1], free.tokens[1][:1])
+    # 2 rows at t=0 + the survivor alone for t=1..5 — NOT 2*6
+    assert res.extras["real_tokens"] == 7
+    assert fam.labels(runner="cont.thaw").value - before == 7.0
+
+
+def test_stream_mid_flight_denial_resolves_denied_and_slot_recovers():
+    """Stream half: the denied slot leaves with its partial generation
+    (status "denied"), its pages fund the survivors, and the slot is
+    admissible again while the batch keeps running."""
+    from mmlspark_tpu.models import PagePool
+    from mmlspark_tpu.observability import MetricsRegistry
+
+    reg = MetricsRegistry()
+    runner = _runner("cont.deny", layers=1, registry=reg)
+    # capacity 5: prefill holds 2+2; the one free page funds slot 0's
+    # first extend, slot 1's is DENIED — and slot 1's freed pages are
+    # exactly what slot 0's remaining extends (5 pages total for a
+    # 6-token budget) need to complete
+    pool = PagePool(runner.module, num_pages=6, page_size=2,
+                    name="cont.deny", registry=reg)
+    dec = runner.decode_stream(slots=2, prompt_bucket=4, max_new_tokens=6,
+                               pool=pool)
+    p = np.asarray([3, 1, 4, 1], np.int32)       # 2 pages each at prefill
+    hA = dec.submit(p, max_new_tokens=6)
+    hB = dec.submit(p + 1, max_new_tokens=6)
+    _drain(dec)
+    statuses = sorted([hA.status, hB.status])
+    assert statuses == ["denied", "ok"], statuses
+    denied, okh = (hA, hB) if hA.status == "denied" else (hB, hA)
+    assert 1 <= len(denied.tokens) < 6 and len(okh.tokens) == 6
+    assert denied.result(timeout=1).extras["status"] == "denied"
+    assert pool.pages_in_use() == 0
+    fam = reg.family("mmlspark_runner_slots_left_total")
+    assert fam.labels(runner="cont.deny", outcome="denied").value == 1.0
+    assert fam.labels(runner="cont.deny", outcome="ok").value == 1.0
+    dec.close()
+
+
+# ---------------------------------------------------------------------------
+# FakeClock TTFT + occupancy metric semantics
+# ---------------------------------------------------------------------------
+
+def test_ttft_and_occupancy_metrics_on_fake_clock():
+    from mmlspark_tpu.observability import MetricsRegistry
+    from mmlspark_tpu.utils.resilience import FakeClock
+
+    clk = FakeClock()
+    reg = MetricsRegistry()
+    runner = _runner("cont.clock", layers=1, registry=reg)
+    dec = runner.decode_stream(slots=4, prompt_bucket=4, max_new_tokens=3,
+                               page_size=2, clock=clk)
+    occ = reg.family("mmlspark_runner_slot_occupancy_pct")
+    assert occ.labels(runner="cont.clock").value == 0.0
+    p = np.asarray([5, 7], np.int32)
+    h1 = dec.submit(p)
+    h2 = dec.submit(p + 1)
+    assert occ.labels(runner="cont.clock").value == 50.0   # 2 of 4 reserved
+    clk.advance(0.125)                       # queue wait before the join
+    dec.step()                               # join prefill = first token
+    ttft = reg.family("mmlspark_runner_ttft_seconds")
+    child = ttft.labels(runner="cont.clock")
+    assert child.count == 2 and abs(child.sum - 0.250) < 1e-9
+    assert h1.ttft_s == h2.ttft_s == 0.125
+    joined = reg.family("mmlspark_runner_slots_joined_total")
+    assert joined.labels(runner="cont.clock").value == 2.0
+    while dec._live:
+        dec.step()
+    assert occ.labels(runner="cont.clock").value == 0.0
+    left = reg.family("mmlspark_runner_slots_left_total")
+    assert left.labels(runner="cont.clock", outcome="ok").value == 2.0
+    # deadline leave on the same clock: expired before its first step
+    h3 = dec.submit(p, deadline_s=clk() + 0.5)
+    dec.step()                               # joins (first token emitted)
+    clk.advance(1.0)
+    dec.step()
+    assert h3.status == "expired"
+    assert left.labels(runner="cont.clock", outcome="expired").value == 1.0
+    dec.close()
+
+
+# ---------------------------------------------------------------------------
+# serving fronts (real sockets)
+# ---------------------------------------------------------------------------
+
+def test_pipeline_server_continuous_decode_e2e():
+    """PipelineServer + continuous decode scorer: replies come from the
+    in-flight engine per request, bit-identical to one-shot decode, with
+    in-band ttft_ms; concurrent requests share the batch."""
+    from mmlspark_tpu.models import ModelRunner
+    from mmlspark_tpu.serving import PipelineServer
+
+    mod, variables = _tiny_lm(layers=1)
+    runner = ModelRunner(module=mod, variables=variables, name="srv.cont")
+    scorer = runner.scorer(mode="decode", continuous=True, report_ttft=True,
+                           slots=4, prompt_bucket=8, max_new_tokens=4,
+                           page_size=4,
+                           encode=lambda t: [int(x) for x in t])
+    srv = PipelineServer(scorer, port=0, mode="continuous").start()
+    try:
+        prompts = [[5, 7, 11], [9, 2], [1, 2, 3, 4, 5]]
+        results = [None] * len(prompts)
+
+        def fire(i):
+            results[i] = post_json(srv.port, srv.api_path, prompts[i])
+
+        threads = [threading.Thread(target=fire, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i, p in enumerate(prompts):
+            status, reply = results[i]
+            assert status == 200, reply
+            ref = runner.decode(np.asarray(p, np.int32)[None],
+                                max_new_tokens=4, kv_layout="paged",
+                                page_size=4)
+            assert reply["tokens"] == ref.tokens[0].tolist()
+            assert reply["ttft_ms"] >= 0.0
+    finally:
+        srv.stop()
+    # stop() closed the scorer's stream (engine thread + borrowed slabs)
+    assert scorer._decoder is None
+
+
+def test_default_encode_replies_are_json_lists_and_streaming_sheds_rows():
+    """Review regressions: (a) a continuous scorer with the DEFAULT encode
+    must reply a JSON list, not a numpy string repr — the deferred resolve
+    path rides the server's reply_encoder exactly like the batch path;
+    (b) the streaming sink maps the per-row ShedReply sentinel to a 503
+    instead of encoding the sentinel object into a 200 body."""
+    from mmlspark_tpu.models import ModelRunner, ShedReply
+    from mmlspark_tpu.serving import PipelineServer
+    from mmlspark_tpu.serving.streaming import HTTPStreamSource, _Pending
+
+    mod, variables = _tiny_lm(layers=1)
+    runner = ModelRunner(module=mod, variables=variables, name="srv.enc")
+    scorer = runner.scorer(mode="decode", continuous=True, slots=2,
+                           prompt_bucket=8, max_new_tokens=3, page_size=4)
+    srv = PipelineServer(scorer, port=0, mode="continuous").start()
+    try:
+        status, reply = post_json(srv.port, srv.api_path, [5, 7, 11])
+        assert status == 200
+        assert isinstance(reply, list) and \
+            all(isinstance(t, int) for t in reply), reply
+    finally:
+        srv.stop()
+    src = HTTPStreamSource()
+    entry = _Pending([1, 2])
+    src._pending["r1"] = entry
+    src.reply(["r1"], [ShedReply("page pool exhausted mid-decode")])
+    assert entry.status == 503 and "shed" in entry.reply["error"]
+    assert entry.done.is_set()
+
+
+def test_pipeline_server_sheds_503_when_slots_exhausted():
+    """Admission-control shedding end to end: with ONE slot and a slow
+    generation in flight, a concurrent request sheds 503 + Retry-After
+    instead of queueing behind the whole generation (or raising)."""
+    from mmlspark_tpu.models import ModelRunner
+    from mmlspark_tpu.serving import PipelineServer
+
+    mod, variables = _tiny_lm(layers=1)
+    runner = ModelRunner(module=mod, variables=variables, name="srv.shed")
+    scorer = runner.scorer(mode="decode", continuous=True, slots=1,
+                           prompt_bucket=8, max_new_tokens=96, page_size=8,
+                           encode=lambda t: [int(x) for x in t])
+    srv = PipelineServer(scorer, port=0, mode="continuous").start()
+    try:
+        done = threading.Event()
+        first = {}
+
+        def long_request():
+            first["res"] = post_json(srv.port, srv.api_path,
+                                     [5, 7, 11], timeout=60)
+            done.set()
+
+        t = threading.Thread(target=long_request)
+        t.start()
+        # wait until the long request owns the engine's only slot
+        deadline = time.monotonic() + 10
+        while scorer._decoder is None or scorer._decoder.occupancy() == 0:
+            if time.monotonic() > deadline:
+                raise AssertionError("first request never joined")
+            time.sleep(0.01)
+        status, reply, headers = post_json(srv.port, srv.api_path, [1, 2],
+                                           return_headers=True)
+        assert status == 503, reply
+        assert "shed" in reply["error"]
+        assert int(headers["Retry-After"]) >= 1
+        assert done.wait(60) and first["res"][0] == 200
+        # stats: exactly one shed, both requests counted
+        st = json.loads(post_json(srv.port, "/stats", None,
+                                  method_get=True)[1])
+        assert st["shed"] == 1 and st["replied"] >= 1
+    finally:
+        srv.stop()
+
+
+def test_streaming_facade_continuous_decode():
+    """read_stream().transform_with(runner-scorer with continuous=True):
+    rows admit into the in-flight engine from the trigger loop and reply
+    per request."""
+    from mmlspark_tpu.models import ModelRunner
+    from mmlspark_tpu.serving import read_stream
+
+    mod, variables = _tiny_lm(layers=1)
+    runner = ModelRunner(module=mod, variables=variables, name="stream.cont")
+    query = (read_stream().server(port=0)
+             .transform_with(runner, mode="decode", continuous=True,
+                             slots=2, prompt_bucket=8, max_new_tokens=3,
+                             page_size=4,
+                             encode=lambda t: [int(x) for x in t])
+             .reply_to("reply"))
+    try:
+        status, reply = post_json(query.source.port, "/score", [3, 1, 4])
+        assert status == 200
+        ref = runner.decode(np.asarray([[3, 1, 4]], np.int32),
+                            max_new_tokens=3, kv_layout="paged", page_size=4)
+        assert reply == ref.tokens[0].tolist()
+    finally:
+        query.stop()
+
+
+def test_mixed_load_ttft_gate_continuous_passes_where_ticked_fails():
+    """The acceptance run: scoring + decode classes through mixed_load.
+    Against the continuous-mode server both classes pass their gates —
+    the decode class's ttft_p99_ms included.  Against the ticked drain
+    (micro_batch flush tick) at the SAME load, the decode class FAILS the
+    same ttft gate: no token is client-visible before the tick's batch
+    resolves, so its honest TTFT is the full latency."""
+    from mmlspark_tpu.core import DataFrame, Transformer
+    from mmlspark_tpu.models import ModelRunner
+    from mmlspark_tpu.serving import PipelineServer, mixed_load
+
+    mod, variables = _tiny_lm(layers=1)
+    lm = ModelRunner(module=mod, variables=variables, name="mix.cont")
+    w = np.arange(6, dtype=np.float32).reshape(3, 2) / 10.0
+
+    def mlp(v):
+        return (np.asarray(v, np.float32) @ w + 1.0).tolist()
+
+    dec_scorer = lm.scorer(mode="decode", continuous=True, report_ttft=True,
+                           slots=4, prompt_bucket=8, max_new_tokens=3,
+                           page_size=4,
+                           encode=lambda t: [int(x) for x in t])
+    ticked_scorer = lm.scorer(mode="decode", report_ttft=True,
+                              max_new_tokens=3, kv_layout="paged",
+                              page_size=4,
+                              encode=lambda t: [int(x) for x in t])
+
+    class Dispatch(Transformer):
+        """One worker, two request classes: decode dicts ride the decode
+        scorer (continuous protocol when the server admits continuously,
+        the batch path under a ticked drain), vectors score inline."""
+
+        def __init__(self, decode_scorer, continuous):
+            super().__init__()
+            self._dec = decode_scorer
+            if continuous:
+                self.continuous_submit = self._submit
+                self.continuous_close = decode_scorer.continuous_close
+
+        def _submit(self, payload, resolve, queue_age_s=0.0,
+                    deadline_budget_s=None):
+            if isinstance(payload, dict) and "decode" in payload:
+                self._dec.continuous_submit(
+                    payload["decode"], resolve, queue_age_s=queue_age_s,
+                    deadline_budget_s=deadline_budget_s)
+            else:
+                resolve(reply=mlp(payload), status=200)
+
+        def _transform(self, df):
+            def per_part(p):
+                col = p["request"]
+                out = np.empty(len(col), dtype=object)
+                dec_idx = [i for i, v in enumerate(col)
+                           if isinstance(v, dict) and "decode" in v]
+                if dec_idx:
+                    sub_req = np.empty(len(dec_idx), dtype=object)
+                    for j, i in enumerate(dec_idx):
+                        sub_req[j] = col[i]["decode"]
+                    sub = {"request": sub_req}
+                    if "_enq_age_s" in p:
+                        sub["_enq_age_s"] = np.asarray(
+                            [p["_enq_age_s"][i] for i in dec_idx])
+                    replies = self._dec._transform(
+                        DataFrame([sub])).collect()["reply"]
+                    for i, r in zip(dec_idx, replies):
+                        out[i] = r
+                for i, v in enumerate(col):
+                    if i not in dec_idx:
+                        out[i] = mlp(v)
+                return {**p, "reply": out}
+            return df.map_partitions(per_part)
+
+        def transform_schema(self, schema):
+            return schema
+
+    score_body = json.dumps([1.0, 2.0, 3.0])
+    decode_body = json.dumps({"decode": [3, 1, 4]})
+
+    def run(server):
+        try:
+            return mixed_load("127.0.0.1", server.port, [
+                {"name": "score", "path": server.api_path,
+                 "body": score_body,
+                 "headers": {"Content-Type": "application/json"},
+                 "n_clients": 2, "per_client": 6,
+                 "gates": {"p99_ms": 30000.0}},
+                {"name": "decode", "path": server.api_path,
+                 "body": decode_body,
+                 "headers": {"Content-Type": "application/json"},
+                 "n_clients": 2, "per_client": 6, "ttft_key": "ttft_ms",
+                 "gates": {"p99_ms": 30000.0, "ttft_p99_ms": 200.0}},
+            ], warm=2)
+        finally:
+            server.stop()
+
+    cont = run(PipelineServer(Dispatch(dec_scorer, True), port=0,
+                              mode="continuous").start())
+    ticked = run(PipelineServer(Dispatch(ticked_scorer, False), port=0,
+                                mode="micro_batch",
+                                micro_batch_interval_ms=400).start())
+    assert cont["score"]["gates"]["passed"], cont["score"]
+    assert cont["decode"]["gates"]["passed"], cont["decode"]
+    assert cont["decode"]["ttft_count"] == 12.0
+    # the ticked drain fails the SAME ttft gate at the SAME load: every
+    # request waited out the flush tick before any token reached it
+    assert not ticked["decode"]["gates"]["passed"], ticked["decode"]
+    failed = ticked["decode"]["gates"]["checks"]["ttft_p99_ms"]
+    assert not failed["ok"] and failed["actual"] > 200.0

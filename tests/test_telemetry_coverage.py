@@ -368,6 +368,52 @@ def test_page_pool_surface_books_metrics():
             f"ModelRunner no longer registers {family}"
 
 
+def test_continuous_engine_surface_books_metrics():
+    """ISSUE 13 coverage: the continuous engine's join/leave/shed sites
+    are what fleet dashboards read for slot occupancy, TTFT and admission
+    pressure — the accounting must be un-droppable.  Source-level (like
+    the page-pool sweep): the join must book the joined counter + TTFT
+    histogram, the leave must book the per-outcome left counter + the
+    occupancy gauge, pool exhaustion must book ``op="denied"`` before
+    raising, and the serving seam must map shed-typed failures (the
+    ``.shed`` duck-type) to the 503 path.  Live: runner construction
+    registers all four families (the scorer shares the runner's
+    registry), and ``page_ops_total`` accepts the denied op."""
+    from mmlspark_tpu.models import runner as runner_mod
+    from mmlspark_tpu.observability import MetricsRegistry
+    from mmlspark_tpu.serving import server as server_mod
+
+    join_src = inspect.getsource(runner_mod.ContinuousDecoder._join)
+    assert "_c_joined" in join_src, "_join() lost the joined counter"
+    assert "_h_ttft" in join_src, "_join() lost the TTFT observation"
+    leave_src = inspect.getsource(runner_mod.ContinuousDecoder._release)
+    assert "_c_left[outcome]" in leave_src, "_release() lost the counter"
+    assert "_book_occupancy" in leave_src, "_release() lost the gauge"
+    submit_src = inspect.getsource(runner_mod.ContinuousDecoder.submit)
+    assert "_book_occupancy" in submit_src, "submit() lost the gauge"
+    alloc_src = inspect.getsource(runner_mod.PagePool.allocate)
+    assert '_book("denied"' in alloc_src, \
+        "pool exhaustion no longer books op='denied'"
+    assert "denied" in runner_mod.PagePool.OPS
+    # the serving seam sheds on the duck-typed admission failures instead
+    # of surfacing them as 500s (both the deferred and the batch path)
+    seam_src = inspect.getsource(server_mod.PipelineServer._submit_continuous)
+    assert 'getattr(ex, "shed", False)' in seam_src
+    score_src = inspect.getsource(server_mod.PipelineServer._score_batch)
+    assert 'getattr(r, "shed_reason", None)' in score_src
+    assert 'getattr(ex, "shed", False)' in score_src
+
+    reg = MetricsRegistry()
+    runner_mod.ModelRunner(apply_fn=lambda v, x: x, variables={},
+                           name="sweep13", registry=reg)
+    for family in ("mmlspark_runner_slots_joined_total",
+                   "mmlspark_runner_slots_left_total",
+                   "mmlspark_runner_slot_occupancy_pct",
+                   "mmlspark_runner_ttft_seconds"):
+        assert reg.family(family) is not None, \
+            f"ModelRunner no longer registers {family}"
+
+
 def test_federation_surface_is_instrumented():
     """ISSUE 11 coverage: the fleet telemetry plane watches the workers,
     so the registry must watch the fleet plane.  Source-level (like the
