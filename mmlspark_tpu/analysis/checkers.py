@@ -485,15 +485,13 @@ class CheckpointAtomicityChecker(Checker):
                        "checkpoint module — route through "
                        "io.checkpoint.atomic_write"}
 
-    #: the atomic writer's own module is the one sanctioned raw-open site
-    EXCLUDED = ("io/checkpoint.py",)
-
+    # io/checkpoint.py itself is scanned too (ISSUE 14): only the one
+    # raw open INSIDE atomic_write is sanctioned, via its inline pragma —
+    # a whole-file exclusion would let a new writer (e.g. a topology-
+    # stanza sidecar) land unatomically in the very module that defines
+    # the contract.
     def interested(self, relpath: str) -> bool:
-        base = relpath.rsplit("/", 1)[-1]
-        if "checkpoint" not in base:
-            return False
-        norm = f"/{relpath}"
-        return not any(norm.endswith(f"/{e}") for e in self.EXCLUDED)
+        return "checkpoint" in relpath.rsplit("/", 1)[-1]
 
     def visit(self, node: ast.AST, ctx: ModuleContext) -> None:
         if not isinstance(node, ast.Call):

@@ -41,10 +41,14 @@ import numpy as np
 
 __all__ = ["atomic_write", "CheckpointManager", "checkpoint_instruments",
            "book_resume", "check_resume_arg", "snapshot_steps",
-           "SNAPSHOT_RE"]
+           "SNAPSHOT_RE", "topology_stanza", "topology_delta",
+           "book_reshard", "RESUME_REQUIRED", "resume_required_error"]
 
-#: step-numbered snapshot filename shape: ``ckpt_0000000042.npz``
-SNAPSHOT_RE = re.compile(r"^(?P<prefix>.+)_(?P<step>\d{10})(?P<ext>\.[\w.]+)$")
+#: step-numbered snapshot filename shape: ``ckpt_0000000042.npz`` — the
+#: extension is pinned to ``.npz`` exactly: an operator-copied
+#: ``ckpt_0000000042.npz.bak`` must read as a FOREIGN file, never as a
+#: snapshot whose open would then surface as a confusing torn_skipped
+SNAPSHOT_RE = re.compile(r"^(?P<prefix>.+)_(?P<step>\d{10})\.npz$")
 
 
 @contextmanager
@@ -75,14 +79,47 @@ def atomic_write(path: str, mode: str = "wb"):
         raise
 
 
-def check_resume_arg(resume: str) -> None:
+#: sentinel distinguishing "caller did not hand us the directory" from a
+#: genuinely absent one — check_resume_arg must not guess either way
+_DIR_UNCHECKED = object()
+
+
+def check_resume_arg(resume: str,
+                     checkpoint_dir: Any = _DIR_UNCHECKED) -> None:
     """Shared knob validation for every checkpointing driver: a typo'd
     resume value silently restarting from iteration zero is the exact
-    loss this layer exists to prevent — reject it loudly."""
-    if resume not in ("auto", "never"):
+    loss this layer exists to prevent — reject it loudly.  ``'must'``
+    (ISSUE 14) is ``'auto'`` that additionally REQUIRES a usable snapshot:
+    a preemption-restart script passes it so a wiped disk raises instead
+    of silently retraining from zero.
+
+    Drivers pass ``checkpoint_dir=`` so the 'must'-with-nowhere-to-resume
+    contract lives HERE, once: ``'must'`` with no directory is the
+    silent-retrain trap in its sneakiest form (a checkpoint-dir env var
+    that didn't propagate to the restart) and raises
+    :func:`resume_required_error` instead of quietly training from zero."""
+    if resume not in ("auto", "never", "must"):
         raise ValueError(
-            f"resume must be 'auto' or 'never', got {resume!r} "
+            f"resume must be 'auto', 'never' or 'must', got {resume!r} "
             "(docs/RESILIENCE.md: training fault tolerance)")
+    if checkpoint_dir is not _DIR_UNCHECKED and resume == "must" \
+            and not checkpoint_dir:
+        raise resume_required_error(checkpoint_dir)
+
+
+#: shared raise for ``resume='must'`` with nothing to restore — one
+#: message so all three drivers fail identically
+RESUME_REQUIRED = (
+    "resume='must' but no usable snapshot exists in {directory!r} — the "
+    "checkpoint directory is empty, wiped, or every snapshot is torn.  A "
+    "preemption-restart script must not silently retrain from zero; point "
+    "at the surviving checkpoint_dir or pass resume='auto' to accept a "
+    "fresh start (docs/RESILIENCE.md: elastic resume)")
+
+
+def resume_required_error(directory: Optional[str]) -> FileNotFoundError:
+    return FileNotFoundError(RESUME_REQUIRED.format(
+        directory=directory or "<no checkpoint_dir>"))
 
 
 def checkpoint_instruments(registry=None) -> Dict[str, Any]:
@@ -113,21 +150,33 @@ def checkpoint_instruments(registry=None) -> Dict[str, Any]:
             "seconds since the last successful snapshot publish (inf "
             "until the first save) — a climbing age on a checkpointing "
             "run is the page", labels=("site",)),
+        "reshard": reg.counter(
+            "mmlspark_reshard_total",
+            "resumes that re-sharded state onto a changed topology "
+            "(elastic resume), by driver and direction "
+            "(shrink / grow / reshape)", labels=("driver", "direction")),
     }
 
 
 def book_resume(site: str, result: str, step: Optional[int] = None,
-                registry=None, path: str = "") -> None:
-    """Book one resume outcome (counter + ring event)."""
+                registry=None, path: str = "", **fields) -> None:
+    """Book one resume outcome (counter + ring event) — the ONE booking
+    path for the ``checkpoint_resume`` family.  Extra keyword fields ride
+    the ring event (e.g. ``files=`` for ``foreign_skipped``)."""
     checkpoint_instruments(registry)["resumes"].inc(site=site, result=result)
     from ..core.logging import log_event
     log_event({"event": "checkpoint_resume", "site": site, "result": result,
-               "step": step, "path": path})
+               "step": step, "path": path, **fields})
 
 
-def snapshot_steps(directory: str, prefix: str = "ckpt") -> List[int]:
+def snapshot_steps(directory: str, prefix: str = "ckpt",
+                   foreign: Optional[List[str]] = None) -> List[int]:
     """Sorted (ascending) step numbers of published snapshots in
-    ``directory``.  Temp files and foreign names are ignored."""
+    ``directory``.  Anything that does not parse as
+    ``<prefix>_<10 digits>.npz`` — temp files, operator-copied backups,
+    editor artifacts — is a FOREIGN name: ignored, and appended to
+    ``foreign`` when the caller wants to book the skip (ISSUE 14: a
+    stray file beside the snapshots must never fail the resume path)."""
     steps = []
     try:
         names = os.listdir(directory)
@@ -135,9 +184,86 @@ def snapshot_steps(directory: str, prefix: str = "ckpt") -> List[int]:
         return []
     for name in names:
         m = SNAPSHOT_RE.match(name)
-        if m and m.group("prefix") == prefix and ".tmp-" not in name:
+        if m and m.group("prefix") == prefix:
             steps.append(int(m.group("step")))
+        elif foreign is not None and not name.startswith(".") \
+                and ".tmp-" not in name:
+            # our own in-flight temp files are not "foreign" — they are
+            # the atomic writer mid-publish (or crash debris it tolerates)
+            foreign.append(name)
     return sorted(steps)
+
+
+# ---------------------------------------------------------------------------
+# topology stanza (elastic resume, ISSUE 14) — recorded, allowed to differ
+# ---------------------------------------------------------------------------
+
+def topology_stanza(mesh=None, **extra) -> Dict[str, Any]:
+    """The topology half of a snapshot's identity: device count, mesh
+    shape, shard count — RECORDED so a resume knows what it left, but
+    never part of the must-match fingerprint, because the fleet a
+    preempted run restarts on is rarely the fleet it lost.  ``mesh``
+    fills the device/mesh fields from a ``jax.sharding.Mesh``; drivers
+    add their own geometry (``shard_count``, ``num_tiles``, ...) via
+    ``extra``."""
+    stanza: Dict[str, Any] = {}
+    if mesh is not None:
+        stanza["device_count"] = int(mesh.devices.size)
+        stanza["mesh_axes"] = {str(a): int(s) for a, s in
+                               zip(mesh.axis_names, mesh.devices.shape)}
+    stanza.update({k: v for k, v in extra.items() if v is not None})
+    return stanza
+
+
+#: width keys in precedence order: the first one present on both sides,
+#: numeric, and DIFFERENT decides shrink-vs-grow; everything else is a
+#: "reshape".  ``tile_rows`` (not num_tiles) is the streamed width: a
+#: smaller tile is a smaller host budget — a shrink — even though the
+#: tile COUNT grows.
+_WIDTH_KEYS = ("shard_count", "tile_rows", "device_count")
+
+
+def topology_delta(saved: Optional[Dict[str, Any]],
+                   current: Dict[str, Any]) -> Dict[str, Any]:
+    """Compare a snapshot's recorded topology to the resuming run's.
+    Returns ``{"changed": bool, "direction": shrink|grow|reshape|same,
+    "fields": {key: [old, new]}}`` — the delta drivers book (and return
+    in extras) so an operator can see a resume re-sharded, in which
+    direction, and by how much.  ``saved=None`` means the snapshot
+    predates topology recording: that is UNKNOWN, not a change — booking
+    a spurious reshard on every pre-upgrade same-mesh resume would cry
+    wolf on the very signal this exists for."""
+    if saved is None:
+        return {"changed": False, "direction": "same", "fields": {}}
+    fields = {}
+    for key in sorted(set(saved) | set(current)):
+        old, new = saved.get(key), current.get(key)
+        if old != new:
+            fields[key] = [old, new]
+    direction = "same"
+    if fields:
+        direction = "reshape"
+        for key in _WIDTH_KEYS:
+            old, new = saved.get(key), current.get(key)
+            if isinstance(old, (int, float)) and isinstance(new, (int, float)) \
+                    and old != new:
+                direction = "shrink" if new < old else "grow"
+                break
+    return {"changed": bool(fields), "direction": direction,
+            "fields": fields}
+
+
+def book_reshard(driver: str, delta: Dict[str, Any],
+                 registry=None) -> None:
+    """Book one topology-changing resume: the ``mmlspark_reshard_total``
+    counter plus a ``resume_topology_delta`` ring event carrying the
+    full field-by-field delta."""
+    checkpoint_instruments(registry)["reshard"].inc(
+        driver=driver, direction=delta.get("direction", "reshape"))
+    from ..core.logging import log_event
+    log_event({"event": "resume_topology_delta", "driver": driver,
+               "direction": delta.get("direction"),
+               "fields": delta.get("fields", {})})
 
 
 class CheckpointManager:
@@ -325,22 +451,68 @@ class CheckpointManager:
             raise ValueError("snapshot meta is not a JSON object")
         return arrays, meta
 
-    def load_latest(self) -> Optional[
+    def load_latest(self, current_topology: Optional[Dict[str, Any]] = None
+                    ) -> Optional[
             Tuple[int, Dict[str, np.ndarray], Dict[str, Any]]]:
         """Newest valid snapshot, or None.  A torn newest snapshot (crash
         artifact, truncated copy) is skipped — booked + ring-evented — and
         the previous one restores instead: durability degrades one step,
-        never to zero."""
-        for step in reversed(self.steps()):
-            try:
-                arrays, meta = self.load(step)
-            except Exception:  # noqa: BLE001 — torn snapshot: fall back
-                book_resume(self.site, "torn_skipped", step,
+        never to zero.
+
+        Foreign filenames beside the snapshots (operator copies, editor
+        backups, unparseable names) are skipped with ONE booked
+        ``foreign_skipped`` + ring event instead of failing the resume
+        path (ISSUE 14).  A snapshot evicted by keep-last-K retention
+        between the directory listing and the open falls back to the
+        next-oldest — and if the stale listing exhausted itself that way
+        while a newer snapshot was landing, the walk re-lists once
+        (booked ``evicted_skipped`` per vanished file).
+
+        With ``current_topology`` given, the returned ``meta`` carries
+        ``meta["topology_delta"]`` — :func:`topology_delta` of the
+        snapshot's recorded topology stanza against the resuming run's —
+        so drivers know they are re-sharding before they rebuild state.
+        """
+        skipped_booked: set = set()   # steps already booked torn/evicted —
+        for relist in range(2):       # the re-list walk must not re-count
+            foreign: List[str] = []   # the same artifact
+            steps = snapshot_steps(self.directory, self.prefix,
+                                   foreign=foreign)
+            if foreign and relist == 0:
+                book_resume(self.site, "foreign_skipped",
                             registry=self._registry,
+                            files=sorted(foreign)[:16])
+            evicted_midwalk = False
+            for step in reversed(steps):
+                try:
+                    arrays, meta = self.load(step)
+                except FileNotFoundError:
+                    # keep-last-K retention raced the walk: the listed
+                    # file is gone, the next-oldest (or a re-list) serves
+                    if step not in skipped_booked:
+                        skipped_booked.add(step)
+                        book_resume(self.site, "evicted_skipped", step,
+                                    registry=self._registry,
+                                    path=self.path_for(step))
+                    evicted_midwalk = True
+                    continue
+                except Exception:  # noqa: BLE001 — torn snapshot: fall back
+                    if step not in skipped_booked:
+                        skipped_booked.add(step)
+                        book_resume(self.site, "torn_skipped", step,
+                                    registry=self._registry,
+                                    path=self.path_for(step))
+                    continue
+                if current_topology is not None:
+                    meta = dict(meta, topology_delta=topology_delta(
+                        meta.get("topology"), current_topology))
+                book_resume(self.site, "ok", step, registry=self._registry,
                             path=self.path_for(step))
-                continue
-            book_resume(self.site, "ok", step, registry=self._registry,
-                        path=self.path_for(step))
-            return step, arrays, meta
+                return step, arrays, meta
+            if not evicted_midwalk:
+                break
+            # every listed snapshot vanished mid-walk — retention only
+            # evicts when a NEWER snapshot landed, so a fresh listing
+            # has something to serve; retry exactly once
         book_resume(self.site, "none", registry=self._registry)
         return None

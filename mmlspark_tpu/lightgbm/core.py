@@ -514,9 +514,14 @@ def make_tree_grower(max_depth: int, num_features: int, num_bins: int,
         if use_quant:
             # quantize ONCE per tree: every level's histogram is then an
             # exact integer function of the same per-row ints, so sibling
-            # subtraction below never leaves integer space
+            # subtraction below never leaves integer space.  Sharded, the
+            # rounding noise is keyed per GLOBAL row (elastic resume,
+            # ISSUE 14): a row quantizes identically at any shard count,
+            # which is what makes resume onto a re-sized mesh bit-exact.
+            row_ids = hist_ops.global_row_ids(axis_name, n)
             qg, qh, g_scale, h_scale = hist_ops.quantize_gradients(
-                grad, hess, quant_bins, seed=params.seed, axis_name=axis_name)
+                grad, hess, quant_bins, seed=params.seed, axis_name=axis_name,
+                row_ids=row_ids)
 
         def build_local(node_a, num_nodes, max_rows=None):
             if use_quant:
@@ -903,9 +908,13 @@ def make_leafwise_grower(num_leaves: int, depth_cap: int, num_features: int,
 
         if use_quant:
             # one quantization per tree — every per-leaf rebuild and every
-            # sibling subtraction below runs on the same per-row integers
+            # sibling subtraction below runs on the same per-row integers.
+            # Sharded: noise keyed per GLOBAL row (elastic resume, ISSUE
+            # 14) so re-sized meshes quantize each row identically.
+            row_ids = hist_ops.global_row_ids(axis_name, n)
             qg, qh, g_scale, h_scale = hist_ops.quantize_gradients(
-                grad, hess, quant_bins, seed=params.seed, axis_name=axis_name)
+                grad, hess, quant_bins, seed=params.seed, axis_name=axis_name,
+                row_ids=row_ids)
 
         def local_hist(mask):
             if use_quant:
@@ -1472,7 +1481,19 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
     machinery (a torn newest snapshot falls back to the previous one);
     SIGTERM/SIGINT requests one final checkpoint at the next iteration
     boundary and returns the partial booster cleanly with
-    ``extras["preempted"]`` set."""
+    ``extras["preempted"]`` set.  ``resume="must"`` raises when no usable
+    snapshot exists (restart scripts must not silently retrain from
+    zero).
+
+    Elastic resume (ISSUE 14): the snapshot records a topology stanza —
+    device count, mesh shape, shard count — that is allowed to differ on
+    restore.  A ``shard_rows`` run resumed on a re-sized mesh re-pads the
+    row stream and bagging mask and re-keys the ``histogram_psum`` lane
+    bound on the new width; with quantized histograms the per-row
+    rounding noise is keyed by GLOBAL row id, so the resumed booster is
+    bit-identical to an uninterrupted run at either width (tested shrink
+    and grow).  The change books ``mmlspark_reshard_total`` and sets
+    ``extras["resharded"]``."""
     import jax
     import jax.numpy as jnp
     from ..observability import get_registry
@@ -1593,28 +1614,54 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
 
     sig = _params_sig(p) + (hist_cfg,)
 
-    # ---- fault tolerance (ISSUE 10): periodic atomic checkpoints + resume
-    # through the warm-start machinery below
+    # ---- fault tolerance (ISSUE 10/14): periodic atomic checkpoints +
+    # resume through the warm-start machinery below.  The fingerprint is
+    # the DATA/PARAMS identity only (must match); topology — device
+    # count, mesh shape, shard count — rides a separate recorded stanza
+    # that is allowed to differ, because the fleet a preempted run
+    # restarts on is rarely the fleet it lost (elastic resume).
     import contextlib
-    from ..io.checkpoint import CheckpointManager, check_resume_arg
+    from ..io.checkpoint import (CheckpointManager, book_reshard,
+                                 check_resume_arg, resume_required_error,
+                                 topology_stanza)
     from ..utils.resilience import PreemptionToken, preemption_scope
     _ckpt_fingerprint = repr((sig, n, F, B, K, shard_rows,
                               _content_fingerprint(X)))
+    _topo_mesh = None
+    if shard_rows:
+        from ..parallel import get_active_mesh as _gam
+        from ..parallel.mesh import AXIS_DATA as _AXIS_DATA
+        _topo_mesh = _gam()
+        _cur_topology = topology_stanza(
+            mesh=_topo_mesh,
+            shard_count=int(_topo_mesh.shape[_AXIS_DATA]))
+    else:
+        _cur_topology = topology_stanza(shard_count=1, device_count=1)
+    check_resume_arg(resume, checkpoint_dir=checkpoint_dir)
     _mgr = None
     if checkpoint_dir:
-        check_resume_arg(resume)
         _mgr = CheckpointManager(checkpoint_dir, site="lightgbm.train",
                                  keep_last=checkpoint_keep_last)
     _resume_meta = None
     _resume_bag: Optional[np.ndarray] = None
+    _resharded = False
     _n_user_init_trees = init_booster.num_trees if init_booster is not None \
         else 0
-    if _mgr is not None and resume == "auto":
-        _got = _mgr.load_latest()
+    if _mgr is not None and resume in ("auto", "must"):
+        _got = _mgr.load_latest(current_topology=_cur_topology)
+        if _got is None and resume == "must":
+            raise resume_required_error(checkpoint_dir)
         if _got is not None:
             _, _arrs, _meta = _got
             if _meta.get("fingerprint") != _ckpt_fingerprint:
                 raise ValueError(_CKPT_FINGERPRINT_MISMATCH)
+            _delta = _meta.get("topology_delta")
+            if _delta is not None and _delta["changed"]:
+                # re-sharding: the row stream re-partitions onto the new
+                # mesh width below (padding, bag mask, psum lane bound all
+                # re-key on it) — book the delta so the resume is visible
+                book_reshard("lightgbm.train", _delta)
+                _resharded = True
             from ..models.gbdt import children_depth_bound
             # the snapshot booster replaces any user init_booster: it
             # already CONTAINS those trees (they were replayed into the
@@ -1647,12 +1694,14 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
                 _resume_bag = np.asarray(_arrs["bag_mask"])
             _resume_meta = _meta
 
+    n_data = n           # pre-pad row count: host stats and the bagging
+    y_data, w_data = y, w  # draw must be independent of the mesh width
     if shard_rows:
         from jax.sharding import PartitionSpec as P
-        from ..parallel import get_active_mesh, batch_sharded
+        from ..parallel import batch_sharded
         from ..parallel.mesh import AXIS_DATA
         from ..parallel.sharding import pad_to_multiple
-        mesh = get_active_mesh()
+        mesh = _topo_mesh
         nd = mesh.shape[AXIS_DATA]
         binned_np, n_valid_rows = pad_to_multiple(binned_np, nd)
         y_pad, _ = pad_to_multiple(y, nd)
@@ -1698,17 +1747,23 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
     D = p.depth_bound                 # static walk bound during training
     L = p.num_leaves                  # leaf slots (level-wise: 2^max_depth)
 
-    # init score (BoostFromAverage analogue)
+    # init score (BoostFromAverage analogue) — computed on the UNPADDED
+    # arrays: the padded tail is zero-weighted either way, but a pairwise
+    # host sum over a width-dependent padded length would make the base
+    # score (and so every f32 score after it) drift across mesh widths,
+    # breaking elastic resume's bit-identity (ISSUE 14)
     init_score = 0.0
     if p.objective == "binary":
-        pbar = float(np.clip(np.average(y, weights=w), 1e-6, 1 - 1e-6))
+        pbar = float(np.clip(np.average(y_data, weights=w_data),
+                             1e-6, 1 - 1e-6))
         init_score = math.log(pbar / (1 - pbar)) / p.sigmoid
     elif p.objective in ("regression", "huber"):
-        init_score = float(np.average(y, weights=w))
+        init_score = float(np.average(y_data, weights=w_data))
     elif p.objective in ("poisson", "tweedie", "gamma"):  # log link
-        init_score = float(np.log(max(np.average(y, weights=w), 1e-9)))
+        init_score = float(np.log(max(np.average(y_data, weights=w_data),
+                                      1e-9)))
     elif p.objective == "regression_l1":
-        init_score = float(np.median(y))
+        init_score = float(np.median(y_data))
 
     scores = jnp.full((n, K), init_score, jnp.float32)
     y_dev = jnp.asarray(y)
@@ -1968,7 +2023,13 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
     it = start_iter
     bag_mask = None  # sampled lazily on the first bagging-eligible iteration
     if _resume_bag is not None:
-        bag_mask = jnp.asarray(np.unpackbits(_resume_bag)[:n].astype(bool))
+        # stored packed bits cover the SNAPSHOT's padded width; re-pad to
+        # this run's (the real rows [0, n_data) are identical, and padded
+        # rows never enter a histogram regardless of their bag bit)
+        _bits = np.unpackbits(_resume_bag)
+        if _bits.size < n:
+            _bits = np.pad(_bits, (0, n - _bits.size))
+        bag_mask = jnp.asarray(_bits[:n].astype(bool))
     lambda_fn = None  # built on first lambdarank iteration, reused after
     _run_iter0 = start_iter
     _done_before = 0
@@ -1996,7 +2057,8 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
         meta = _booster_ckpt_meta(done, _n_user_init_trees, rng,
                                   best_metric, best_iter, rounds_no_improve,
                                   evals, init_score, _ckpt_fingerprint,
-                                  finished, p.num_iterations, "booster_v1")
+                                  finished, p.num_iterations, "booster_v1",
+                                  topology=_cur_topology)
         _mgr.save(done, _booster_ckpt_arrays(trees, tree_weights, bag_mask),
                   meta, block=block)
 
@@ -2064,7 +2126,12 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
             # iteration of this call (a warm start may begin off-schedule,
             # in which case bag_mask would otherwise be unbound)
             if it % p.bagging_freq == 0 or bag_mask is None:
-                bag_mask = jnp.asarray(rng.random(n) < p.bagging_fraction)
+                # draw over the UNPADDED rows (padded tail stays out of
+                # the bag): the PRNG stream — and so every later draw —
+                # is then independent of the mesh width, which elastic
+                # resume's cross-width bit-identity rides on (ISSUE 14)
+                _draw = rng.random(n_data) < p.bagging_fraction
+                bag_mask = jnp.asarray(np.pad(_draw, (0, n - n_data)))
             base_mask = hist_mask_full & bag_mask
 
         # ---- gradients precomputed for lambdarank / dart
@@ -2240,7 +2307,8 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
                    "resumed_from_iteration":
                        float(_resume_meta["iteration"])
                        if _resume_meta is not None else -1.0,
-                   "checkpoint_saves": float(_mgr.saves_ok)}
+                   "checkpoint_saves": float(_mgr.saves_ok),
+                   "resharded": float(_resharded)}
         for k, v in _extras.items():
             _train_span.set_attribute(f"ckpt.{k}", v)
     export_span(_train_span)
@@ -2276,6 +2344,21 @@ _STREAM_TREE_KEYS = ("left_child", "right_child", "split_feature",
                      "leaf_count")
 
 
+def _quant_mix(g_host: np.ndarray, h_host: np.ndarray) -> np.int32:
+    """Per-iteration quantization key mix for the streamed driver: an
+    exact INTEGER fold of the bitcast |grad|/hess magnitudes over the
+    whole host row space.  Integer adds are associative and the host
+    arrays are tile-independent, so the mix — and every row's stochastic
+    rounding — survives a resume onto a different tile width bit-for-bit
+    (the tile-level twin of the sharded grower's psum'd mix)."""
+    gi = int(np.abs(g_host).view(np.int32).astype(np.int64).sum())
+    hi = int(h_host.view(np.int32).astype(np.int64).sum())
+    total = (gi + 3 * hi) & 0xFFFFFFFF
+    if total >= 1 << 31:
+        total -= 1 << 32
+    return np.int32(total)
+
+
 def _booster_ckpt_arrays(trees: Dict[str, list], tree_weights: list,
                          bag_mask) -> Callable[[], Dict[str, np.ndarray]]:
     """Snapshot-arrays callable shared by ``train`` and ``train_streamed``
@@ -2303,13 +2386,15 @@ def _booster_ckpt_meta(completed_iter: int, n_init_trees: int, rng,
                        best_metric, best_iter: int, rounds_no_improve: int,
                        evals: list, init_score: float, fingerprint: str,
                        finished: bool, num_iterations: int,
-                       fmt: str) -> Dict:
+                       fmt: str, topology: Optional[Dict] = None) -> Dict:
     """Snapshot meta shared by both drivers.  ``completed_iter`` is the
     ONE convention both must use: boosting iterations completed beyond the
     user's warm-start trees, derived from the tree count (robust to early
     stopping and the fused multi-iteration chunk path, where loop counters
-    and completed work can disagree at the break)."""
-    return {"iteration": int(completed_iter),
+    and completed work can disagree at the break).  ``topology`` is the
+    recorded-but-not-identity stanza (ISSUE 14): a resume onto a changed
+    mesh width / tile geometry diffs it instead of rejecting it."""
+    meta = {"iteration": int(completed_iter),
             "n_init_trees": int(n_init_trees),
             "rng_state": rng.bit_generator.state,
             "best_metric": best_metric, "best_iter": int(best_iter),
@@ -2318,6 +2403,9 @@ def _booster_ckpt_meta(completed_iter: int, n_init_trees: int, rng,
             "init_score": float(init_score),
             "fingerprint": fingerprint, "finished": bool(finished),
             "num_iterations": int(num_iterations), "format": fmt}
+    if topology is not None:
+        meta["topology"] = topology
+    return meta
 
 
 _CKPT_FINGERPRINT_MISMATCH = (
@@ -2426,6 +2514,16 @@ def train_streamed(X, y: Optional[np.ndarray] = None, params: GBDTParams = None,
     makes that exact; tested by the chaos harness).  SIGTERM/SIGINT
     during the loop requests one final checkpoint at the next iteration
     boundary and returns cleanly with ``extras["preempted"]`` set.
+    ``resume="must"`` raises when no usable snapshot exists.
+
+    Elastic resume (ISSUE 14): the snapshot's topology stanza records the
+    tile geometry but is NOT identity — a resume may re-partition the row
+    stream onto a different ``tile_rows``/``num_tiles`` (the change books
+    ``mmlspark_reshard_total`` and sets ``extras["resharded"]``).  With
+    quantized histograms the rounding noise is keyed per GLOBAL row, so
+    the per-tile int32 partials accumulate to the same integers under any
+    tiling and the resumed booster stays bit-identical to an
+    uninterrupted run at either width (tested shrink and grow).
 
     Not (yet) streamed: multiclass, lambdarank, dart/goss/rf, categorical
     features, and ``shard_rows`` (the multi-host composition — per-tile
@@ -2578,11 +2676,16 @@ def train_streamed(X, y: Optional[np.ndarray] = None, params: GBDTParams = None,
     grad_fn = _cached(("ooc_grad", sig, T), _build_grad)
 
     def _build_accum():
-        def accum(acc, b_t, g_t, h_t, node_t, gsc, hsc):
+        def accum(acc, b_t, g_t, h_t, node_t, ids_t, mixv, gsc, hsc):
             nodes_d = acc.shape[0]          # static at trace time
             if use_quant:
+                # noise keyed per GLOBAL row id + one per-iteration mix
+                # (elastic resume, ISSUE 14): a row quantizes identically
+                # under ANY tile width, so per-tile int32 partials
+                # accumulate to the same integers after a re-tiled resume
                 qg, qh, _, _ = hist_ops.quantize_gradients(
-                    g_t, h_t, qb, seed=p.seed, g_scale=gsc, h_scale=hsc)
+                    g_t, h_t, qb, seed=p.seed, g_scale=gsc, h_scale=hsc,
+                    row_ids=ids_t, mix=mixv)
                 part = hist_ops.build_quantized(
                     b_t, qg, qh, node_t, nodes_d, B, quant_bins=qb,
                     backend=hist_backend, node_rows_bound=T)
@@ -2724,18 +2827,28 @@ def train_streamed(X, y: Optional[np.ndarray] = None, params: GBDTParams = None,
     # ---- fault tolerance (ISSUE 10): periodic atomic checkpoints,
     # resume-through-replay, preemption-aware shutdown
     import contextlib
-    from ..io.checkpoint import CheckpointManager, check_resume_arg
+    from ..io.checkpoint import (CheckpointManager, book_reshard,
+                                 check_resume_arg, resume_required_error,
+                                 topology_stanza)
     from ..utils.resilience import PreemptionToken, preemption_scope
+    # identity (must match) carries data/params only; the tile geometry is
+    # the streamed driver's topology stanza — recorded, allowed to differ
+    # on resume (elastic resume, ISSUE 14: the host that restarts a
+    # preempted stream rarely has the old host-RAM budget)
     fingerprint = repr((sig, n, F, B, _content_fingerprint(cd.X)))
+    _cur_topology = topology_stanza(shard_count=1,
+                                    num_tiles=int(cd.num_tiles),
+                                    tile_rows=int(T))
+    check_resume_arg(resume, checkpoint_dir=checkpoint_dir)
     manager = None
     if checkpoint_dir:
-        check_resume_arg(resume)
         manager = CheckpointManager(checkpoint_dir,
                                     site="lightgbm.train_streamed",
                                     keep_last=checkpoint_keep_last)
     n_init_trees = 0
     start_iter = 0
     resumed_from = -1
+    resharded = False
     preempted = False
 
     def _replay_range(t0: int, t1: int, valid_too: bool) -> None:
@@ -2780,18 +2893,29 @@ def train_streamed(X, y: Optional[np.ndarray] = None, params: GBDTParams = None,
         meta = _booster_ckpt_meta(done, n_init_trees, rng, best_metric,
                                   best_iter, rounds_no_improve, evals,
                                   init_score, fingerprint, finished,
-                                  p.num_iterations, "streamed_booster_v1")
+                                  p.num_iterations, "streamed_booster_v1",
+                                  topology=_cur_topology)
         manager.save(done, _booster_ckpt_arrays(trees, tree_weights,
                                                 bag_mask), meta,
                      block=block)
 
     resumed = False
-    if manager is not None and resume == "auto":
-        got = manager.load_latest()
+    if manager is not None and resume in ("auto", "must"):
+        got = manager.load_latest(current_topology=_cur_topology)
+        if got is None and resume == "must":
+            raise resume_required_error(checkpoint_dir)
         if got is not None:
             _, _arrs, _meta = got
             if _meta.get("fingerprint") != fingerprint:
                 raise ValueError(_CKPT_FINGERPRINT_MISMATCH)
+            _delta = _meta.get("topology_delta")
+            if _delta is not None and _delta["changed"]:
+                # re-tiled resume: the row stream re-partitions onto this
+                # run's tile geometry; with quantized histograms the
+                # global-row-keyed rounding keeps the continued booster
+                # bit-identical to an uninterrupted run at either width
+                book_reshard("lightgbm.train_streamed", _delta)
+                resharded = True
             T_done = int(_arrs["split_feature"].shape[0])
             for k in _STREAM_TREE_KEYS:
                 trees[k] = [np.asarray(_arrs[k][t]) for t in range(T_done)]
@@ -2867,11 +2991,20 @@ def train_streamed(X, y: Optional[np.ndarray] = None, params: GBDTParams = None,
         go_right = do[node] & (rb > bb[node])
         node_h[lo:hi] = 2 * node + go_right
 
+    # per-iteration quantization mix (elastic resume): an exact-integer
+    # fold of the HOST gradient arrays, so the value — and with it every
+    # row's rounding noise — is identical under any tile width.  Written
+    # once per iteration before the histogram passes read it.
+    row_ids_h = np.arange(n, dtype=np.int32)
+    _iter_mix = {"mix": np.int32(0)}
+
     def _hist_pass(nodes_d, gsc, hsc, decisions, node_of):
         """One accumulate pass over every tile: routing for this level
         (when ``decisions`` carries the previous level's splits) happens on
         the PREFETCH worker, then the consumer folds the tile's quantized
         partial into the int32 accumulator."""
+        mixv = _iter_mix["mix"]
+
         def make_tile(i, lo, hi):
             if decisions is not None:
                 _route(lo, hi, *decisions)
@@ -2881,12 +3014,13 @@ def train_streamed(X, y: Optional[np.ndarray] = None, params: GBDTParams = None,
                     pad_tile(g_host, lo, hi, T),
                     pad_tile(h_host, lo, hi, T),
                     # node_t is already the slice: pad from its own origin
-                    pad_tile(node_t, 0, hi - lo, T, fill=-1))
+                    pad_tile(node_t, 0, hi - lo, T, fill=-1),
+                    pad_tile(row_ids_h, lo, hi, T))
         acc = jnp.zeros((nodes_d, F, B, 3),
                         jnp.int32 if use_quant else jnp.float32)
         pf = _stream(make_tile)
-        for i, lo, hi, (b_t, g_t, h_t, n_t) in pf:
-            acc = accum_fn(acc, b_t, g_t, h_t, n_t, gsc, hsc)
+        for i, lo, hi, (b_t, g_t, h_t, n_t, i_t) in pf:
+            acc = accum_fn(acc, b_t, g_t, h_t, n_t, i_t, mixv, gsc, hsc)
         _finish_stream(pf)
         return acc
 
@@ -2916,6 +3050,8 @@ def train_streamed(X, y: Optional[np.ndarray] = None, params: GBDTParams = None,
         fm_dev = jnp.asarray(feat_mask)
 
         gsc, hsc = _grad_pass()
+        if use_quant:
+            _iter_mix["mix"] = _quant_mix(g_host, h_host)
         node_h = np.zeros((n,), np.int32)
 
         sf = np.full((I,), -1, np.int32)
@@ -3037,6 +3173,7 @@ def train_streamed(X, y: Optional[np.ndarray] = None, params: GBDTParams = None,
         extras["preempted"] = float(preempted)
         extras["resumed_from_iteration"] = float(resumed_from)
         extras["checkpoint_saves"] = float(manager.saves_ok)
+        extras["resharded"] = float(resharded)
     for k, v in extras.items():
         _span.set_attribute(f"ooc.{k}", v)
     _span.set_attribute("rows", n)

@@ -336,9 +336,22 @@ def build_histograms_matmul(binned: jnp.ndarray, grad: jnp.ndarray,
 # per-row integers, sibling subtraction (right = parent - left) is EXACT in
 # integer space — no f32 cancellation drift between levels.
 
+def global_row_ids(axis_name: Optional[str], n: int):
+    """Global ids of this shard's ``n`` contiguous rows, or None when
+    unsharded (local ids are already global).  THE formula the elastic
+    bit-identity contract rides (ISSUE 14): with contiguous block
+    sharding, real rows keep identical ids at ANY shard count, so
+    rounding noise keyed on them is width-independent — both growers
+    must use this one helper, never a local copy."""
+    if axis_name is None:
+        return None
+    return jax.lax.axis_index(axis_name) * n + jnp.arange(n)
+
+
 def quantize_gradients(grad, hess, quant_bins: int, seed: int = 0,
                        axis_name: Optional[str] = None,
-                       g_scale=None, h_scale=None):
+                       g_scale=None, h_scale=None,
+                       row_ids=None, mix=None):
     """Stochastically round per-row grad/hess to small signed/unsigned ints.
 
     Returns ``(qg, qh, g_scale, h_scale)`` with ``qg`` in
@@ -362,6 +375,19 @@ def quantize_gradients(grad, hess, quant_bins: int, seed: int = 0,
     bitcast of the gradient sum, which changes every iteration (the scores
     moved), decorrelating rounding patterns across iterations while staying
     deterministic and tracer-safe.
+
+    Topology independence (elastic resume, ISSUE 14): with ``row_ids``
+    given (the GLOBAL row index of each local row), the per-row noise is
+    counter-based — ``u(row) = uniform(fold_in(key, row_id))`` — so a row
+    rounds identically no matter which shard or tile holds it.  The key
+    itself must then also be topology-free: inside ``shard_map``
+    (``axis_name`` set) it folds an exact INTEGER psum of the bitcast
+    |grad|/hess magnitudes (integer adds are associative, so 4 shards and
+    8 shards fold the same value; |g| zeroes the sign bit so ``-0.0`` pad
+    rows cannot skew the count); single-shard callers that stream tiles
+    pass ``mix`` (an int32 computed once over the whole row space) for the
+    same guarantee.  Without ``row_ids`` the original shape-keyed draw is
+    preserved bit-for-bit.
     """
     import jax
     import jax.numpy as jnp
@@ -384,10 +410,28 @@ def quantize_gradients(grad, hess, quant_bins: int, seed: int = 0,
     else:
         g_scale = jnp.maximum(jnp.asarray(g_scale, jnp.float32), 1e-30)
         h_scale = jnp.maximum(jnp.asarray(h_scale, jnp.float32), 1e-30)
-    mix = jax.lax.bitcast_convert_type(
-        jnp.sum(g) + 3.0 * jnp.sum(h), jnp.int32)
-    key = jrandom.fold_in(jrandom.PRNGKey(seed), mix)
-    u = jrandom.uniform(key, (2,) + g.shape)
+    if mix is None:
+        if row_ids is not None and axis_name is not None:
+            # exact integer fold: associative across any shard layout
+            mix = jax.lax.psum(
+                jnp.sum(jax.lax.bitcast_convert_type(jnp.abs(g), jnp.int32))
+                + 3 * jnp.sum(jax.lax.bitcast_convert_type(h, jnp.int32)),
+                axis_name)
+        else:
+            mix = jax.lax.bitcast_convert_type(
+                jnp.sum(g) + 3.0 * jnp.sum(h), jnp.int32)
+    key = jrandom.fold_in(jrandom.PRNGKey(seed),
+                          jnp.asarray(mix, jnp.int32))
+    if row_ids is not None:
+        if g.ndim != 1:
+            raise ValueError("row_ids quantization expects 1-d grad/hess "
+                             f"(got shape {g.shape})")
+        row_keys = jax.vmap(lambda i: jrandom.fold_in(key, i))(
+            jnp.asarray(row_ids, jnp.int32))
+        u = jnp.moveaxis(
+            jax.vmap(lambda k: jrandom.uniform(k, (2,)))(row_keys), -1, 0)
+    else:
+        u = jrandom.uniform(key, (2,) + g.shape)
     qg = jnp.clip(jnp.floor(g / g_scale + u[0]),
                   -qg_cap, qg_cap).astype(jnp.int32)
     qh = jnp.clip(jnp.floor(h / h_scale + u[1]),

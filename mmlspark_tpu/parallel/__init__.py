@@ -5,6 +5,8 @@ from .sharding import (named_sharding, replicated, batch_sharded, shard_batch,
                        replicate, pad_to_multiple)
 from .collectives import (psum, pmean, pmax, all_gather, ppermute, ring_perm,
                           axis_index, shard_mapped)
+from .partition import (match_partition_rules, replace_on_mesh,
+                        tree_path_names)
 
 __all__ = [
     "AXIS_DATA", "AXIS_MODEL", "AXIS_SEQ", "AXIS_PIPE", "AXIS_EXPERT",
@@ -12,5 +14,6 @@ __all__ = [
     "active_mesh", "initialize_distributed", "named_sharding", "replicated",
     "batch_sharded", "shard_batch", "replicate", "pad_to_multiple", "psum",
     "pmean", "pmax", "all_gather", "ppermute", "ring_perm", "axis_index",
-    "shard_mapped",
+    "shard_mapped", "match_partition_rules", "replace_on_mesh",
+    "tree_path_names",
 ]

@@ -167,11 +167,18 @@ class TrainLoopCheckpointer:
     _STEP_KEY = "__step__"
 
     def __init__(self, directory: str, *, keep_last: int = 3,
-                 site: str = "parallel.trainer", registry=None):
+                 site: str = "parallel.trainer", registry=None,
+                 topology: Optional[dict] = None):
         self._mgr = CheckpointManager(directory, site=site,
                                       keep_last=keep_last, prefix="state",
                                       registry=registry)
         self.site = site
+        self._registry = registry
+        #: the topology stanza recorded into every snapshot's meta
+        #: (elastic resume, ISSUE 14): device count / mesh shape — allowed
+        #: to differ on restore, surfaced as ``last_topology_delta``
+        self.topology = dict(topology) if topology else None
+        self.last_topology_delta: Optional[dict] = None
 
     @property
     def manager(self) -> CheckpointManager:
@@ -188,12 +195,21 @@ class TrainLoopCheckpointer:
         flat = traverse_util.flatten_dict(
             {"t": {"params": host["params"],
                    "batch_stats": host["batch_stats"]}}, sep="/")
-        arrays = {k: v for k, v in flat.items() if v is not None}
-        arrays[self._STEP_KEY] = np.asarray(host["step"])
+        # device_get on the CPU backend returns ZERO-COPY views of the
+        # device buffers (ndarray.base is the jax capsule) — and the
+        # training loop donates this state into the very next train_step
+        # while the background writer is still serializing.  The sync
+        # fetch on the training thread must therefore be a sync COPY, or
+        # the writer reads freed/overwritten memory (segfault, or worse:
+        # a silently torn snapshot that resumes to wrong losses).
+        arrays = {k: np.array(v) for k, v in flat.items() if v is not None}
+        arrays[self._STEP_KEY] = np.array(host["step"])
         arrays[self._OPT_KEY] = np.frombuffer(
             pickling.dumps(jax.device_get(state.opt_state)), dtype=np.uint8)
-        self._mgr.save(step, arrays, dict(meta or {}, kind="train_state"),
-                       block=block)
+        meta = dict(meta or {}, kind="train_state")
+        if self.topology is not None:
+            meta["topology"] = self.topology
+        self._mgr.save(step, arrays, meta, block=block)
 
     def wait(self) -> None:
         self._mgr.wait()
@@ -204,11 +220,27 @@ class TrainLoopCheckpointer:
     def load_latest(self, trainer=None) -> Optional[TrainState]:
         """Newest valid snapshot as a TrainState (re-sharded onto
         ``trainer``'s mesh when given), or None.  A torn newest snapshot
-        falls back to the previous one (CheckpointManager contract)."""
-        got = self._mgr.load_latest()
+        falls back to the previous one (CheckpointManager contract).
+
+        Elastic resume (ISSUE 14): when this checkpointer carries a
+        topology stanza, the snapshot's recorded stanza is diffed against
+        it — a change is booked (``mmlspark_reshard_total{driver=
+        "parallel.trainer"}`` + ring event) and surfaced as
+        ``self.last_topology_delta``; the state then re-places onto the
+        trainer's CURRENT mesh through its partition rules, which is what
+        makes restoring onto a grown/shrunk fleet a plain restore."""
+        got = self._mgr.load_latest(current_topology=self.topology)
+        self.last_topology_delta = None
         if got is None:
             return None
         _, arrays, _meta = got
+        delta = _meta.get("topology_delta")
+        if delta is not None:
+            self.last_topology_delta = delta
+            if delta["changed"]:
+                from ..io.checkpoint import book_reshard
+                book_reshard("parallel.trainer", delta,
+                             registry=self._registry)
         from flax import traverse_util
         from ..utils import pickling
         flat = {k: v for k, v in arrays.items()

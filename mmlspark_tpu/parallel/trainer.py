@@ -126,29 +126,53 @@ class Trainer:
                            step=jnp.zeros((), jnp.int32), batch_stats=batch_stats)
         return self.shard_state(state)
 
+    def partition_rules(self):
+        """Ordered ``(regex, PartitionSpec-or-callable)`` placement table
+        for a TrainState tree (``parallel.partition`` semantics): params
+        ride the size-aware kernel rule (big >=2-d kernels shard their
+        last axis over ``model``), everything else replicates.  One table
+        instead of per-field ``tree.map`` glue — the same rules place a
+        fresh ``init_state`` and a checkpoint restored onto a DIFFERENT
+        mesh (elastic resume, ISSUE 14)."""
+        from jax.sharding import PartitionSpec as P
+        model_size = dict(zip(self.mesh.axis_names,
+                              self.mesh.devices.shape)).get(AXIS_MODEL, 1)
+        min_size = self.min_shard_size
+
+        def kernel_rule(name, leaf):
+            return param_spec(leaf, model_size, min_size)
+
+        return ((r"^params(/|$)", kernel_rule),
+                (r"^(opt_state|step|batch_stats)(/|$)", P()))
+
     def shard_state(self, state: TrainState) -> TrainState:
         import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax.sharding import NamedSharding
+        from .partition import match_partition_rules, replace_on_mesh
         mesh = self.mesh
-        p_shard = shard_params_by_rule(state.params, mesh, self.min_shard_size)
-        rep = NamedSharding(mesh, P())
-        opt_shard = jax.tree.map(lambda _: rep, state.opt_state)
-        bs_shard = None if state.batch_stats is None else \
-            jax.tree.map(lambda _: rep, state.batch_stats)
-        self._state_shardings = TrainState(params=p_shard, opt_state=opt_shard,
-                                           step=rep, batch_stats=bs_shard)
+        tree = {"params": state.params, "opt_state": state.opt_state,
+                "step": state.step, "batch_stats": state.batch_stats or {}}
+        rules = self.partition_rules()
+        specs = match_partition_rules(rules, tree)
+        sh = jax.tree.map(lambda spec: NamedSharding(mesh, spec), specs)
+        self._state_shardings = TrainState(
+            params=sh["params"], opt_state=sh["opt_state"], step=sh["step"],
+            batch_stats=None if state.batch_stats is None
+            else sh["batch_stats"])
         # instrumented placement: mmlspark_device_transfer_bytes_total books
         # the host->device feed per site (the out-of-core work needs this
-        # visible before it lands)
-        from ..observability.compute import device_put as _obs_device_put
-        put = lambda x, s: _obs_device_put(x, s,
-                                           site="parallel.trainer.shard_state")
+        # visible before it lands).  replace_on_mesh also re-places device
+        # arrays sharded over a PREVIOUS mesh, so a restored checkpoint
+        # and a fresh init take the same path; the matched specs are
+        # passed through so the tree is walked once.
+        placed = replace_on_mesh(tree, rules, mesh,
+                                 site="parallel.trainer.shard_state",
+                                 specs=specs)
         return TrainState(
-            params=jax.tree.map(put, state.params, p_shard),
-            opt_state=jax.tree.map(put, state.opt_state, opt_shard),
-            step=put(state.step, rep),
-            batch_stats=None if state.batch_stats is None else
-            jax.tree.map(put, state.batch_stats, bs_shard))
+            params=placed["params"], opt_state=placed["opt_state"],
+            step=placed["step"],
+            batch_stats=None if state.batch_stats is None
+            else placed["batch_stats"])
 
     # ------------------------------------------------------------------ steps
     def _build_train_step(self):
@@ -253,7 +277,15 @@ class Trainer:
         and ``resume="auto"`` restores the newest valid snapshot and
         fast-forwards ``batches`` past the steps it already holds — so the
         SAME batch iterable must be passed again on resume (``resume=
-        "never"`` disables restoring).  SIGTERM/SIGINT during the loop
+        "never"`` disables restoring; ``resume="must"`` additionally
+        RAISES when no usable snapshot exists, the restart-script
+        contract).  Elastic resume (ISSUE 14): the snapshot records a
+        topology stanza, and restoring onto a trainer with a DIFFERENT
+        device count/mesh re-places the state through the partition
+        rules (replicated params/opt_state re-placed, batch re-sharded
+        over the new ``data`` axis) — the change is booked
+        (``mmlspark_reshard_total``) and surfaced as
+        ``stats["resharded"]``.  SIGTERM/SIGINT during the loop
         requests one final checkpoint at the next step boundary and
         returns cleanly with ``stats["preempted"]`` set — a preempted
         worker resumes instead of restarting.
@@ -271,22 +303,37 @@ class Trainer:
         from ..utils.resilience import PreemptionToken, preemption_scope
         batch_sh = NamedSharding(self.mesh, P(AXIS_DATA))
 
+        from ..io.checkpoint import (check_resume_arg,
+                                     resume_required_error, topology_stanza)
+        check_resume_arg(resume, checkpoint_dir=checkpoint_dir)
         ckpt = None
         skip = 0
         step0 = None
+        resharded = False
         if checkpoint_dir:
-            from ..io.checkpoint import check_resume_arg
-            check_resume_arg(resume)
             from .checkpoint import TrainLoopCheckpointer
-            ckpt = TrainLoopCheckpointer(checkpoint_dir,
-                                         keep_last=checkpoint_keep_last,
-                                         site=site)
+            mesh_axes = dict(zip(self.mesh.axis_names,
+                                 self.mesh.devices.shape))
+            ckpt = TrainLoopCheckpointer(
+                checkpoint_dir, keep_last=checkpoint_keep_last, site=site,
+                topology=topology_stanza(
+                    mesh=self.mesh,
+                    shard_count=int(mesh_axes.get(AXIS_DATA, 1))))
             step0 = int(jax.device_get(state.step))
-            if resume == "auto":
+            if resume in ("auto", "must"):
+                # load_latest(trainer=self) re-places the restored state
+                # onto THIS trainer's mesh through the partition rules —
+                # the device count/mesh the snapshot was taken on may
+                # differ (elastic resume); the topology delta is booked
+                # by the checkpointer and surfaced in stats
                 restored = ckpt.load_latest(trainer=self)
+                if restored is None and resume == "must":
+                    raise resume_required_error(checkpoint_dir)
                 if restored is not None:
                     skip = max(0, int(jax.device_get(restored.step)) - step0)
                     state = restored
+                    delta = ckpt.last_topology_delta
+                    resharded = bool(delta and delta["changed"])
 
         def _load(batch):
             return jax.tree.map(
@@ -320,7 +367,7 @@ class Trainer:
         losses = [float(l) for l in losses]
         stats = prefetcher.overlap_stats()
         stats.update(steps=float(steps_done), resumed_from_step=float(skip),
-                     preempted=float(preempted))
+                     preempted=float(preempted), resharded=float(resharded))
         if ckpt is not None:
             if not preempted and (steps_done > skip or skip == 0):
                 # terminal snapshot: resume of a finished stream restores

@@ -1,9 +1,11 @@
 from .server import (PipelineServer, DistributedPipelineServer, ServingStats)
-from .distributed import RoutingClient, TopologyService, WorkerServer
+from .distributed import (MembershipWatcher, RoutingClient, TopologyService,
+                          WorkerServer)
 from .streaming import HTTPStreamSource, StreamingQuery, read_stream
 from .loadgen import check_gates, sustained_load, mixed_load
 
 __all__ = ["PipelineServer", "DistributedPipelineServer", "ServingStats",
            "TopologyService", "WorkerServer", "RoutingClient",
+           "MembershipWatcher",
            "HTTPStreamSource", "StreamingQuery", "read_stream",
            "sustained_load", "mixed_load", "check_gates"]

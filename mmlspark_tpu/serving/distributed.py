@@ -18,13 +18,15 @@ put it behind an LB.  Workers reply directly on their own sockets
 """
 from __future__ import annotations
 
+import itertools
 import json
+import os
 import threading
 import time
 import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, Iterable, List, Optional
 
 from .server import PipelineServer
 from ..observability import get_registry, instrument_breaker
@@ -71,9 +73,16 @@ def _http_json(url: str, payload: Optional[dict] = None, timeout: float = 10.0,
 #: prefix-matched flag read.
 TOPOLOGY_ENDPOINTS = {
     "GET": ("/routing", "/flag/<key>", "/stats", "/fleet/slow",
-            "/fleet/metrics", "/fleet/slo", "/fleet/autoscale", "/health"),
+            "/fleet/metrics", "/fleet/slo", "/fleet/autoscale",
+            "/fleet/membership", "/health"),
     "POST": ("/register", "/deregister", "/flag"),
 }
+
+#: per-process instance counter for the membership-epoch gauge label: a
+#: registry shared by several services (tests, embedded drivers) must not
+#: have one service's epoch stomp another's series, and port 0 is not
+#: known until start() so host:port cannot label at construction
+_SERVICE_IDS = itertools.count()
 
 
 def _nonneg_int(raw: str) -> int:
@@ -186,6 +195,27 @@ class TopologyService:
             "mmlspark_topology_evictions_total",
             "workers evicted after consecutive probe failures",
             labels=("worker",))
+        # training-fleet membership plane (ISSUE 14): a monotonically
+        # increasing epoch that bumps EXACTLY once per join / evict /
+        # leave — the signal an elastic training loop (MembershipWatcher)
+        # observes to checkpoint-and-exit instead of riding a dead
+        # collective.  Registered at construction (coverage-gated).
+        self._membership_epoch = 0
+        _sid = next(_SERVICE_IDS)
+        self._membership_label = f"topology-{_sid}"
+        # served in /fleet/membership as "instance": a restarted driver
+        # is a DIFFERENT membership plane even when its fresh epoch has
+        # already caught up past a watcher's last-seen value — pid makes
+        # it unique across processes, the counter within one
+        self._boot_id = f"{os.getpid():x}-{_sid}"
+        self._m_membership = self.registry.gauge(
+            "mmlspark_fleet_membership_epoch",
+            "monotonic fleet-membership epoch (bumps once per worker "
+            "join/evict/leave)", labels=("service",))
+        self._m_membership.set(0.0, service=self._membership_label)
+        self._m_membership_changes = self.registry.counter(
+            "mmlspark_fleet_membership_changes_total",
+            "membership transitions by kind", labels=("change",))
         self._lock = threading.Lock()
         self._workers: Dict[str, Dict] = {}
         self._fail_counts: Dict[str, int] = {}
@@ -240,17 +270,39 @@ class TopologyService:
                 length = int(self.headers.get("Content-Length", 0))
                 payload = json.loads(self.rfile.read(length).decode() or "{}")
                 if self.path == "/register":
+                    payload.setdefault("role", "worker")
+                    payload.setdefault("generation", 0)
+                    bump = None
                     with svc._lock:
                         sid = payload["server_id"]
+                        prev = svc._workers.get(sid)
+                        # a JOIN is a sid the table does not route to, or
+                        # a returning worker announcing a NEW generation
+                        # (a crashed box back before the prober noticed);
+                        # a same-generation re-register is a heartbeat
+                        # and must NOT bump the epoch
+                        if prev is None or \
+                                prev.get("generation") != payload["generation"]:
+                            bump = svc._bump_epoch_locked("joined", sid,
+                                                          payload)
                         svc._workers[sid] = payload
                         # (re-)registration wipes any stale health verdict
                         svc._fail_counts.pop(sid, None)
                         svc._evicted.pop(sid, None)
-                    self._json(200, {"ok": True,
-                                     "num_workers": len(svc._workers)})
+                        num, epoch = len(svc._workers), svc._membership_epoch
+                    if bump is not None:
+                        svc._book_membership(*bump)
+                    self._json(200, {"ok": True, "num_workers": num,
+                                     "membership_epoch": epoch})
                 elif self.path == "/deregister":
+                    bump = None
                     with svc._lock:
-                        svc._workers.pop(payload.get("server_id"), None)
+                        sid = payload.get("server_id")
+                        gone = svc._workers.pop(sid, None)
+                        if gone is not None:
+                            bump = svc._bump_epoch_locked("left", sid, gone)
+                    if bump is not None:
+                        svc._book_membership(*bump)
                     self._json(200, {"ok": True})
                 elif self.path == "/flag":
                     with svc._lock:
@@ -315,6 +367,8 @@ class TopologyService:
                     self._json(200, {"classes": recs,
                                      "workers": view.to_dict()["workers"],
                                      "evaluated_at": view.scraped_at})
+                elif path == "/fleet/membership":
+                    self._json(200, svc.membership())
                 elif path == "/health":
                     self._json(200, {"ok": True})
                 else:
@@ -323,12 +377,58 @@ class TopologyService:
         return Handler
 
     # ---------------------------------------------------------------- health
+    def _bump_epoch_locked(self, change: str, sid: str,
+                           worker: Optional[Dict]) -> tuple:
+        """Advance the membership epoch — caller MUST hold ``self._lock``
+        — and return the transition tuple for :meth:`_book_membership`
+        (booked OUTSIDE the lock: the ring event does I/O).  Every
+        mutation site rides this one helper so the exactly-once
+        bump-per-change contract is structural, not copy-pasted."""
+        self._membership_epoch += 1
+        return (self._membership_epoch, change, sid, worker)
+
+    def _book_membership(self, epoch: int, change: str, sid: str,
+                         worker: Optional[Dict]) -> None:
+        """Book one membership transition: epoch gauge, per-kind counter,
+        and the ``fleet_membership_changed`` ring event a training loop's
+        watcher (or an operator tailing events) observes.  The gauge is
+        written from the CURRENT epoch while holding the lock, not this
+        transition's value outside it: two transitions booking out of
+        lock order must never regress a gauge documented as monotonic —
+        the ring event keeps the per-transition epoch."""
+        with self._lock:
+            # set while HOLDING the lock: a re-read-then-set outside it
+            # still lets an older transition's write land last
+            self._m_membership.set(float(self._membership_epoch),
+                                   service=self._membership_label)
+        self._m_membership_changes.inc(change=change)
+        from ..core.logging import log_event
+        log_event({"event": "fleet_membership_changed", "epoch": int(epoch),
+                   "change": change, "worker": sid,
+                   "role": (worker or {}).get("role"),
+                   "generation": (worker or {}).get("generation")})
+
+    def membership(self) -> Dict:
+        """The ``GET /fleet/membership`` body: current epoch plus every
+        live worker's role/generation/address — what an elastic training
+        loop polls to notice the fleet changed under it."""
+        with self._lock:
+            workers = {sid: {"role": w.get("role", "worker"),
+                             "generation": int(w.get("generation", 0)),
+                             "host": w.get("host"), "port": w.get("port"),
+                             "request_class": w.get("request_class")}
+                       for sid, w in self._workers.items()}
+            return {"epoch": int(self._membership_epoch), "workers": workers,
+                    "evicted": sorted(self._evicted),
+                    "instance": self._boot_id}
+
     def probe_once(self) -> List[str]:
         """One health sweep over the registered workers; returns the ids
         evicted by this sweep.  Also the unit the background prober loops."""
         with self._lock:
             snapshot = list(self._workers.items())
         evicted: List[str] = []
+        bumps = []
         for sid, w in snapshot:
             healthy = self.prober(w, self.probe_timeout_s)
             self._m_probes.inc(worker=sid,
@@ -342,11 +442,16 @@ class TopologyService:
                 fails = self._fail_counts.get(sid, 0) + 1
                 self._fail_counts[sid] = fails
                 if fails >= self.evict_after:
-                    self._evicted[sid] = self._workers.pop(sid)
+                    gone = self._workers.pop(sid)
+                    self._evicted[sid] = gone
                     self._fail_counts.pop(sid, None)
                     evicted.append(sid)
+                    bumps.append(self._bump_epoch_locked("evicted", sid,
+                                                         gone))
         for sid in evicted:
             self._m_evictions.inc(worker=sid)
+        for bump in bumps:
+            self._book_membership(*bump)
         return evicted
 
     def _probe_loop(self) -> None:
@@ -611,13 +716,20 @@ class WorkerServer:
 
     def __init__(self, model, server_id: str, driver_address: str,
                  partition_ids: Optional[List[int]] = None,
-                 request_class: str = "default", **kw):
+                 request_class: str = "default", role: str = "serving",
+                 generation: int = 0, **kw):
         self.server_id = server_id
         self.driver_address = driver_address.rstrip("/")
         self.partition_ids = partition_ids or []
         # the traffic class this replica serves (e.g. "score" / "decode"):
         # the autoscale signal groups workers by it (ISSUE 11)
         self.request_class = request_class
+        # membership plane (ISSUE 14): the role this worker plays in the
+        # fleet ("serving" / "trainer" / ...) and its restart generation —
+        # a returning worker announces generation+1 so the driver books a
+        # join even if the prober never noticed the crash
+        self.role = role
+        self.generation = int(generation)
         self.server = PipelineServer(model, **kw)
 
     def start(self) -> "WorkerServer":
@@ -627,7 +739,8 @@ class WorkerServer:
                     "port": self.server.port,
                     "api_path": self.server.api_path,
                     "partition_ids": self.partition_ids,
-                    "request_class": self.request_class})
+                    "request_class": self.request_class,
+                    "role": self.role, "generation": self.generation})
         return self
 
     def stop(self) -> None:
@@ -641,6 +754,134 @@ class WorkerServer:
     @property
     def address(self) -> str:
         return self.server.address
+
+
+class MembershipWatcher:
+    """Watches ``GET /fleet/membership`` for a fleet SHRINK (ISSUE 14).
+
+    The elastic-training half of the membership plane: a training loop
+    hands this to :func:`utils.resilience.preemption_scope` (``watcher=``)
+    — or starts it standalone around the whole run — and when the epoch
+    advances with FEWER workers than before, the watcher requests
+    preemption, so the loop writes its final checkpoint and exits instead
+    of riding a collective whose peer just died.  Growth (a join) is
+    observed but never preempts: new capacity joins at the next restart's
+    re-shard, it does not invalidate the running step.
+
+    ``poll_once()`` is the deterministic unit tests drive; ``start()``
+    loops it on a daemon thread every ``poll_s``.  A dead or slow driver
+    is swallowed — losing the membership view must degrade to signal-only
+    preemption, never kill the training it protects."""
+
+    def __init__(self, driver_address: str, poll_s: float = 2.0,
+                 timeout_s: float = 2.0,
+                 on_shrink: Optional[Callable[[Dict], None]] = None,
+                 roles: Optional[Iterable[str]] = None):
+        self.driver_address = driver_address.rstrip("/")
+        self.poll_s = float(poll_s)
+        self.timeout_s = float(timeout_s)
+        self.on_shrink = on_shrink
+        # on a TopologyService shared with serving replicas, a scaled-down
+        # or evicted SERVING worker must not preempt training — pass
+        # roles={"trainer"} to watch only the collective's own peers.
+        # None keeps every worker in view (single-purpose fleets).
+        self.roles = None if roles is None else frozenset(roles)
+        self.last_epoch: Optional[int] = None
+        self.last_workers: Optional[Dict[str, int]] = None  # sid -> generation
+        self.last_instance: Optional[str] = None
+        self.shrinks = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def poll_once(self) -> Optional[Dict]:
+        """One membership read; returns the shrink info dict when this
+        poll observed a shrink (and fired the preemption), else None."""
+        try:
+            m = _http_json(f"{self.driver_address}/fleet/membership",
+                           timeout=self.timeout_s)
+        except Exception:  # noqa: BLE001 — a blind watcher must not kill
+            return None    # the training loop it guards
+        epoch = int(m.get("epoch", 0))
+        workers = {sid: int((w or {}).get("generation", 0))
+                   for sid, w in dict(m.get("workers", {})).items()
+                   if self.roles is None
+                   or (w or {}).get("role") in self.roles}
+        inst = m.get("instance")
+        first = self.last_epoch is None
+        restarted = not first and (
+            (inst is not None and self.last_instance is not None
+             and inst != self.last_instance)
+            or epoch < self.last_epoch)
+        if restarted:
+            # a NEW instance token (or, pre-upgrade, an epoch that went
+            # backwards): a restarted (fresh, in-memory) membership
+            # plane, not a transition — the old view is incomparable.
+            # The token matters because a restart whose re-registrations
+            # already pushed the fresh epoch PAST our last-seen value
+            # looks like a plain advance.  Rebaseline instead of diffing
+            # across service instances: a restarted driver's half-empty
+            # registry would read as "every peer lost" and preempt a
+            # healthy collective, and a lost membership view must
+            # degrade to signal-only preemption, never kill the run it
+            # guards.
+            self.last_epoch, self.last_workers = epoch, workers
+            self.last_instance = inst
+            return None
+        # a shrink is a worker the last view HAD that this one lost —
+        # keyed by id AND generation, not a count compare: an eviction
+        # masked by an unrelated join keeps the count flat, and a crash
+        # whose supervisor re-registers the same id with generation+1
+        # inside one poll interval keeps even the ID SET flat — in both
+        # cases the collective's original peer process is dead
+        lost = set() if first else {
+            sid for sid, gen in self.last_workers.items()
+            if workers.get(sid, -1) != gen}
+        shrunk = not first and epoch > self.last_epoch and bool(lost)
+        self.last_epoch, self.last_workers = epoch, workers
+        self.last_instance = inst
+        if not shrunk:
+            return None
+        self.shrinks += 1
+        info = {"epoch": epoch, "workers": len(workers),
+                "lost": sorted(lost)}
+        if self.on_shrink is not None:
+            self.on_shrink(info)
+        else:
+            from ..utils.resilience import request_preemption
+            request_preemption("fleet_membership_shrink")
+        return info
+
+    def _loop(self, stop: threading.Event) -> None:
+        # the event is captured per thread: a loop orphaned by a
+        # timed-out stop() keeps its own SET event and dies at the next
+        # wake even after start() arms a fresh one — never two pollers
+        while not stop.wait(self.poll_s):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — the watcher must never
+                # die: a malformed /fleet/membership body (proxy error
+                # page behind a 200) or a user on_shrink callback that
+                # raises would otherwise silently kill the thread, and
+                # every later shrink would go unobserved — the exact
+                # dead-collective hang this watcher exists to prevent
+                pass
+
+    def start(self) -> "MembershipWatcher":
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, args=(self._stop,), daemon=True,
+            name="mmlspark-membership-watcher")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=self.poll_s + self.timeout_s + 1.0)
+        self._thread = None
 
 
 class RoutingClient:

@@ -29,7 +29,8 @@ from ..utils.resilience import FakeClock  # re-export for chaos suites
 
 __all__ = ["ChaosInjector", "LatencyInjector", "ConnectionErrorInjector",
            "StatusStormInjector", "WorkerKiller", "FakeClock",
-           "FlakyLoadInjector", "PreemptionSimulator"]
+           "FlakyLoadInjector", "PreemptionSimulator",
+           "ElasticTopologyDrill"]
 
 Transport = Callable[[HTTPRequestData, float], HTTPResponseData]
 
@@ -172,6 +173,142 @@ class PreemptionSimulator:
         if not self.fired and iteration >= self.at_iteration:
             self.fired = True
             _signal.raise_signal(self.signum)
+
+
+class ElasticTopologyDrill:
+    """SIGKILL a sharded training child mid-run, resume it at a DIFFERENT
+    mesh width, grow back — the ISSUE 10 crash drill generalized across
+    topology (elastic resume, ISSUE 14).
+
+    Each leg runs ``lightgbm.train(shard_rows=True)`` on a ``data`` mesh
+    of ``width`` CPU devices (``--xla_force_host_platform_device_count``
+    fakes the fleet) against one shared checkpoint directory.  The child
+    appends each completed iteration to a marker file; :meth:`run_child`
+    SIGKILLs it — no grace, no handler, the crash class atomic
+    publication exists for — once enough NEW iterations landed.
+    :meth:`train_inline` runs a leg (or the uninterrupted baseline)
+    in-process and returns the TrainResult, so the final assertion —
+    resumed-across-widths booster == uninterrupted booster, bit for bit —
+    stays a plain array compare.  Quantized histograms are forced ON:
+    integer accumulation plus global-row-keyed rounding noise is what
+    makes the cross-width replay exact."""
+
+    def __init__(self, ckpt_dir: str, marker_path: str, *, rows: int = 801,
+                 features: int = 6, num_iterations: int = 8,
+                 max_depth: int = 3, seed: int = 3, data_seed: int = 0):
+        self.ckpt_dir = str(ckpt_dir)
+        self.marker_path = str(marker_path)
+        self.rows, self.features = int(rows), int(features)
+        self.num_iterations = int(num_iterations)
+        self.max_depth, self.seed = int(max_depth), int(seed)
+        self.data_seed = int(data_seed)
+
+    # ---- one data/params recipe, shared by children and inline legs
+    def make_data(self):
+        import numpy as np
+        rng = np.random.default_rng(self.data_seed)
+        X = rng.normal(size=(self.rows, self.features)).astype(np.float32)
+        y = (X[:, 0] + 0.5 * X[:, 1]
+             + rng.normal(scale=0.3, size=self.rows) > 0).astype(np.float32)
+        return X, y
+
+    def make_params(self):
+        from ..lightgbm import GBDTParams
+        return GBDTParams(num_iterations=self.num_iterations,
+                          objective="binary", max_depth=self.max_depth,
+                          growth="level", seed=self.seed,
+                          use_quantized_grad=True, bagging_fraction=0.7,
+                          bagging_freq=2, feature_fraction=0.8)
+
+    def child_program(self, width: int) -> str:
+        """Source of one training leg: mesh of ``width`` devices, resume
+        from (and checkpoint into) the shared directory, marker line per
+        iteration."""
+        return (
+            "import numpy as np\n"
+            "import jax\n"
+            "from mmlspark_tpu.lightgbm import GBDTParams\n"
+            "from mmlspark_tpu.lightgbm import core as gbdt_core\n"
+            "from mmlspark_tpu.parallel import active_mesh, make_mesh\n"
+            "from mmlspark_tpu.testing.chaos import ElasticTopologyDrill\n"
+            f"drill = ElasticTopologyDrill({self.ckpt_dir!r}, "
+            f"{self.marker_path!r}, rows={self.rows}, "
+            f"features={self.features}, "
+            f"num_iterations={self.num_iterations}, "
+            f"max_depth={self.max_depth}, seed={self.seed}, "
+            f"data_seed={self.data_seed})\n"
+            "X, y = drill.make_data()\n"
+            "def cb(it, ev):\n"
+            "    with open(drill.marker_path, 'a') as f:\n"
+            "        f.write(str(it) + chr(10))\n"
+            f"mesh = make_mesh({{'data': {int(width)}}}, "
+            f"jax.devices()[:{int(width)}])\n"
+            "with active_mesh(mesh):\n"
+            "    gbdt_core.train(X, y, drill.make_params(), shard_rows=True,\n"
+            "                    checkpoint_dir=drill.ckpt_dir,\n"
+            "                    checkpoint_every=1, callbacks=[cb])\n")
+
+    def _marker_lines(self) -> int:
+        import os
+        if not os.path.exists(self.marker_path):
+            return 0
+        with open(self.marker_path) as f:
+            return len(f.read().splitlines())
+
+    def run_child(self, width: int, min_new_iterations: int = 2,
+                  timeout_s: float = 240.0, env: Optional[dict] = None):
+        """Spawn one leg at ``width`` and SIGKILL it after it has logged
+        ``min_new_iterations`` NEW iterations (children that finish
+        first are left finished).  Returns the iteration count observed
+        at the kill."""
+        import os
+        import subprocess
+        import sys
+        base = self._marker_lines()
+        run_env = dict(os.environ, JAX_PLATFORMS="cpu")
+        flags = run_env.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            run_env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        if env:
+            run_env.update(env)
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        proc = subprocess.Popen([sys.executable, "-c",
+                                 self.child_program(width)],
+                                env=run_env, cwd=repo_root)
+        try:
+            deadline = time.time() + timeout_s
+            while time.time() < deadline:
+                if self._marker_lines() >= base + min_new_iterations:
+                    break
+                if proc.poll() is not None:
+                    break
+                time.sleep(0.05)
+            if proc.poll() is None:
+                proc.kill()              # SIGKILL: no cleanup, no handler
+            proc.wait(timeout=60)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        return self._marker_lines()
+
+    def train_inline(self, width: int, checkpoint: bool = True,
+                     resume: str = "auto"):
+        """Run one leg (or, with ``checkpoint=False``, the uninterrupted
+        baseline) in this process on a ``width``-wide mesh."""
+        import jax
+        from ..lightgbm import core as gbdt_core
+        from ..parallel import active_mesh, make_mesh
+        X, y = self.make_data()
+        kw = {}
+        if checkpoint:
+            kw = dict(checkpoint_dir=self.ckpt_dir, checkpoint_every=1,
+                      resume=resume)
+        mesh = make_mesh({"data": int(width)}, jax.devices()[: int(width)])
+        with active_mesh(mesh):
+            return gbdt_core.train(X, y, self.make_params(),
+                                   shard_rows=True, **kw)
 
 
 class WorkerKiller:

@@ -417,28 +417,66 @@ def is_transient_io(exc: BaseException) -> bool:
 
 class PreemptionToken:
     """Cooperative shutdown flag set by SIGTERM/SIGINT inside a
-    :func:`preemption_scope`.  Training loops poll :attr:`requested` at
+    :func:`preemption_scope` — or programmatically via
+    :func:`request_preemption` (a fleet-membership watcher observing a
+    shrink, ISSUE 14).  Training loops poll :attr:`requested` at
     iteration boundaries: a set token means "write a final checkpoint and
     return cleanly" — the preempted worker resumes instead of restarting.
     ``armed`` is False when the scope could not install handlers (not the
-    main thread); the token then never fires and the loop runs normally."""
+    main thread); signals then never fire it, but programmatic requests
+    still do.  ``reason`` records what fired it (``"signal"`` or the
+    string a programmatic requester passed)."""
 
-    __slots__ = ("requested", "signum", "count", "armed")
+    __slots__ = ("requested", "signum", "count", "armed", "reason")
 
     def __init__(self, armed: bool = False):
         self.requested = False
         self.signum: Optional[int] = None
         self.count = 0
         self.armed = armed
+        self.reason: Optional[str] = None
 
     def fire(self, signum: int) -> None:
         self.requested = True
         self.signum = signum
+        self.reason = "signal"
+        self.count += 1
+
+    def fire_event(self, reason: str) -> None:
+        """Programmatic preemption (no signal): membership shrink,
+        operator drain, test harness."""
+        self.requested = True
+        self.reason = str(reason)
         self.count += 1
 
 
+#: tokens of every entered preemption_scope, innermost last — the target
+#: set of request_preemption().  Guarded by _TOKEN_LOCK; scopes push on
+#: entry and pop on exit even when signal installation degraded, so a
+#: membership watcher can preempt a loop running off the main thread.
+_TOKEN_STACK: list = []
+_TOKEN_LOCK = threading.Lock()
+
+
+def request_preemption(reason: str = "requested") -> int:
+    """Fire every active :class:`preemption_scope` token programmatically
+    — the non-signal preemption path (ISSUE 14): a fleet-membership
+    watcher that sees the training fleet shrink calls this so the loop
+    checkpoints and exits instead of riding a dead collective.  Returns
+    the number of tokens fired; books one ``preemption_requested`` ring
+    event when any was."""
+    with _TOKEN_LOCK:
+        tokens = list(_TOKEN_STACK)
+    for token in tokens:
+        token.fire_event(reason)
+    if tokens:
+        from ..core.logging import log_event
+        log_event({"event": "preemption_requested", "reason": str(reason)})
+    return len(tokens)
+
+
 @contextmanager
-def preemption_scope(signals: Tuple[int, ...] = None):
+def preemption_scope(signals: Tuple[int, ...] = None, watcher=None):
     """Install SIGTERM/SIGINT handlers for the duration of a training
     loop, yielding a :class:`PreemptionToken`.
 
@@ -448,7 +486,17 @@ def preemption_scope(signals: Tuple[int, ...] = None):
     handler (normally ``KeyboardInterrupt``): a user hammering ctrl-C
     still gets the hard stop.  Handlers are restored on exit.  Off the
     main thread signal installation is impossible; the scope degrades to
-    an inert (``armed=False``) token rather than failing the run."""
+    an inert (``armed=False``) token rather than failing the run — the
+    token still fires via :func:`request_preemption`, which reaches
+    every active scope (the stack makes an OUTER watcher preempt an
+    inner driver loop's token).
+
+    ``watcher`` (ISSUE 14) is an optional membership watcher — anything
+    with ``start()``/``stop()`` (e.g. ``serving.distributed.
+    MembershipWatcher``, whose default on-shrink action is
+    ``request_preemption``): started on entry, stopped on exit, so a
+    fleet shrink triggers checkpoint-and-exit instead of a collective
+    that hangs on dead peers."""
     if signals is None:
         signals = (_signal.SIGTERM, _signal.SIGINT)
     token = PreemptionToken()
@@ -456,9 +504,15 @@ def preemption_scope(signals: Tuple[int, ...] = None):
     try:
         for signum in signals:
             def _handler(sn, frame, _token=token, _signals=signals):
-                if _token.requested and sn == _signal.SIGINT:
+                if _token.signum is not None and sn == _signal.SIGINT:
                     # second ctrl-C: the user wants a hard stop, not
-                    # patience — chain to the previous handler, honouring
+                    # patience.  Gate on signum (a prior REAL signal),
+                    # not requested — a programmatic fire_event (e.g. a
+                    # membership-shrink request_preemption) sets
+                    # requested too, and the FIRST ctrl-C after it must
+                    # still take the graceful path, not interrupt the
+                    # final checkpoint.  Chain to the previous handler,
+                    # honouring
                     # SIG_DFL (reinstall + re-raise so the default
                     # terminate semantics apply) and SIG_IGN
                     prev = previous.get(sn)
@@ -479,9 +533,26 @@ def preemption_scope(signals: Tuple[int, ...] = None):
         # signal() call is what raises there), so there is nothing to
         # restore — degrade to an inert token
         previous = {}
+    with _TOKEN_LOCK:
+        _TOKEN_STACK.append(token)
     try:
+        # watcher start INSIDE the try: a start() that raises must still
+        # restore the handlers and pop the token, or the process keeps
+        # hijacked signals and a dead stack entry forever
+        if watcher is not None:
+            watcher.start()
         yield token
     finally:
+        if watcher is not None:
+            try:
+                watcher.stop()
+            except Exception:  # noqa: BLE001 — teardown must not mask
+                pass
+        with _TOKEN_LOCK:
+            try:
+                _TOKEN_STACK.remove(token)
+            except ValueError:
+                pass
         for signum, prev in previous.items():
             try:
                 _signal.signal(signum, prev)

@@ -183,6 +183,136 @@ def test_snapshot_steps_ignores_foreign_and_temp_files(tmp_path):
     m.close()
 
 
+def test_load_latest_skips_foreign_files_and_books_them(tmp_path):
+    """Operator-copied files and editor backups dropped beside the
+    snapshots must never fail (or confuse) the resume path: they are
+    skipped with one booked ``foreign_skipped`` + ring event, and the
+    newest REAL snapshot restores (ISSUE 14 satellite)."""
+    from mmlspark_tpu.core.logging import recent_events
+    reg = MetricsRegistry()
+    m = CheckpointManager(str(tmp_path), site="t", registry=reg)
+    m.save(1, {"a": np.ones(1)}, {"s": 1})
+    m.save(2, {"a": np.full(1, 2.0)}, {"s": 2}, block=True)
+    # foreign debris: a backup suffix ON a snapshot name (must not read
+    # as a torn step-3 snapshot), garbage that apes the prefix, and an
+    # unrelated npz — none of them parseable as snapshots
+    (tmp_path / "ckpt_0000000003.npz.orig").write_bytes(b"\x00garbage")
+    (tmp_path / "ckpt_labels.npz").write_bytes(b"not a snapshot")
+    (tmp_path / "scores-backup.npz").write_bytes(b"x")
+    got = m.load_latest()
+    assert got is not None and got[0] == 2 and got[2]["s"] == 2
+    fam = reg.family("mmlspark_checkpoint_resumes_total")
+    assert fam.labels(site="t", result="foreign_skipped").value == 1
+    assert fam.labels(site="t", result="torn_skipped").value == 0
+    ev = [e for e in recent_events()
+          if e.get("event") == "checkpoint_resume"
+          and e.get("result") == "foreign_skipped"]
+    assert ev and "ckpt_labels.npz" in ev[-1]["files"]
+    m.close()
+
+
+def test_eviction_racing_load_latest_falls_back_not_raise(tmp_path):
+    """Keep-last-K retention racing ``load_latest``: a snapshot evicted
+    between the directory listing and the open must fall back (and
+    re-list once when the stale listing exhausted), never raise."""
+    reg = MetricsRegistry()
+    clk = FakeClock()
+    m = CheckpointManager(str(tmp_path), site="t", keep_last=1,
+                          registry=reg, clock=clk)
+    m.save(1, {"a": np.ones(1)}, {"s": 1}, block=True)
+    gate = threading.Event()
+    orig_write = m._write_one
+
+    def gated_write(step, arrays, meta):
+        gate.wait(timeout=30)
+        orig_write(step, arrays, meta)
+
+    m._write_one = gated_write
+    m.save(2, {"a": np.full(1, 2.0)}, {"s": 2})   # pending behind the gate
+
+    orig_load = m.load
+
+    def racing_load(step):
+        # between the listing (which saw only step 1) and this open, the
+        # writer publishes step 2 and keep-last-1 evicts step 1
+        m.load = orig_load
+        gate.set()
+        m.wait()
+        return orig_load(step)
+
+    m.load = racing_load
+    step, arrays, meta = m.load_latest()
+    assert step == 2 and meta["s"] == 2
+    np.testing.assert_array_equal(arrays["a"], np.full(1, 2.0))
+    fam = reg.family("mmlspark_checkpoint_resumes_total")
+    assert fam.labels(site="t", result="evicted_skipped").value == 1
+    assert fam.labels(site="t", result="ok").value == 1
+    m.close()
+
+
+def test_relist_walk_books_each_skipped_snapshot_once(tmp_path):
+    """The one-shot re-list must not re-count artifacts it already
+    skipped: a torn snapshot that survives both walk passes used to book
+    ``torn_skipped`` twice (and a still-listed evicted file twice),
+    inflating the durability signal operators alert on."""
+    reg = MetricsRegistry()
+    m = CheckpointManager(str(tmp_path), site="t", registry=reg)
+    m.save(1, {"a": np.ones(1)}, {"s": 1}, block=True)
+    m.save(2, {"a": np.full(1, 2.0)}, {"s": 2}, block=True)
+    # step 2 torn on disk; step 1 "vanishes" between listing and open —
+    # the walk exhausts via the eviction path, re-lists once, and meets
+    # the SAME torn file again on the second pass
+    (tmp_path / "ckpt_0000000002.npz").write_bytes(b"\x00torn")
+    orig_load = m.load
+
+    def racing_load(step):
+        if step == 1:
+            raise FileNotFoundError(m.path_for(1))
+        return orig_load(step)
+
+    m.load = racing_load
+    assert m.load_latest() is None
+    fam = reg.family("mmlspark_checkpoint_resumes_total")
+    assert fam.labels(site="t", result="torn_skipped").value == 1
+    assert fam.labels(site="t", result="evicted_skipped").value == 1
+    assert fam.labels(site="t", result="none").value == 1
+    m.close()
+
+
+def test_resume_must_requires_a_snapshot(tmp_path):
+    """``resume='must'``: a preemption-restart script must not silently
+    retrain from zero on a wiped disk — every driver raises when no
+    usable snapshot exists, and proceeds normally when one does."""
+    from mmlspark_tpu.lightgbm import train, train_streamed
+    X, y = _data(n=600)
+    d = str(tmp_path / "empty")
+    with pytest.raises(FileNotFoundError, match="resume='must'"):
+        train_streamed(X, y, _stream_params(2), checkpoint_dir=d,
+                       resume="must")
+    with pytest.raises(FileNotFoundError, match="resume='must'"):
+        train(X, y, _stream_params(2), checkpoint_dir=d, resume="must")
+    # 'must' with NO checkpoint_dir at all (an env var that didn't
+    # propagate) is the same silent-retrain trap — raise, don't ignore
+    with pytest.raises(FileNotFoundError, match="resume='must'"):
+        train_streamed(X, y, _stream_params(2), resume="must")
+    with pytest.raises(FileNotFoundError, match="resume='must'"):
+        train(X, y, _stream_params(2), resume="must")
+    tr2, s02, batches2 = _trainer_fixture()
+    with pytest.raises(FileNotFoundError, match="resume='must'"):
+        tr2.train_stream(s02, batches2(), resume="must")
+    tr, s0, batches = _trainer_fixture()
+    with pytest.raises(FileNotFoundError, match="resume='must'"):
+        tr.train_stream(s0, batches(), checkpoint_dir=str(tmp_path / "e2"),
+                        resume="must")
+    # with a snapshot present, 'must' behaves exactly like 'auto'
+    d2 = str(tmp_path / "ck")
+    train_streamed(X, y, _stream_params(2), checkpoint_dir=d2,
+                   checkpoint_every=1)
+    r = train_streamed(X, y, _stream_params(2), checkpoint_dir=d2,
+                       resume="must")
+    assert r.extras["resumed_from_iteration"] == 2.0
+
+
 # ---------------------------------------------------------------------------
 # prefetch retry (FakeClock, seeded injector)
 # ---------------------------------------------------------------------------
@@ -311,6 +441,22 @@ def test_preemption_scope_degrades_off_main_thread():
     t.start()
     t.join()
     assert out["armed"] is False
+
+
+def test_first_sigint_after_programmatic_preemption_stays_graceful():
+    """The hard-stop escalation gates on a prior REAL signal (signum),
+    not on ``requested`` — a programmatic ``request_preemption`` (e.g. a
+    membership-shrink) sets requested too, and the first ctrl-C after it
+    must take the documented graceful path, not interrupt the final
+    checkpoint the request just triggered."""
+    from mmlspark_tpu.utils.resilience import request_preemption
+    with preemption_scope() as token:
+        assert request_preemption("fleet_membership_shrink") == 1
+        assert token.requested and token.signum is None
+        signal.raise_signal(signal.SIGINT)      # FIRST real signal
+        assert token.signum == signal.SIGINT and token.count == 2
+        with pytest.raises(KeyboardInterrupt):  # second escalates
+            signal.raise_signal(signal.SIGINT)
 
 
 def test_preemption_simulator_is_seeded():

@@ -106,6 +106,34 @@ def test_trc_pallas_kernels_are_tracing_roots():
     assert "_partial_kernel" in symbols
 
 
+def test_res003_scans_the_sanctioned_writer_module_too(tmp_path):
+    """ISSUE 14: io/checkpoint.py lost its whole-file RES003 exclusion —
+    only atomic_write's own raw open is sanctioned (inline pragma), so a
+    new writer landing in the contract-defining module (e.g. a topology-
+    stanza sidecar writer) is flagged like anywhere else."""
+    checker = CheckpointAtomicityChecker()
+    assert checker.interested("mmlspark_tpu/io/checkpoint.py")
+    assert checker.interested("mmlspark_tpu/parallel/checkpoint.py")
+    assert not checker.interested("mmlspark_tpu/lightgbm/core.py")
+    # a raw topology-stanza writer inside an io/checkpoint.py twin trips
+    mod_dir = tmp_path / "io"
+    mod_dir.mkdir()
+    (mod_dir / "checkpoint.py").write_text(
+        "def write_topology_stanza(path, stanza):\n"
+        "    with open(path, 'w') as f:\n"
+        "        f.write(repr(stanza))\n")
+    findings = _scan(CheckpointAtomicityChecker(),
+                     os.path.join("io", "checkpoint.py"),
+                     root=str(tmp_path))
+    assert {f.rule for f in findings} == {"RES003"}
+    # while the REAL module scans clean: atomic_write's open carries the
+    # inline pragma and every other open there is read-mode
+    real = _scan(CheckpointAtomicityChecker(),
+                 os.path.join("mmlspark_tpu", "io", "checkpoint.py"),
+                 root=REPO)
+    assert real == []
+
+
 def test_res002_fires_once_per_unbudgeted_site():
     findings = _scan(UndeadlinedRetryChecker(), "cognitive/res_deadline_bad.py")
     # deferred_callback.cb: a def under a deadline_scope runs later, when

@@ -453,6 +453,64 @@ def test_federation_surface_is_instrumented():
             f"TopologyService no longer registers {family}"
 
 
+def test_elastic_surface_books_metrics():
+    """ISSUE 14 coverage: elastic resume's reshard and membership sites
+    are what tells an operator a fleet changed shape under a training
+    run — the accounting must be un-droppable.  Source-level (like the
+    checkpoint sweep): all three drivers must book their topology delta
+    through ``book_reshard``, ``book_reshard`` itself must tick the
+    counter + ring event, the membership mutation sites must route
+    through ``_book_membership`` (gauge + per-kind counter + ring event),
+    and the growers' sharded quantization must key noise per global row
+    (the width-independence elastic bit-identity rides on).  Live:
+    CheckpointManager construction registers the reshard family and
+    TopologyService construction registers both membership families."""
+    import tempfile
+
+    from mmlspark_tpu.io import checkpoint as ckpt_mod
+    from mmlspark_tpu.lightgbm import core as gbdt_core
+    from mmlspark_tpu.observability import MetricsRegistry
+    from mmlspark_tpu.parallel import checkpoint as pckpt_mod
+    from mmlspark_tpu.serving import TopologyService
+    from mmlspark_tpu.serving import distributed as dist_mod
+
+    assert "book_reshard" in inspect.getsource(gbdt_core.train)
+    assert "book_reshard" in inspect.getsource(gbdt_core.train_streamed)
+    assert "book_reshard" in inspect.getsource(
+        pckpt_mod.TrainLoopCheckpointer.load_latest)
+    book_src = inspect.getsource(ckpt_mod.book_reshard)
+    assert '"reshard"' in book_src and "log_event" in book_src
+
+    for handler_src in (inspect.getsource(TopologyService._make_handler),
+                        inspect.getsource(TopologyService.probe_once)):
+        assert "_book_membership" in handler_src, \
+            "a membership mutation site lost its booking"
+    bm_src = inspect.getsource(TopologyService._book_membership)
+    for needle in ("_m_membership.set", "_m_membership_changes.inc",
+                   "log_event"):
+        assert needle in bm_src, f"_book_membership lost {needle}"
+    # width-independent rounding: both sharded growers pass global row
+    # ids into the quantizer (dropping one silently breaks the elastic
+    # bit-identity contract in a way only a cross-width run would catch)
+    for fn in (gbdt_core.make_tree_grower, gbdt_core.make_leafwise_grower):
+        assert "row_ids=row_ids" in inspect.getsource(fn), \
+            f"{fn.__name__} no longer keys rounding noise per global row"
+    assert "row_ids=ids_t" in inspect.getsource(gbdt_core.train_streamed)
+
+    reg = MetricsRegistry()
+    with tempfile.TemporaryDirectory() as d:
+        ckpt_mod.CheckpointManager(d, site="sweep14", registry=reg).close()
+    assert reg.family("mmlspark_reshard_total") is not None, \
+        "CheckpointManager no longer registers the reshard family"
+    reg2 = MetricsRegistry()
+    TopologyService(registry=reg2, probe_interval_s=None)  # never started
+    for family in ("mmlspark_fleet_membership_epoch",
+                   "mmlspark_fleet_membership_changes_total"):
+        assert reg2.family(family) is not None, \
+            f"TopologyService no longer registers {family}"
+    assert dist_mod.MembershipWatcher is not None
+
+
 def test_topology_endpoint_sweep():
     """Every HTTP endpoint the TopologyService handler serves must appear
     in the declared ``TOPOLOGY_ENDPOINTS`` table (and vice versa): a new
