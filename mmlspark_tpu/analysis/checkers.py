@@ -489,9 +489,13 @@ class CheckpointAtomicityChecker(Checker):
     # raw open INSIDE atomic_write is sanctioned, via its inline pragma —
     # a whole-file exclusion would let a new writer (e.g. a topology-
     # stanza sidecar) land unatomically in the very module that defines
-    # the contract.
+    # the contract.  The flight recorder (ISSUE 15) is held to the same
+    # contract: a postmortem dump racing the crash that triggered it must
+    # publish whole or not at all, so its writes go through atomic_write
+    # only.
     def interested(self, relpath: str) -> bool:
-        return "checkpoint" in relpath.rsplit("/", 1)[-1]
+        name = relpath.rsplit("/", 1)[-1]
+        return "checkpoint" in name or "flightrecorder" in name
 
     def visit(self, node: ast.AST, ctx: ModuleContext) -> None:
         if not isinstance(node, ast.Call):

@@ -362,11 +362,17 @@ class ModelRunner:
     runner books — keep it low-cardinality (a model family, not a uid).
     """
 
+    #: sampled block_until_ready cadence for the decode dispatch/device
+    #: split (the PR 6 Trainer pattern brought to the decode hot loop):
+    #: every Nth step pays one forced sync so the device-time series costs
+    #: 1/N of the async overlap; 0 disables the device phase entirely
+    DEVICE_TIME_EVERY_DEFAULT = 32
+
     def __init__(self, payload=None, *, module=None, variables=None,
                  apply_fn: Optional[Callable] = None,
                  apply_kwargs: Optional[Dict[str, Any]] = None,
                  name: str = "model", batch_size: int = 64,
-                 registry=None):
+                 registry=None, device_time_every: Optional[int] = None):
         if payload is not None:
             self._pure = payload.pure_apply
             self.variables = payload.variables
@@ -422,6 +428,20 @@ class ModelRunner:
             "per-sequence real generated tokens (unfrozen steps only; "
             "eos-frozen tails and pad rows are not generated work)",
             labels=("runner",)).labels(runner=name)
+        # decode-loop dispatch/device split (ISSUE 15): dispatch = host
+        # time to enqueue each step program, device = sampled
+        # block_until_ready wait every device_time_every steps — the
+        # numbers that prove (or refute) "dispatch-bound"
+        if device_time_every is None:
+            device_time_every = self.DEVICE_TIME_EVERY_DEFAULT
+        self.device_time_every = max(0, int(device_time_every))
+        h_phase = reg.histogram(
+            "mmlspark_runner_decode_phase_seconds",
+            "decode-step breakdown: dispatch (host enqueue) vs device "
+            "(sampled block_until_ready wait)", labels=("runner", "phase"))
+        self._h_phase_dispatch = h_phase.labels(runner=name,
+                                                phase="dispatch")
+        self._h_phase_device = h_phase.labels(runner=name, phase="device")
         # page-pool surface (paged decode): families registered at
         # construction so the telemetry-coverage sweep gates on them even
         # for runners that never decode; PagePool binds the children
@@ -455,6 +475,11 @@ class ModelRunner:
         self._pools: Dict[Tuple, PagePool] = {}
         #: resolved geometry of the most recent decode (DecodeResult.extras)
         self.last_decode_extras: Optional[Dict[str, Any]] = None
+        # flight-recorder roster (ISSUE 15): the postmortem dump walks the
+        # registry's live runners for their last decode geometry — a
+        # WeakSet, so enrolment never pins a discarded runner
+        from ..observability.flightrecorder import _roster
+        _roster(reg, "_model_runners").add(self)
 
     # ------------------------------------------------------------- lowering
     @staticmethod
@@ -918,6 +943,18 @@ class ModelRunner:
         # only when extend/free dirties it
         table_dev = jnp.asarray(table) if paged else None
         table_dirty = False
+        # dispatch/device split (ISSUE 15, the PR 6 Trainer pattern on the
+        # decode hot loop): dispatch = host time to enqueue each step,
+        # device = sampled block_until_ready wait every Nth step; the loop
+        # runs under an ambient profiler phase so host-stack samples
+        # attribute to the decode loop by name
+        from ..observability.tracing import (Span, _enter_phase,
+                                             _exit_phase, current_trace_id,
+                                             export_span)
+        dte = self.device_time_every
+        dispatch_s_total = device_s_total = 0.0
+        t_loop0 = time.perf_counter()
+        _phase = _enter_phase("runner.decode")
         try:
             last, cache = prefill(
                 variables, jnp.asarray(toks), jnp.asarray(positions),
@@ -1022,6 +1059,7 @@ class ModelRunner:
                         # copy (the table arg is never donated)
                         table_dev = jnp.asarray(table)
                         table_dirty = False
+                t_disp0 = time.perf_counter()
                 if fused:
                     # donated dispatch: fin_d/cache are CONSUMED here — the
                     # loop rebinds all three outputs and must never touch
@@ -1033,10 +1071,23 @@ class ModelRunner:
                     last, cache = step(variables, jnp.asarray(tok[:, None]),
                                        jnp.asarray(pos[:, None]), table_dev,
                                        cache)
+                disp_s = time.perf_counter() - t_disp0
+                dispatch_s_total += disp_s
+                self._h_phase_dispatch.observe(disp_s)
                 steps += 1
                 self._c_decode_steps.inc()
+                if dte and steps % dte == 0:
+                    # sampled only: the forced sync ends async pipelining
+                    # for this step, so the device series costs 1/N of the
+                    # dispatch/execute overlap
+                    t_dev0 = time.perf_counter()
+                    jax.block_until_ready(tok_d if fused else last)
+                    dev_s = time.perf_counter() - t_dev0
+                    device_s_total += dev_s
+                    self._h_phase_device.observe(dev_s)
             ok = True
         finally:
+            _exit_phase(_phase)
             if paged:
                 leftover = [p for pgs in seq_pages for p in pgs]
                 if leftover:
@@ -1056,7 +1107,20 @@ class ModelRunner:
             "kv_layout": "paged" if paged else "dense",
             "real_tokens": real_tokens,
             "batch_bucket": B_b,
+            "dispatch_s": round(dispatch_s_total, 6),
+            "device_s": round(device_s_total, 6),
         }
+        # one span per decode call carrying the split (never per token —
+        # the export ring is bounded); joins the ambient trace when the
+        # call rides a served request
+        span = Span("runner.decode", trace_id=current_trace_id(),
+                    start_s=t_loop0,
+                    attributes={"runner": self.name, "steps": steps,
+                                "dispatch_s": round(dispatch_s_total, 6),
+                                "device_s": round(device_s_total, 6),
+                                "device_time_every": dte})
+        span.finish(time.perf_counter())
+        export_span(span, self.registry)
         if denied_at:
             extras["denied_rows"] = sorted(denied_at)
             extras["denied_at"] = {int(b): int(c)
@@ -1128,15 +1192,22 @@ class StreamHandle:
 
     __slots__ = ("prompt", "length", "max_new_tokens", "deadline_s",
                  "on_done", "slot", "tokens", "status", "done",
-                 "t_submit_s", "t_first_s", "pages")
+                 "t_submit_s", "t_first_s", "pages", "trace_id")
 
     def __init__(self, prompt: np.ndarray, length: int, max_new_tokens: int,
-                 deadline_s: Optional[float], on_done: Optional[Callable]):
+                 deadline_s: Optional[float], on_done: Optional[Callable],
+                 trace_id: Optional[str] = None):
         self.prompt = prompt
         self.length = int(length)
         self.max_new_tokens = int(max_new_tokens)
         self.deadline_s = deadline_s
         self.on_done = on_done
+        # the request's trace id (ISSUE 15 satellite): the TTFT histogram
+        # observation carries it as an exemplar, so a p99 TTFT outlier on
+        # /metrics resolves to the exact request via /trace/<id> even
+        # though the observation books on the ENGINE thread, which has no
+        # ambient span
+        self.trace_id = trace_id
         self.slot = -1
         self.tokens: List[int] = []
         self.status = "queued"
@@ -1303,6 +1374,11 @@ class ContinuousDecoder:
             "submit-to-first-token latency of continuous decode",
             labels=("runner",)).labels(runner=name)
         self._book_occupancy()
+        # flight-recorder roster (ISSUE 15): the postmortem dump reads the
+        # live slot table + pool occupancy from here — WeakSet-held, so a
+        # closed and discarded stream drops out on its own
+        from ..observability.flightrecorder import _roster
+        _roster(reg, "_decode_streams").add(self)
 
     # -------------------------------------------------------------- admission
     @property
@@ -1342,7 +1418,8 @@ class ContinuousDecoder:
 
     def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
                deadline_s: Optional[float] = None,
-               on_done: Optional[Callable] = None) -> StreamHandle:
+               on_done: Optional[Callable] = None,
+               trace_id: Optional[str] = None) -> StreamHandle:
         """Admit one request: reserve a free slot and allocate its prompt
         pages NOW (the admission decision), splice into the batch at the
         next step boundary.  Raises :class:`SlotsExhausted` /
@@ -1375,7 +1452,7 @@ class ContinuousDecoder:
             pages = self.pool.allocate(n_pages)
             slot = self._free.pop()
             handle = StreamHandle(prompt, length, budget, deadline_s,
-                                  on_done)
+                                  on_done, trace_id=trace_id)
             handle.slot = slot
             handle.pages = list(pages)
             handle.t_submit_s = self.clock()
@@ -1437,11 +1514,19 @@ class ContinuousDecoder:
         """One engine round: splice queued arrivals (join prefill), advance
         every live slot one fused step, release finished slots (leave).
         ONE driver only — the :meth:`start` thread or a single test/bench
-        loop.  Returns the number of live slots remaining."""
+        loop.  Returns the number of live slots remaining.
+
+        The round runs under the ``runner.decode.step`` ambient phase
+        (ISSUE 15): host-stack samples from ``/debug/profile`` attribute
+        the engine thread's time to the decode step loop by name — a span
+        per round would flood the export ring at token cadence, the phase
+        table costs two dict writes."""
+        from ..observability.tracing import _enter_phase, _exit_phase
         with self._cond:
             joiners = list(self._arrivals)
             self._arrivals.clear()
         leavers: List[StreamHandle] = []
+        _phase = _enter_phase("runner.decode.step")
         try:
             if joiners:
                 self._join(joiners, leavers)
@@ -1453,6 +1538,8 @@ class ContinuousDecoder:
             # borrower rebuilds zeros instead of consuming a dead buffer
             self._poisoned = True
             raise
+        finally:
+            _exit_phase(_phase)
         self._finish(leavers)
         if self._live == 0:
             self._return_cache_if_idle()
@@ -1507,7 +1594,10 @@ class ContinuousDecoder:
             now = self.clock()
             h.status = "live"
             h.t_first_s = now
-            self._h_ttft.observe(max(0.0, now - h.t_submit_s))
+            # exemplar: the engine thread has no ambient span, so the
+            # request's trace id rides the handle (ISSUE 15 satellite —
+            # a TTFT outlier must resolve to its trace)
+            self._h_ttft.observe(max(0.0, now - h.t_submit_s), h.trace_id)
             self._c_joined.inc()
             self.joined += 1
             self._live += 1
@@ -1565,14 +1655,24 @@ class ContinuousDecoder:
             else jnp.asarray(self._tok)
         fin_in = self._fin_dev if self._fin_dev is not None \
             else jnp.asarray(self._fin)
+        t_disp0 = time.perf_counter()
         tok_d, fin_d, self._cache = self._step(
             runner.variables, tok_in, jnp.asarray(pos),
             self._table_dev, fin_in, self._cache)
+        # dispatch/device split (ISSUE 15): the step call above is the
+        # host enqueue; the token fetch below IS the device wait — already
+        # a sync, so sampling it costs nothing extra
+        disp_s = time.perf_counter() - t_disp0
+        runner._h_phase_dispatch.observe(disp_s)
         # fin_in was donated (consumed) by the dispatch: rebind both device
         # copies to the step's outputs; a release below invalidates them
         self._tok_dev, self._fin_dev = tok_d, fin_d
+        t_dev0 = time.perf_counter()
         tok, fin = np.asarray(tok_d), np.asarray(fin_d)
         self.steps += 1
+        dte = runner.device_time_every
+        if dte and self.steps % dte == 0:
+            runner._h_phase_device.observe(time.perf_counter() - t_dev0)
         runner._c_decode_steps.inc()
         for s, h in enumerate(self._handles):
             if h is None:
@@ -1612,6 +1712,43 @@ class ContinuousDecoder:
             self._free.append(s)
             self._book_occupancy()
             self._cond.notify_all()
+
+    # ------------------------------------------------------------- postmortem
+    def debug_state(self) -> Dict[str, Any]:
+        """JSON-able engine state for the flight recorder (ISSUE 15): the
+        slot table, per-slot progress, and pool occupancy — the state that
+        otherwise dies with a crashed/preempted worker.  Read under the
+        admission lock so a dump mid-join sees a consistent table."""
+        with self._cond:
+            slots = []
+            for s in range(self.slots):
+                h = self._handles[s]
+                slots.append({
+                    "slot": s,
+                    "live": h is not None,
+                    "status": None if h is None else h.status,
+                    "length": int(self._lens[s]),
+                    "emitted": int(self._emitted[s]),
+                    "finished": bool(self._fin[s]),
+                    "pages": list(map(int, self._table[s]))})
+            state = {
+                "runner": self._name,
+                "slots": self.slots,
+                "occupancy": self.slots - len(self._free),
+                "live": self._live,
+                "queued_arrivals": len(self._arrivals),
+                "steps": self.steps,
+                "joined": self.joined,
+                "left": self.left,
+                "closed": self._closed,
+                "slot_table": slots,
+            }
+        state["pool"] = {
+            "page_size": self.pool.page_size,
+            "capacity": self.pool.capacity,
+            "pages_in_use": self.pool.pages_in_use(),
+            "occupancy_pct": round(self.pool.occupancy_pct(), 2)}
+        return state
 
     # -------------------------------------------------------------- lifecycle
     def start(self) -> "ContinuousDecoder":
@@ -1771,7 +1908,7 @@ class _RunnerScorer(Transformer):
         return body
 
     def _continuous_submit(self, payload, resolve, queue_age_s=0.0,
-                           deadline_budget_s=None) -> None:
+                           deadline_budget_s=None, trace_id=None) -> None:
         """The serving seam (ISSUE 13): admit ONE request into the
         in-flight batch.  ``resolve(reply=, status=, verdict=,
         retry_after_s=, ttft_s=)`` fires on the engine thread at the
@@ -1784,7 +1921,10 @@ class _RunnerScorer(Transformer):
         never absolute timestamps, so a server on an injectable clock and
         a decoder on ``time.monotonic`` can never be compared against each
         other.  Reported TTFT = queue age + the engine's
-        submit-to-first-token."""
+        submit-to-first-token.  ``trace_id`` (ISSUE 15) threads the
+        request's trace through to the engine so the TTFT histogram's
+        exemplar names it — the resolve path runs on the engine thread,
+        where no ambient span exists to supply one."""
         decoder = self._ensure_decoder()
         prompt = np.asarray(payload, np.int32).reshape(-1)
         deadline_s = None if deadline_budget_s is None \
@@ -1808,7 +1948,8 @@ class _RunnerScorer(Transformer):
                 resolve(reply={"error": f"decode {h.status}"},
                         status=500, verdict="error")
 
-        decoder.submit(prompt, deadline_s=deadline_s, on_done=on_done)
+        decoder.submit(prompt, deadline_s=deadline_s, on_done=on_done,
+                       trace_id=trace_id)
 
     # ------------------------------------------------------------- batch path
     def _decode_batch(self, col, n: int, out: np.ndarray, age) -> None:

@@ -33,9 +33,10 @@ breakers), ``lightgbm/core.train`` (per-iteration phase timings),
 """
 from .metrics import (Counter, DEFAULT_LATENCY_BUCKETS, Gauge, Histogram,
                       MetricsRegistry, get_registry, set_registry)
-from .tracing import (Span, TRACE_HEADER, TRACEPARENT_HEADER, current_span,
-                      current_trace_id, format_traceparent, new_trace_id,
-                      parse_traceparent, trace_span)
+from .tracing import (Span, TRACE_HEADER, TRACEPARENT_HEADER, ambient_phase,
+                      current_span, current_trace_id, format_traceparent,
+                      new_trace_id, parse_traceparent, thread_phases,
+                      trace_span)
 from .instruments import (BREAKER_STATE_CODES, instrument_breaker,
                           instrument_collector)
 from .collector import OTLP_ENDPOINT_ENV, SpanCollector, get_collector
@@ -45,11 +46,16 @@ from .autoscale import AutoscaleAdvisor
 from .compute import (InstrumentedJit, compile_report, device_put,
                       ensure_build_info, ensure_device_memory_gauges,
                       instrumented_jit, transfer_nbytes)
+from .profiling import (SamplingProfiler, ProfilerBusy, profile_window,
+                        profiler_instruments)
+from .flightrecorder import (FlightRecorder, flightrecorder_instruments,
+                             get_flight_recorder)
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "DEFAULT_LATENCY_BUCKETS", "get_registry", "set_registry",
            "Span", "TRACE_HEADER", "TRACEPARENT_HEADER", "current_span",
            "current_trace_id", "new_trace_id", "trace_span",
+           "ambient_phase", "thread_phases",
            "parse_traceparent", "format_traceparent", "BREAKER_STATE_CODES",
            "instrument_breaker", "instrument_collector",
            "OTLP_ENDPOINT_ENV", "SpanCollector", "get_collector",
@@ -57,4 +63,7 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "device_put", "transfer_nbytes", "ensure_build_info",
            "ensure_device_memory_gauges",
            "FleetView", "MetricsFederator", "parse_prometheus",
-           "SLO", "SLOEngine", "parse_slo", "AutoscaleAdvisor"]
+           "SLO", "SLOEngine", "parse_slo", "AutoscaleAdvisor",
+           "SamplingProfiler", "ProfilerBusy", "profile_window",
+           "profiler_instruments", "FlightRecorder",
+           "flightrecorder_instruments", "get_flight_recorder"]

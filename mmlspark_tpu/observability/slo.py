@@ -362,6 +362,19 @@ class SLOEngine:
         for payload in transitions:
             from ..core.logging import log_event
             log_event(payload)
+            if payload["event"] == "slo_burn":
+                # flight-recorder dump on the burning EDGE (ISSUE 15):
+                # edge-triggered like the ring event, so a sustained burn
+                # costs one dump, not one per evaluate pass.  Only an
+                # ALREADY-constructed recorder dumps — the engine must not
+                # grow process-global crash hooks as a side effect of an
+                # SLO evaluation
+                rec = getattr(self.registry, "_flight_recorder", None)
+                if rec is not None:
+                    try:
+                        rec.dump(trigger="slo_burn")
+                    except Exception:  # noqa: BLE001 — the page still fires
+                        pass
         result = {"evaluated_at": now,
                   "alert_burn_rate": self.alert_burn_rate,
                   "slos": verdicts}

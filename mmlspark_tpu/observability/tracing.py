@@ -28,6 +28,7 @@ import contextlib
 import itertools
 import math
 import os
+import threading
 import time
 from contextvars import ContextVar
 from typing import Any, Dict, Optional
@@ -37,7 +38,8 @@ from .metrics import MetricsRegistry, get_registry
 
 __all__ = ["Span", "TRACE_HEADER", "TRACEPARENT_HEADER", "current_span",
            "current_trace_id", "new_trace_id", "trace_span", "export_span",
-           "parse_traceparent", "format_traceparent"]
+           "parse_traceparent", "format_traceparent", "ambient_phase",
+           "thread_phases"]
 
 #: wire header carrying the trace id across HTTP hops
 TRACE_HEADER = "X-MMLSpark-Trace-Id"
@@ -165,6 +167,49 @@ class Span:
 _current_span: ContextVar[Optional[Span]] = \
     ContextVar("mmlspark_tpu_span", default=None)
 
+#: thread ident -> innermost ambient span/phase NAME.  Contextvars cannot
+#: be read across threads, so the sampling profiler
+#: (``observability/profiling.py``) attributes each sampled thread through
+#: this side table instead: ``trace_span`` and ``ambient_phase`` both
+#: maintain it (two dict writes per scope — GIL-atomic, no lock; each
+#: thread only ever writes its own key).
+_THREAD_PHASE: Dict[int, str] = {}
+
+
+def thread_phases() -> Dict[int, str]:
+    """Snapshot of {thread ident: innermost ambient span/phase name} — the
+    profiler's attribution table.  Threads outside any ``trace_span`` /
+    ``ambient_phase`` scope are absent (attributed ``unattributed``)."""
+    return dict(_THREAD_PHASE)
+
+
+def _enter_phase(name: str) -> tuple:
+    tid = threading.get_ident()
+    prev = _THREAD_PHASE.get(tid)
+    _THREAD_PHASE[tid] = name
+    return tid, prev
+
+
+def _exit_phase(token: tuple) -> None:
+    tid, prev = token
+    if prev is None:
+        _THREAD_PHASE.pop(tid, None)
+    else:
+        _THREAD_PHASE[tid] = prev
+
+
+@contextlib.contextmanager
+def ambient_phase(name: str):
+    """Mark this thread's work as ``name`` for profiler attribution WITHOUT
+    opening a Span — the hot-loop variant (e.g. the continuous decode
+    engine's step loop, where a span per token would flood the export
+    ring).  Nests: inner scopes shadow outer ones, restored on exit."""
+    token = _enter_phase(name)
+    try:
+        yield
+    finally:
+        _exit_phase(token)
+
 
 def current_span() -> Optional[Span]:
     """The innermost active span in this context, or None."""
@@ -230,6 +275,7 @@ def trace_span(name: str, trace_id: Optional[str] = None,
             span.set_attribute("deadline_remaining_ms",
                                int(remaining * 1000))
     token = _current_span.set(span)
+    phase_token = _enter_phase(name)  # profiler attribution (side table)
     try:
         if deadline_s is not None:
             with deadline_scope(deadline_s, clock):
@@ -240,5 +286,6 @@ def trace_span(name: str, trace_id: Optional[str] = None,
         span.status = f"error:{type(e).__name__}"
         raise
     finally:
+        _exit_phase(phase_token)
         _current_span.reset(token)
         export_span(span, registry)

@@ -26,7 +26,7 @@ import time
 import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Dict, Iterable, List, Optional
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from .server import PipelineServer
 from ..observability import get_registry, instrument_breaker
@@ -74,7 +74,7 @@ def _http_json(url: str, payload: Optional[dict] = None, timeout: float = 10.0,
 TOPOLOGY_ENDPOINTS = {
     "GET": ("/routing", "/flag/<key>", "/stats", "/fleet/slow",
             "/fleet/metrics", "/fleet/slo", "/fleet/autoscale",
-            "/fleet/membership", "/health"),
+            "/fleet/membership", "/fleet/dump", "/health"),
     "POST": ("/register", "/deregister", "/flag"),
 }
 
@@ -216,6 +216,17 @@ class TopologyService:
         self._m_membership_changes = self.registry.counter(
             "mmlspark_fleet_membership_changes_total",
             "membership transitions by kind", labels=("change",))
+        # postmortem plane (ISSUE 15): recorder families on the driver's
+        # registry (fleet_dump books per-worker outcomes into them), the
+        # driver's own recorder with crash/preemption hooks, and roster
+        # enrolment so any recorder dumping THIS registry captures the
+        # membership epoch
+        from ..observability.flightrecorder import (_roster,
+                                                    flightrecorder_instruments,
+                                                    get_flight_recorder)
+        self._m_fr = flightrecorder_instruments(self.registry)
+        get_flight_recorder(self.registry)
+        _roster(self.registry, "_topology_services").add(self)
         self._lock = threading.Lock()
         self._workers: Dict[str, Dict] = {}
         self._fail_counts: Dict[str, int] = {}
@@ -367,6 +378,15 @@ class TopologyService:
                     self._json(200, {"classes": recs,
                                      "workers": view.to_dict()["workers"],
                                      "evaluated_at": view.scraped_at})
+                elif path == "/fleet/dump":
+                    params, err = _parse_query(query,
+                                               {"deadline_ms": _pos_float})
+                    if err is not None:
+                        self._json(400, {"error": err})
+                        return
+                    dl = params.get("deadline_ms")
+                    self._json(200, svc.fleet_dump(
+                        deadline_s=dl / 1000.0 if dl is not None else None))
                 elif path == "/fleet/membership":
                     self._json(200, svc.membership())
                 elif path == "/health":
@@ -626,20 +646,18 @@ class TopologyService:
         for _sid, breaker in dead:
             uninstrument_breaker(breaker, self.registry)
 
-    def fleet_slow(self, k: Optional[int] = None,
-                   deadline_s: Optional[float] = None) -> Dict:
-        """Fleet-wide slowest requests (``GET /fleet/slow?k=N``, PR 4
-        follow-up): fan out to every live worker's ``/debug/slow`` under one
-        overall deadline, merge to a global top-K with worker attribution.
+    def _fanout_debug(self, path: str,
+                      deadline: Deadline) -> Tuple[Dict, Dict]:
+        """Concurrent deadline-bounded GET of ``path`` against every live
+        worker with the per-worker breaker discipline (ISSUE 15 factored
+        this out of :meth:`fleet_slow` so ``/fleet/dump`` shares it
+        verbatim).  Returns ``(per_worker, payloads)``: a verdict row per
+        worker (``{"ok": True}`` / ``{"skipped": ...}`` / ``{"error":
+        ...}``) and the successful workers' JSON payloads.
 
-        Per-worker circuit breakers isolate dead workers: a worker that
-        keeps failing costs one probe per cooldown instead of a timeout per
-        query, and partial results are always served — one dead worker must
-        never blind the fleet view.  Skipped/failed workers are reported in
-        ``workers`` so a partial merge is visibly partial."""
-        k = self.fleet_slow_k if k is None else max(0, int(k))
-        deadline = Deadline.after(deadline_s if deadline_s is not None
-                                  else self.fleet_slow_deadline_s)
+        Rules carried over: an open breaker costs one skip, not a timeout;
+        a client-side deadline expiry mid-exchange is NEVER fed to the
+        breaker (PR 2 rule); partial results always serve."""
         with self._lock:
             workers = list(self._workers.items())
         self._prune_fleet_breakers({sid for sid, _ in workers})
@@ -650,7 +668,7 @@ class TopologyService:
         def fetch(sid: str, w: Dict, breaker: CircuitBreaker) -> None:
             try:
                 got = _http_json(
-                    f"http://{w['host']}:{w['port']}/debug/slow?k={k}",
+                    f"http://{w['host']}:{w['port']}{path}",
                     timeout=self.probe_timeout_s, deadline=deadline)
             except Exception as e:  # noqa: BLE001 — a dead worker is a row
                 if deadline.expired():
@@ -660,18 +678,15 @@ class TopologyService:
                     # never trip a healthy worker's breaker)
                     with results_lock:
                         results[sid] = (
-                            {"skipped": "deadline_exhausted"}, [])
+                            {"skipped": "deadline_exhausted"}, None)
                     return
                 breaker.record_failure()
                 with results_lock:
-                    results[sid] = ({"error": str(e)}, [])
+                    results[sid] = ({"error": str(e)}, None)
                 return
             breaker.record_success()
-            rows = got.get("slowest", []) if isinstance(got, dict) else []
-            for row in rows:
-                row["worker"] = sid
             with results_lock:
-                results[sid] = ({"count": len(rows)}, rows)
+                results[sid] = ({"ok": True}, got)
 
         # genuinely concurrent fan-out: one slow worker costs the query its
         # OWN latency, never every later worker's slice of the budget (the
@@ -686,14 +701,14 @@ class TopologyService:
                 per_worker[sid] = {"skipped": "deadline_exhausted"}
                 continue
             t = threading.Thread(target=fetch, args=(sid, w, breaker),
-                                 daemon=True, name=f"fleet-slow-{sid}")
+                                 daemon=True, name=f"fleet-debug-{sid}")
             t.start()
             threads.append((sid, t))
         for sid, t in threads:
             t.join(timeout=max(0.0, deadline.remaining()))
         with results_lock:
             done = dict(results)
-        merged: List[Dict] = []
+        payloads: Dict[str, Dict] = {}
         for sid, _t in threads:
             outcome = done.get(sid)
             if outcome is None:
@@ -701,11 +716,56 @@ class TopologyService:
                 # finish the breaker bookkeeping in the background
                 per_worker[sid] = {"skipped": "deadline_exhausted"}
                 continue
-            verdict, rows = outcome
+            verdict, payload = outcome
             per_worker[sid] = verdict
+            if payload is not None:
+                payloads[sid] = payload
+        return per_worker, payloads
+
+    def fleet_slow(self, k: Optional[int] = None,
+                   deadline_s: Optional[float] = None) -> Dict:
+        """Fleet-wide slowest requests (``GET /fleet/slow?k=N``, PR 4
+        follow-up): fan out to every live worker's ``/debug/slow`` under one
+        overall deadline, merge to a global top-K with worker attribution.
+
+        Per-worker circuit breakers isolate dead workers: a worker that
+        keeps failing costs one probe per cooldown instead of a timeout per
+        query, and partial results are always served — one dead worker must
+        never blind the fleet view.  Skipped/failed workers are reported in
+        ``workers`` so a partial merge is visibly partial."""
+        k = self.fleet_slow_k if k is None else max(0, int(k))
+        deadline = Deadline.after(deadline_s if deadline_s is not None
+                                  else self.fleet_slow_deadline_s)
+        per_worker, payloads = self._fanout_debug(f"/debug/slow?k={k}",
+                                                  deadline)
+        merged: List[Dict] = []
+        for sid, got in payloads.items():
+            rows = got.get("slowest", []) if isinstance(got, dict) else []
+            for row in rows:
+                row["worker"] = sid
+            per_worker[sid] = {"count": len(rows)}
             merged.extend(rows)
         merged.sort(key=lambda r: r.get("durationS", 0.0), reverse=True)
         return {"k": k, "workers": per_worker, "slowest": merged[:k]}
+
+    def fleet_dump(self, deadline_s: Optional[float] = None) -> Dict:
+        """Fleet-wide flight-recorder snapshots (``GET /fleet/dump``,
+        ISSUE 15): fan out to every live worker's ``/debug/dump`` under
+        one overall deadline with the :meth:`fleet_slow` breaker
+        discipline, and serve PARTIAL results — a dead worker is exactly
+        when an operator pulls the fleet's black boxes, so one dead worker
+        blinding the endpoint would defeat it.  Per-worker outcomes book
+        ``mmlspark_flightrecorder_dumps_total{trigger="fleet"}`` on the
+        driver's registry."""
+        deadline = Deadline.after(deadline_s if deadline_s is not None
+                                  else self.fleet_slow_deadline_s)
+        per_worker, payloads = self._fanout_debug("/debug/dump", deadline)
+        dumps_c = self._m_fr["dumps"]
+        for sid, verdict in per_worker.items():
+            result = "ok" if sid in payloads else (
+                "skipped" if "skipped" in verdict else "error")
+            dumps_c.inc(trigger="fleet", result=result)
+        return {"workers": per_worker, "dumps": payloads}
 
 
 class WorkerServer:

@@ -157,6 +157,21 @@ class PipelineServer:
         # decoding.  Duck-typed so serving never imports the models
         # package (a pure-python pipeline must not pay a jax import).
         self._continuous_submit = getattr(model, "continuous_submit", None)
+        # `trace_id=` (ISSUE 15: the TTFT exemplar rides it to the engine
+        # thread) is forwarded only to fronts that declare it — the PR 13
+        # protocol is duck-typed, and an existing front must not start
+        # throwing TypeError because the server learned a new kwarg
+        self._submit_takes_trace = False
+        if self._continuous_submit is not None:
+            try:
+                import inspect as _inspect
+                params = _inspect.signature(
+                    self._continuous_submit).parameters
+                self._submit_takes_trace = "trace_id" in params or any(
+                    p.kind is _inspect.Parameter.VAR_KEYWORD
+                    for p in params.values())
+            except (TypeError, ValueError):
+                pass
         self.input_col, self.reply_col = input_col, reply_col
         self.host, self.port, self.api_path = host, port, api_path
         self.mode = mode
@@ -218,6 +233,15 @@ class PipelineServer:
             "mmlspark_serving_queue_delay_ewma_seconds",
             "EWMA of per-entry queue delay (adaptive shed signal)",
             labels=("server",))
+        # profiling + postmortem plane (ISSUE 15): families registered at
+        # construction (coverage-gated), and the per-registry flight
+        # recorder created with its crash/preemption hooks installed so
+        # every serving process records — /debug/profile and /debug/dump
+        # serve from these
+        from ..observability.flightrecorder import get_flight_recorder
+        from ..observability.profiling import profiler_instruments
+        profiler_instruments(reg)
+        self._recorder = get_flight_recorder(reg)
         # pre-start sinks: port=0 is unresolved, and registering children
         # under "host:0" would leave a ghost zero series in the (usually
         # shared) registry for every constructed-but-restarted server.
@@ -336,6 +360,50 @@ class PipelineServer:
                         server=server._server_label)
                     self._respond(200, {"server": server._server_label,
                                         "slowest": slow})
+                elif self.path.split("?", 1)[0] == "/debug/profile":
+                    # on-demand host-stack sampling window (ISSUE 15):
+                    # blocks THIS handler thread for the window (other
+                    # requests keep flowing — threaded server), attributes
+                    # samples to ambient span names, 409 when a window is
+                    # already running
+                    from ..observability.profiling import (ProfilerBusy,
+                                                           profile_window)
+                    seconds, hz, idle = 2.0, None, False
+                    query = self.path.partition("?")[2]
+                    try:
+                        for part in query.split("&"):
+                            if part.startswith("seconds="):
+                                seconds = float(part[len("seconds="):])
+                            elif part.startswith("hz="):
+                                hz = float(part[len("hz="):])
+                            elif part.startswith("idle="):
+                                idle = bool(int(part[len("idle="):]))
+                    except ValueError:
+                        self._respond(400, {"error": "seconds/hz/idle must "
+                                                     "be numeric"})
+                        return
+                    try:
+                        kw = {} if hz is None else {"hz": hz}
+                        report = profile_window(seconds=seconds,
+                                                registry=server.registry,
+                                                include_idle=idle,
+                                                **kw)
+                    except ProfilerBusy as e:
+                        self._write_raw(409, json.dumps(
+                            {"error": str(e)}).encode())
+                        return
+                    self._respond(200, report)
+                elif self.path == "/debug/dump":
+                    # on-demand flight-recorder snapshot: books the dump
+                    # (and writes the file when a dump dir is configured),
+                    # then serves the snapshot itself
+                    from ..observability.flightrecorder import \
+                        get_flight_recorder
+                    rec = get_flight_recorder(server.registry)
+                    path = rec.dump(trigger="http")
+                    snap = dict(rec.last_snapshot or {})
+                    snap["dump_path"] = path
+                    self._respond(200, snap)
                 else:
                     self._respond(404, {"error": "not found"})
 
@@ -470,7 +538,8 @@ class PipelineServer:
                     raise
 
             _STATUS = {200: b"200 OK", 400: b"400 Bad Request",
-                       404: b"404 Not Found", 500: b"500 Internal Server Error",
+                       404: b"404 Not Found", 409: b"409 Conflict",
+                       500: b"500 Internal Server Error",
                        503: b"503 Service Unavailable",
                        504: b"504 Gateway Timeout"}
 
@@ -780,10 +849,11 @@ class PipelineServer:
             e.done.set()
 
         try:
+            kw = {"trace_id": e.trace_id} if self._submit_takes_trace else {}
             self._continuous_submit(
                 e.payload, resolve=resolve,
                 queue_age_s=max(0.0, t_submit - e.t_enq),
-                deadline_budget_s=max(0.0, e.t_deadline - t_submit))
+                deadline_budget_s=max(0.0, e.t_deadline - t_submit), **kw)
             return True
         except Exception as ex:  # noqa: BLE001 — admission failure shapes
             if getattr(ex, "shed", False):

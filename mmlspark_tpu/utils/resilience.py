@@ -457,6 +457,40 @@ class PreemptionToken:
 _TOKEN_STACK: list = []
 _TOKEN_LOCK = threading.Lock()
 
+#: observers fired once per preemption event (signal landing in a scope,
+#: or a programmatic request that reached at least one token) — the
+#: flight recorder (ISSUE 15) registers here so a preempted process dumps
+#: its black box BEFORE the final checkpoint-and-exit.  Guarded by
+#: _TOKEN_LOCK for registration; fired from a snapshot outside it.
+_PREEMPTION_HOOKS: list = []
+
+
+def register_preemption_hook(fn) -> None:
+    """Register ``fn(reason)`` to run on every preemption event.  A
+    raising hook is swallowed — observers must never break the shutdown
+    path they observe.  Idempotent per callable."""
+    with _TOKEN_LOCK:
+        if fn not in _PREEMPTION_HOOKS:
+            _PREEMPTION_HOOKS.append(fn)
+
+
+def unregister_preemption_hook(fn) -> None:
+    with _TOKEN_LOCK:
+        try:
+            _PREEMPTION_HOOKS.remove(fn)
+        except ValueError:
+            pass
+
+
+def _fire_preemption_hooks(reason: str) -> None:
+    with _TOKEN_LOCK:
+        hooks = list(_PREEMPTION_HOOKS)
+    for fn in hooks:
+        try:
+            fn(reason)
+        except Exception:  # noqa: BLE001 — see register_preemption_hook
+            pass
+
 
 def request_preemption(reason: str = "requested") -> int:
     """Fire every active :class:`preemption_scope` token programmatically
@@ -472,6 +506,9 @@ def request_preemption(reason: str = "requested") -> int:
     if tokens:
         from ..core.logging import log_event
         log_event({"event": "preemption_requested", "reason": str(reason)})
+        # observers (flight recorder) AFTER the ring event so the dump's
+        # ring tail includes the preemption it is recording
+        _fire_preemption_hooks(str(reason))
     return len(tokens)
 
 
@@ -526,6 +563,11 @@ def preemption_scope(signals: Tuple[int, ...] = None, watcher=None):
                 from ..core.logging import log_event
                 log_event({"event": "preemption_requested",
                            "signal": int(sn)})
+                # flight-recorder dump while the process is still whole:
+                # the handler runs on the main thread at a bytecode
+                # boundary, so file I/O here is ordinary code, and hooks
+                # swallow their own failures
+                _fire_preemption_hooks(f"signal:{int(sn)}")
             previous[signum] = _signal.signal(signum, _handler)
         token.armed = True
     except ValueError:

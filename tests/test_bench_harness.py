@@ -313,6 +313,36 @@ def test_runner_cont_marker_folds_with_gate_parity_and_compile_checks():
         bench.RESULT["extras"].clear()
 
 
+def test_serving_profiler_marker_folds_with_gate():
+    """ISSUE 15: the echo-serving profiler overhead A/B rides the serving
+    child — its SERVING_PROFILER marker must fold into extras, a >3%
+    overhead must leave a phase note (the gate), and a within-gate run
+    must not."""
+    proc = _child("print('SERVING_PROFILER 1.441 1.462 1.5')\n")
+    got = bench._collect_multi(proc, ("SERVING_PROFILER",), idle=10, hard=20)
+    bench.RESULT["extras"].clear()
+    try:
+        assert bench._record_serving_profiler(got)
+        ex = bench.RESULT["extras"]
+        assert ex["serving_echo_p50_ms"] == 1.441
+        assert ex["serving_echo_profiled_p50_ms"] == 1.462
+        assert ex["profiler_overhead_pct"] == 1.5
+        assert "serving" not in ex.get("phase_notes", {})
+        assert not bench._record_serving_profiler({})  # absent -> False
+    finally:
+        bench.RESULT["extras"].clear()
+    # over-gate run: the number still folds, the note names the miss
+    bench.RESULT["extras"].clear()
+    try:
+        assert bench._record_serving_profiler(
+            {"SERVING_PROFILER": [1.441, 1.513, 5.0]})
+        ex = bench.RESULT["extras"]
+        assert ex["profiler_overhead_pct"] == 5.0
+        assert "3% echo-microbench gate" in ex["phase_notes"]["serving"]
+    finally:
+        bench.RESULT["extras"].clear()
+
+
 def test_phase_metrics_snapshot_folds_into_extras():
     """ISSUE 11: each phase child prints a bounded PHASE_METRICS registry
     snapshot; the parent folds it under extras.phase_metrics so bench

@@ -22,6 +22,7 @@ The acceptance contracts this file pins:
 """
 import http.client
 import json
+import os
 import threading
 import time
 
@@ -670,3 +671,168 @@ def test_mixed_load_ttft_gate_continuous_passes_where_ticked_fails():
     assert not ticked["decode"]["gates"]["passed"], ticked["decode"]
     failed = ticked["decode"]["gates"]["checks"]["ttft_p99_ms"]
     assert not failed["ok"] and failed["actual"] > 200.0
+
+
+# ---------------------------------------------------------------------------
+# profiling + postmortem plane over the decode hot loop (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+def test_debug_profile_dump_and_compile_over_live_decode_stream(tmp_path):
+    """The ISSUE 15 worked flow, end to end over real sockets: with a
+    long generation holding the in-flight batch, (a) ``/debug/profile``
+    attributes >= half its busy samples to the decode-step phase — the
+    number that decomposes "dispatch-bound"; (b) ``/debug/compile`` shows
+    the stream executables under the runner's wrapper names (a
+    join-minted compile is visible fleet-wide, not just counter-checked);
+    (c) "killing" the worker mid-stream (the preemption trigger a SIGTERM
+    drill fires) leaves an atomic JSON-parseable dump with the live slot
+    table, the ring tail, and the compile report; and (d) the request's
+    ``serving.request`` span still lands in ``/debug/slow`` with its
+    verdict, and the TTFT histogram's exemplar names the request's trace
+    id even though the engine thread booked the observation (the PR 13
+    engine-thread resolve seam)."""
+    from mmlspark_tpu.models import ModelRunner
+    from mmlspark_tpu.observability import MetricsRegistry
+    from mmlspark_tpu.serving import PipelineServer
+    from mmlspark_tpu.utils.resilience import (preemption_scope,
+                                               request_preemption)
+
+    reg = MetricsRegistry()
+    # a LONG positional table: ~900 steps at a few ms each keeps the
+    # stream alive through the profile window + the mid-stream drill
+    # (prompt_bucket + max_new_tokens must fit max_len)
+    mod, variables = _tiny_lm(layers=1, max_len=1024)
+    runner = ModelRunner(module=mod, variables=variables, name="srv.prof",
+                         registry=reg)
+    scorer = runner.scorer(mode="decode", continuous=True, report_ttft=True,
+                           slots=1, prompt_bucket=8, max_new_tokens=900,
+                           page_size=8, encode=lambda t: [int(x) for x in t])
+    srv = PipelineServer(scorer, port=0, mode="continuous",
+                         registry=reg).start()
+    first = {}
+    done = threading.Event()
+    try:
+        def long_request():
+            first["res"] = post_json(srv.port, srv.api_path, [5, 7, 11],
+                                     timeout=120, return_headers=True)
+            done.set()
+
+        t = threading.Thread(target=long_request, daemon=True)
+        t.start()
+        # wait for real STEPS, not just occupancy: the slot is reserved at
+        # submit, but a cold .xla_cache pays the prefill/step compiles
+        # inside the first engine rounds — the drill below needs the
+        # steady-state step loop (and its booked compile) underway
+        deadline = time.monotonic() + 150
+        while scorer._decoder is None or scorer._decoder.steps < 2:
+            if time.monotonic() > deadline:
+                raise AssertionError("the stream never started stepping")
+            if done.is_set():
+                raise AssertionError(f"request failed early: {first}")
+            time.sleep(0.01)
+
+        # (a) dispatch-heavy stream: >= half the busy samples attribute to
+        # the decode step loop by name
+        status, rep = post_json(
+            srv.port, "/debug/profile?seconds=0.5&hz=150", None,
+            method_get=True)
+        assert status == 200
+        rep = json.loads(rep)
+        assert rep["samples"] > 0
+        assert rep["by_span"].get("runner.decode.step", 0) >= \
+            rep["samples"] / 2, rep["by_span"]
+
+        # (b) the stream executables are visible on the compile plane
+        status, comp = post_json(srv.port, "/debug/compile", None,
+                                 method_get=True)
+        fns = json.loads(comp)["functions"]
+        for name in ("runner.srv.prof.prefill_paged",
+                     "runner.srv.prof.decode_step_paged",
+                     "runner.srv.prof.decode_sample"):
+            assert name in fns and fns[name]["compiles"] >= 1, \
+                f"{name} missing from /debug/compile"
+
+        # (c) kill the worker mid-stream: the preemption trigger fires the
+        # recorder and the dump is the debuggable artifact
+        assert not done.is_set(), "generation finished before the drill"
+        rec = reg._flight_recorder
+        rec.dump_dir = str(tmp_path)
+        with preemption_scope():
+            assert request_preemption("chaos-kill") == 1
+        names = os.listdir(tmp_path)
+        assert len(names) == 1 and "preemption" in names[0]
+        dump = json.load(open(tmp_path / names[0]))
+        slot_rows = dump["decode_streams"][0]["slot_table"]
+        assert any(row["live"] for row in slot_rows), \
+            "dump lost the live slot table"
+        assert dump["decode_streams"][0]["pool"]["pages_in_use"] > 0
+        assert any(e.get("event") == "preemption_requested"
+                   for e in dump["ring_events"]), "dump lost the ring tail"
+        assert "runner.srv.prof.decode_step_paged" in \
+            dump["compile"]["functions"], "dump lost the compile report"
+
+        # (d) the engine-thread resolve still lands the serving.request
+        # span + TTFT exemplar (satellite: the PR 13 attribution seam)
+        assert done.wait(120) and first["res"][0] == 200
+        trace_id = first["res"][2]["X-MMLSpark-Trace-Id"]
+        status, slow = post_json(srv.port, "/debug/slow?k=5", None,
+                                 method_get=True)
+        rows = json.loads(slow)["slowest"]
+        mine = [r for r in rows if r["traceId"] == trace_id]
+        assert mine, f"serving.request span missing from /debug/slow: {rows}"
+        assert mine[0]["verdict"] == "ok"
+        assert mine[0]["ttft_s"] >= 0.0
+        ex = reg.family("mmlspark_runner_ttft_seconds").labels(
+            runner="srv.prof").exemplars()
+        assert ex is not None and any(tid == trace_id
+                                      for _v, tid, _ts in ex.values()), \
+            "TTFT exemplar lost the engine-thread request's trace id"
+    finally:
+        done.wait(120)
+        srv.stop()
+        reg._flight_recorder.close()
+
+
+def test_engine_thread_crash_dumps_via_excepthook_without_deadlock(tmp_path):
+    """A crashing scorer/engine thread is exactly when the black box must
+    publish: poison the step executable mid-stream, let the engine thread
+    die on the uncaught error, and assert the ``threading.excepthook``
+    path wrote a parseable dump (with the slot table as of the crash)
+    while clients resolve as errors and ``close()`` does not deadlock."""
+    from mmlspark_tpu.observability import MetricsRegistry
+    from mmlspark_tpu.observability.flightrecorder import FlightRecorder
+
+    reg = MetricsRegistry()
+    runner = _runner("cont.crash", layers=1, registry=reg)
+    rec = FlightRecorder(registry=reg, dump_dir=str(tmp_path), install=True)
+    try:
+        dec = runner.decode_stream(slots=2, prompt_bucket=4,
+                                   max_new_tokens=6, page_size=2)
+        h = dec.submit(np.asarray([5, 7], np.int32), max_new_tokens=6)
+        dec.step()                      # join + first token, healthy
+        assert h.slot >= 0 and dec.occupancy() == 1
+
+        def boom(*a, **k):
+            raise RuntimeError("step executable poisoned")
+
+        dec._step = boom
+        dec.start()                     # engine thread picks up the stream
+        assert h.done.wait(30), "client stranded by the crashed engine"
+        assert h.status == "error"
+        deadline = time.monotonic() + 30
+        while not os.listdir(tmp_path):
+            if time.monotonic() > deadline:
+                raise AssertionError("excepthook never dumped")
+            time.sleep(0.01)
+        names = os.listdir(tmp_path)
+        assert len(names) == 1 and "crash" in names[0]
+        dump = json.load(open(tmp_path / names[0]))
+        assert dump["trigger"] == "crash"
+        streams = [s for s in dump["decode_streams"]
+                   if s.get("runner") == "cont.crash"]
+        assert streams and streams[0]["steps"] >= 1
+        dec.close()                     # must return, not deadlock
+        assert reg.family("mmlspark_flightrecorder_dumps_total").value(
+            trigger="crash", result="ok") == 1
+    finally:
+        rec.close()

@@ -430,6 +430,37 @@ def test_preemption_scope_catches_sigterm_and_restores_handler():
                for e in recent_events())
 
 
+def test_sigterm_in_scope_leaves_parseable_dump_artifact(tmp_path):
+    """ISSUE 15 on the PR 14 drill seam: a preemption SIGNAL landing in an
+    armed scope fires the flight recorder BEFORE the final
+    checkpoint-and-exit — the post-mortem artifact is atomic, parseable,
+    and carries the ring tail with the very signal it records."""
+    import json
+    import os
+
+    from mmlspark_tpu.observability import MetricsRegistry
+    from mmlspark_tpu.observability.flightrecorder import FlightRecorder
+
+    reg = MetricsRegistry()
+    rec = FlightRecorder(registry=reg, dump_dir=str(tmp_path), install=True)
+    try:
+        with preemption_scope() as token:
+            signal.raise_signal(signal.SIGTERM)
+            assert token.requested
+        names = os.listdir(tmp_path)
+        assert len(names) == 1 and "preemption" in names[0]
+        dump = json.load(open(tmp_path / names[0]))
+        assert dump["trigger"] == "preemption"
+        assert any(e.get("event") == "preemption_requested"
+                   and e.get("signal") == int(signal.SIGTERM)
+                   for e in dump["ring_events"]), \
+            "dump's ring tail lost the preemption signal event"
+        assert reg.family("mmlspark_flightrecorder_dumps_total").value(
+            trigger="preemption", result="ok") == 1
+    finally:
+        rec.close()
+
+
 def test_preemption_scope_degrades_off_main_thread():
     out = {}
 

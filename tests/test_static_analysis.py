@@ -134,6 +134,32 @@ def test_res003_scans_the_sanctioned_writer_module_too(tmp_path):
     assert real == []
 
 
+def test_res003_covers_the_flight_recorder(tmp_path):
+    """ISSUE 15: a postmortem dump races the crash that triggered it, so
+    the flight recorder is held to the checkpoint atomicity contract —
+    RES003 scans flightrecorder modules; a raw-open dump writer in a
+    flightrecorder twin trips, while the real module (whose dump goes
+    through ``atomic_write``) scans clean."""
+    checker = CheckpointAtomicityChecker()
+    assert checker.interested(
+        "mmlspark_tpu/observability/flightrecorder.py")
+    mod_dir = tmp_path / "observability"
+    mod_dir.mkdir()
+    (mod_dir / "flightrecorder.py").write_text(
+        "def dump(path, snap):\n"
+        "    with open(path, 'w') as f:\n"
+        "        f.write(repr(snap))\n")
+    findings = _scan(CheckpointAtomicityChecker(),
+                     os.path.join("observability", "flightrecorder.py"),
+                     root=str(tmp_path))
+    assert {f.rule for f in findings} == {"RES003"}
+    real = _scan(CheckpointAtomicityChecker(),
+                 os.path.join("mmlspark_tpu", "observability",
+                              "flightrecorder.py"),
+                 root=REPO)
+    assert real == []
+
+
 def test_res002_fires_once_per_unbudgeted_site():
     findings = _scan(UndeadlinedRetryChecker(), "cognitive/res_deadline_bad.py")
     # deferred_callback.cb: a def under a deadline_scope runs later, when

@@ -511,6 +511,90 @@ def test_elastic_surface_books_metrics():
     assert dist_mod.MembershipWatcher is not None
 
 
+def test_profiler_recorder_surface_books_metrics():
+    """ISSUE 15 coverage: the profiling/postmortem plane observes the
+    process at its worst moments, so its own accounting must be
+    un-droppable.  Source-level: the sampler's start/stop/drop sites, the
+    recorder's dump (every result), every dump TRIGGER (crash hooks,
+    preemption hook, SLO burn edge, both HTTP endpoints, the fleet
+    fan-out), and the preemption sites that fire the hooks.  Live:
+    PipelineServer construction registers every profiler + recorder
+    family (and the recorder itself), TopologyService construction
+    registers the recorder families on the driver's registry."""
+    from mmlspark_tpu.observability import flightrecorder, profiling, slo
+    from mmlspark_tpu.observability.metrics import MetricsRegistry
+    from mmlspark_tpu.serving import PipelineServer, TopologyService
+    from mmlspark_tpu.serving import distributed as dist_mod
+    from mmlspark_tpu.utils import resilience
+
+    # sampler lifecycle books runs + per-span samples + bounded drops
+    assert '_m["runs"]' in inspect.getsource(
+        profiling.SamplingProfiler.start)
+    stop_src = inspect.getsource(profiling.SamplingProfiler.stop)
+    assert '_m["runs"]' in stop_src and '_m["samples"]' in stop_src
+    assert '_m["dropped"]' in inspect.getsource(
+        profiling.SamplingProfiler.sample_once)
+    window_src = inspect.getsource(profiling.profile_window)
+    assert 'result="busy"' in window_src and 'result="error"' in window_src
+
+    # every dump outcome books; every trigger routes through dump()
+    dump_src = inspect.getsource(flightrecorder.FlightRecorder.dump)
+    for needle in ('_m["dumps"]', 'result="no_dir"', 'result="ok"',
+                   'result="error"'):
+        assert needle in dump_src, f"FlightRecorder.dump() lost {needle}"
+    assert "set_function" in inspect.getsource(
+        flightrecorder.FlightRecorder.__init__), \
+        "recorder lost the last-dump-age callback gauge"
+    for hook, trig in ((flightrecorder.FlightRecorder._sys_hook, "crash"),
+                       (flightrecorder.FlightRecorder._threading_hook,
+                        "crash"),
+                       (flightrecorder.FlightRecorder._on_preemption,
+                        "preemption")):
+        assert f'trigger="{trig}"' in inspect.getsource(hook), \
+            f"{hook.__name__} no longer dumps with trigger={trig}"
+    assert 'trigger="slo_burn"' in inspect.getsource(slo.SLOEngine.evaluate)
+    # both preemption paths fire the observer hooks the recorder rides
+    assert "_fire_preemption_hooks" in inspect.getsource(
+        resilience.request_preemption)
+    assert "_fire_preemption_hooks" in inspect.getsource(
+        resilience.preemption_scope)
+
+    # both new endpoints serve through the booking call sites
+    handler_src = inspect.getsource(PipelineServer._make_handler)
+    assert "/debug/profile" in handler_src and \
+        "profile_window" in handler_src
+    assert "/debug/dump" in handler_src and \
+        'trigger="http"' in handler_src
+    fleet_src = inspect.getsource(TopologyService.fleet_dump)
+    assert 'trigger="fleet"' in fleet_src and "dumps_c.inc" in fleet_src
+
+    # live: server construction registers the families + the recorder
+    reg = MetricsRegistry()
+    srv = PipelineServer(lambda df: df, registry=reg)  # never started
+    try:
+        for family in ("mmlspark_profiler_runs_total",
+                       "mmlspark_profiler_samples_total",
+                       "mmlspark_profiler_stacks_dropped_total",
+                       "mmlspark_flightrecorder_dumps_total",
+                       "mmlspark_flightrecorder_last_dump_age_seconds"):
+            assert reg.family(family) is not None, \
+                f"PipelineServer no longer registers {family}"
+        assert getattr(reg, "_flight_recorder", None) is not None, \
+            "PipelineServer no longer creates the per-registry recorder"
+    finally:
+        reg._flight_recorder.close()   # uninstall the process crash hooks
+    reg2 = MetricsRegistry()
+    TopologyService(registry=reg2, probe_interval_s=None)  # never started
+    try:
+        for family in ("mmlspark_flightrecorder_dumps_total",
+                       "mmlspark_flightrecorder_last_dump_age_seconds"):
+            assert reg2.family(family) is not None, \
+                f"TopologyService no longer registers {family}"
+    finally:
+        reg2._flight_recorder.close()
+    assert dist_mod.TOPOLOGY_ENDPOINTS["GET"].count("/fleet/dump") == 1
+
+
 def test_topology_endpoint_sweep():
     """Every HTTP endpoint the TopologyService handler serves must appear
     in the declared ``TOPOLOGY_ENDPOINTS`` table (and vice versa): a new
