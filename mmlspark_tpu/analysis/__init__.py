@@ -18,7 +18,7 @@ from .baseline import (BaselineEntry, load_baseline, save_baseline,
 from .checkers import (CheckpointAtomicityChecker, HotPathChecker,
                        LockDisciplineChecker, ResilienceCoverageChecker,
                        TracerSafetyChecker, TransferDisciplineChecker,
-                       UndeadlinedRetryChecker)
+                       UnboundedBlockingChecker, UndeadlinedRetryChecker)
 from .cli import default_checkers, main, rule_catalog, run_analysis
 from .engine import AnalysisEngine, Checker, Finding, iter_python_files
 from .stagecheck import StageContractChecker
@@ -27,7 +27,8 @@ __all__ = [
     "AnalysisEngine", "BaselineEntry", "Checker", "CheckpointAtomicityChecker",
     "Finding", "HotPathChecker", "LockDisciplineChecker", "ResilienceCoverageChecker",
     "StageContractChecker", "TracerSafetyChecker",
-    "TransferDisciplineChecker", "UndeadlinedRetryChecker",
+    "TransferDisciplineChecker", "UnboundedBlockingChecker",
+    "UndeadlinedRetryChecker",
     "default_checkers", "iter_python_files", "load_baseline", "main",
     "rule_catalog", "run_analysis", "save_baseline", "split_findings",
     "update_baseline",

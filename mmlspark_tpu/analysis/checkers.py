@@ -16,7 +16,7 @@ from .engine import Checker, Finding, ModuleContext, with_lock_items
 __all__ = ["TracerSafetyChecker", "ResilienceCoverageChecker",
            "UndeadlinedRetryChecker", "CheckpointAtomicityChecker",
            "LockDisciplineChecker", "HotPathChecker",
-           "TransferDisciplineChecker"]
+           "TransferDisciplineChecker", "UnboundedBlockingChecker"]
 
 
 # ---------------------------------------------------------------------------
@@ -682,3 +682,56 @@ class HotPathChecker(Checker):
                         "decide to drop it — pass structured fields and "
                         "format lazily (core/logging gates on listeners)")
                     return
+
+
+# ---------------------------------------------------------------------------
+# RES004 — unbounded blocking
+# ---------------------------------------------------------------------------
+
+#: blocking primitives whose zero-timeout form parks the calling thread
+#: forever; the message names the canonical owner of each method
+_RES_BLOCKING_ATTRS = {
+    "join": "Thread.join",
+    "get": "Queue.get",
+    "wait": "Event.wait / Condition.wait",
+}
+
+
+class UnboundedBlockingChecker(Checker):
+    """RES004 — ``Thread.join()`` / ``Queue.get()`` / ``Event.wait()``
+    with no timeout inside the serving layer or the runner hot path is a
+    latent hang: a hung device dispatch or a peer that accepts and never
+    replies parks the thread forever — exactly the slow-failure class the
+    dispatch watchdog exists for (ISSUE 16).  Pass a timeout (and handle
+    expiry), or baseline the site with a justification for why it cannot
+    hang (e.g. the waited-on event is set by a watchdog-guarded engine
+    that resolves every handle on abort)."""
+
+    rules = {
+        "RES004": "unbounded blocking call (join/get/wait with no "
+                  "timeout) on a serving/runner hot path",
+    }
+
+    SCOPE = ("serving/", "models/runner.py")
+
+    def interested(self, relpath: str) -> bool:
+        return any(f"/{s}" in f"/{relpath}" for s in self.SCOPE)
+
+    def visit(self, node: ast.AST, ctx: ModuleContext) -> None:
+        if not isinstance(node, ast.Call) or \
+                not isinstance(node.func, ast.Attribute):
+            return
+        attr = node.func.attr
+        owner = _RES_BLOCKING_ATTRS.get(attr)
+        if owner is None:
+            return
+        # a positional arg is the timeout for all three primitives (and
+        # excludes the str.join/dict.get false positives wholesale); a
+        # `timeout=` keyword bounds the call explicitly
+        if node.args or any(kw.arg == "timeout" for kw in node.keywords):
+            return
+        ctx.report(
+            "RES004", node,
+            f".{attr}() with no timeout ({owner} shape) — an unbounded "
+            "block is a latent hang on this path: pass a timeout and "
+            "handle expiry, or baseline the site with a justification")

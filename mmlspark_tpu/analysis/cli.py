@@ -22,7 +22,7 @@ from .baseline import (DEFAULT_BASELINE_NAME, load_baseline, split_findings,
 from .checkers import (CheckpointAtomicityChecker, HotPathChecker,
                        LockDisciplineChecker, ResilienceCoverageChecker,
                        TracerSafetyChecker, TransferDisciplineChecker,
-                       UndeadlinedRetryChecker)
+                       UnboundedBlockingChecker, UndeadlinedRetryChecker)
 from .engine import AnalysisEngine, Checker, Finding, iter_python_files
 from .stagecheck import StageContractChecker
 
@@ -33,7 +33,8 @@ def default_checkers() -> List[Checker]:
     return [TracerSafetyChecker(), ResilienceCoverageChecker(),
             UndeadlinedRetryChecker(), CheckpointAtomicityChecker(),
             LockDisciplineChecker(), HotPathChecker(),
-            TransferDisciplineChecker(), StageContractChecker()]
+            TransferDisciplineChecker(), StageContractChecker(),
+            UnboundedBlockingChecker()]
 
 
 def rule_catalog() -> dict:

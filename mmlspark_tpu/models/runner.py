@@ -78,6 +78,26 @@ class SlotsExhausted(RuntimeError):
     shed = True
 
 
+class EngineDraining(RuntimeError):
+    """The decoder is draining (graceful shutdown, ISSUE 16): no new
+    joins — existing slots run to eos/budget, arrivals shed retryably."""
+    shed = True
+    shed_reason = "draining"
+
+
+class EngineUnavailable(RuntimeError):
+    """The continuous decode engine cannot take this request right now —
+    restart backoff in progress, or the runner is quarantined after
+    repeated stalls (ISSUE 16).  A retryable shed (another worker can
+    serve it), not a failure: ``shed`` duck-types the serving 503 path."""
+    shed = True
+
+    def __init__(self, msg: str, reason: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.shed_reason = reason
+        self.retry_after_s = float(retry_after_s)
+
+
 class ShedReply:
     """Per-row shed sentinel: a scorer that must refuse ONE row of a batch
     (mid-decode page denial) returns this in the reply column, and the
@@ -471,6 +491,18 @@ class ModelRunner:
         reg.histogram("mmlspark_runner_ttft_seconds",
                       "submit-to-first-token latency of continuous decode",
                       labels=("runner",))
+        # tail-tolerance surface (ISSUE 16): stall + supervised-restart
+        # families registered at construction so the telemetry sweep gates
+        # on them even for runners that never stall; the stall watchdog
+        # and the scorer's restart supervisor bind/book the children
+        self._c_stalls = reg.counter(
+            "mmlspark_runner_stalls_total",
+            "device dispatches that exceeded the stall watchdog timeout",
+            labels=("runner",)).labels(runner=name)
+        reg.counter(
+            "mmlspark_engine_restarts_total",
+            "supervised decode-engine rebuilds after an abort/stall",
+            labels=("runner",))
         #: (device key, page size) -> shared PagePool for paged decode
         self._pools: Dict[Tuple, PagePool] = {}
         #: resolved geometry of the most recent decode (DecodeResult.extras)
@@ -581,7 +613,8 @@ class ModelRunner:
                prepare: Optional[Callable] = None,
                encode: Optional[Callable] = None,
                mode: str = "score", continuous: bool = False,
-               report_ttft: bool = False, **decode_kwargs) -> "Transformer":
+               report_ttft: bool = False, supervisor=None,
+               **decode_kwargs) -> "Transformer":
         """A ``Transformer`` front for ``PipelineServer`` / the streaming
         facade.  ``mode="score"`` stacks request rows (via ``prepare``,
         default ``np.asarray(..., float32)``) and scores them through
@@ -608,6 +641,9 @@ class ModelRunner:
         the moment it is drained — no flush tick, and a finished sequence
         replies while the batch keeps decoding.  Admission failure (no free
         slot, page pool exhausted) sheds with 503 + Retry-After.
+        ``supervisor`` (continuous only, ISSUE 16) overrides the default
+        :class:`~mmlspark_tpu.utils.resilience.RestartSupervisor` gating
+        engine rebuilds (backoff/quarantine policy, injectable clock).
         ``report_ttft=True`` wraps decode replies as ``{"tokens",
         "ttft_ms"}`` — the in-band first-token latency ``mixed_load``'s
         ``ttft_p99_ms`` gate reads (for the ticked drain there is no
@@ -617,7 +653,7 @@ class ModelRunner:
             raise ValueError("scorer mode must be score|decode")
         return _RunnerScorer(self, input_col, reply_col, prepare, encode,
                              mode, decode_kwargs, continuous=continuous,
-                             report_ttft=report_ttft)
+                             report_ttft=report_ttft, supervisor=supervisor)
 
     # ------------------------------------------------------------ decode front
     def page_pool(self, page_size: int = 64,
@@ -787,7 +823,8 @@ class ModelRunner:
                cache_len: Optional[int] = None,
                kv_layout: str = "dense",
                page_size: int = 64,
-               pool: Optional[PagePool] = None) -> DecodeResult:
+               pool: Optional[PagePool] = None,
+               watchdog=None) -> DecodeResult:
         """KV-cached batched autoregressive generation.
 
         ``prompts`` is ``(B, P)`` int32 (rows padded to the longest prompt);
@@ -954,6 +991,13 @@ class ModelRunner:
         dte = self.device_time_every
         dispatch_s_total = device_s_total = 0.0
         t_loop0 = time.perf_counter()
+        if watchdog is not None:
+            # stall watchdog (ISSUE 16): one armed section spans prefill +
+            # the whole token loop, with a per-iteration heartbeat after
+            # each host fetch — the timeout bounds any SINGLE dispatch/
+            # fetch (the hang shapes), never the loop's total wall time.
+            # Build one via stall_watchdog() to book stalls + flight dumps.
+            watchdog.arm("runner.decode")
         _phase = _enter_phase("runner.decode")
         try:
             last, cache = prefill(
@@ -988,6 +1032,8 @@ class ModelRunner:
                         fin_now = finished | (tok == eos_id)
                     else:
                         fin_now = finished
+                if watchdog is not None:
+                    watchdog.heartbeat()   # this step's host fetch returned
                 # tokens emitted while a sequence was already frozen are eos
                 # padding, not generated work (ISSUE 12 bugfix: the old
                 # B * n_generated charge inflated fleet tokens/sec and the
@@ -1088,6 +1134,8 @@ class ModelRunner:
             ok = True
         finally:
             _exit_phase(_phase)
+            if watchdog is not None:
+                watchdog.disarm()
             if paged:
                 leftover = [p for pgs in seq_pages for p in pgs]
                 if leftover:
@@ -1144,12 +1192,41 @@ class ModelRunner:
                             lengths=lengths, steps=steps, logits=logits,
                             extras=extras)
 
+    # --------------------------------------------------------- stall watchdog
+    def stall_watchdog(self, stall_timeout_s: float,
+                       clock: Callable[[], float] = time.monotonic,
+                       on_stall: Optional[Callable] = None):
+        """A :class:`~mmlspark_tpu.utils.resilience.Watchdog` wired to this
+        runner's stall telemetry (ISSUE 16): an armed section overrunning
+        ``stall_timeout_s`` books ``mmlspark_runner_stalls_total`` and
+        fires a flight-recorder postmortem dump on the stall edge
+        (``trigger="stall"`` — the engine state BEFORE recovery tears it
+        down), then chains the caller's ``on_stall(label, elapsed_s)``
+        (the continuous engine hangs its poison-abort there).  Pass the
+        result to :meth:`decode`'s ``watchdog=``, or let
+        ``decode_stream(stall_timeout_s=...)`` build one internally."""
+        from ..utils.resilience import Watchdog
+
+        def _trip(label: str, elapsed: float) -> None:
+            self._c_stalls.inc()
+            try:
+                from ..observability.flightrecorder import get_flight_recorder
+                get_flight_recorder(self.registry).dump(trigger="stall")
+            except Exception:  # noqa: BLE001 — the dump must never block
+                pass           # stall recovery
+            if on_stall is not None:
+                on_stall(label, elapsed)
+
+        return Watchdog(stall_timeout_s, clock=clock, on_stall=_trip,
+                        name=self.name)
+
     # ------------------------------------------------------ continuous front
     def decode_stream(self, *, slots: int = 4, prompt_bucket: int = 16,
                       max_new_tokens: int = 16,
                       eos_id: Optional[int] = None, page_size: int = 64,
                       pool: Optional[PagePool] = None,
-                      clock: Optional[Callable[[], float]] = None
+                      clock: Optional[Callable[[], float]] = None,
+                      stall_timeout_s: Optional[float] = None
                       ) -> "ContinuousDecoder":
         """A persistent in-flight decode loop over the paged pool (ISSUE 13
         tentpole): a fixed ``slots``-wide batch whose per-slot state (page-
@@ -1175,7 +1252,8 @@ class ModelRunner:
                                  prompt_bucket=prompt_bucket,
                                  max_new_tokens=max_new_tokens,
                                  eos_id=eos_id, page_size=page_size,
-                                 pool=pool, clock=clock)
+                                 pool=pool, clock=clock,
+                                 stall_timeout_s=stall_timeout_s)
 
 
 class StreamHandle:
@@ -1279,7 +1357,8 @@ class ContinuousDecoder:
                  prompt_bucket: int = 16, max_new_tokens: int = 16,
                  eos_id: Optional[int] = None, page_size: int = 64,
                  pool: Optional[PagePool] = None,
-                 clock: Optional[Callable[[], float]] = None):
+                 clock: Optional[Callable[[], float]] = None,
+                 stall_timeout_s: Optional[float] = None):
         module = runner.module
         if module is None or not hasattr(module, "init_paged_cache"):
             raise TypeError(
@@ -1349,7 +1428,19 @@ class ContinuousDecoder:
         self._closed = False
         self._poisoned = False
         self._torn = False
+        self._draining = False
+        #: why the engine died ("stall"/"error"; None while alive or after
+        #: a clean close) — the serving seam reads it to map stall-aborted
+        #: handles to a retryable 503 instead of a 500 (ISSUE 16)
+        self.abort_reason: Optional[str] = None
         self._thread: Optional[threading.Thread] = None
+        # dispatch hang watchdog (ISSUE 16): armed around every engine
+        # dispatch+fetch; a trip books the stall, dumps the flight
+        # recorder (runner.stall_watchdog wires both), then poison-aborts
+        # this engine from the monitor thread
+        self.watchdog = None if stall_timeout_s is None else \
+            runner.stall_watchdog(stall_timeout_s, clock=self.clock,
+                                  on_stall=self._stall_abort)
         self.steps = 0       # fused step dispatches (join prefills excluded)
         self.joined = 0
         self.left = 0
@@ -1386,6 +1477,12 @@ class ContinuousDecoder:
         """True once :meth:`close` ran or the engine aborted — a closed
         decoder refuses submits; callers holding one should rebuild."""
         return self._closed
+
+    @property
+    def draining(self) -> bool:
+        """True once :meth:`drain` started: no new joins, existing slots
+        running to completion."""
+        return self._draining
 
     def occupancy(self) -> int:
         """Slots reserved or live (free slots are ``slots - occupancy``)."""
@@ -1441,6 +1538,12 @@ class ContinuousDecoder:
         with self._cond:
             if self._closed:
                 raise RuntimeError("decoder is closed")
+            if self._draining:
+                # graceful drain (ISSUE 16): existing slots run to
+                # eos/budget, new arrivals shed retryably — another
+                # worker (or this one after restart) takes them
+                raise EngineDraining(
+                    "decoder is draining — no new joins")
             self._adopt_current_pool_locked()
             if not self._free:
                 raise SlotsExhausted(
@@ -1583,6 +1686,8 @@ class ContinuousDecoder:
             self._table[s, :n] = h.pages
             self._table_dirty = True
             self._handles[s] = h
+            if self.watchdog is not None:
+                self.watchdog.arm("runner.decode.join")
             last, self._cache = self._prefill1(
                 runner.variables, jnp.asarray(toks), pos_dev,
                 jnp.asarray([h.length], np.int32), jnp.asarray(jtable),
@@ -1590,6 +1695,8 @@ class ContinuousDecoder:
             tok_d, fin_d = self._sample1(last, jnp.zeros(1, bool))
             tok0 = int(np.asarray(tok_d)[0])
             fin0 = bool(np.asarray(fin_d)[0])
+            if self.watchdog is not None:
+                self.watchdog.disarm()
             runner._c_batches["decode"].inc()
             now = self.clock()
             h.status = "live"
@@ -1655,6 +1762,11 @@ class ContinuousDecoder:
             else jnp.asarray(self._tok)
         fin_in = self._fin_dev if self._fin_dev is not None \
             else jnp.asarray(self._fin)
+        if self.watchdog is not None:
+            # the armed section covers the dispatch AND the host fetch
+            # below — both are the hang shapes (a wedged relay stalls the
+            # fetch; a dead runtime stalls the enqueue)
+            self.watchdog.arm("runner.decode.step")
         t_disp0 = time.perf_counter()
         tok_d, fin_d, self._cache = self._step(
             runner.variables, tok_in, jnp.asarray(pos),
@@ -1669,6 +1781,8 @@ class ContinuousDecoder:
         self._tok_dev, self._fin_dev = tok_d, fin_d
         t_dev0 = time.perf_counter()
         tok, fin = np.asarray(tok_d), np.asarray(fin_d)
+        if self.watchdog is not None:
+            self.watchdog.disarm()
         self.steps += 1
         dte = runner.device_time_every
         if dte and self.steps % dte == 0:
@@ -1741,8 +1855,12 @@ class ContinuousDecoder:
                 "joined": self.joined,
                 "left": self.left,
                 "closed": self._closed,
+                "draining": self._draining,
+                "abort_reason": self.abort_reason,
                 "slot_table": slots,
             }
+        if self.watchdog is not None:
+            state["watchdog"] = self.watchdog.as_dict()
         state["pool"] = {
             "page_size": self.pool.page_size,
             "capacity": self.pool.capacity,
@@ -1765,6 +1883,10 @@ class ContinuousDecoder:
                 target=self._run, daemon=True,
                 name=f"mmlspark-decode-stream-{self._name}")
             self._thread.start()
+        if self.watchdog is not None:
+            # monitor thread mode: a test driving step() manually on a
+            # FakeClock skips start() and polls watchdog.check() itself
+            self.watchdog.start()
         return self
 
     def _run(self) -> None:
@@ -1781,10 +1903,23 @@ class ContinuousDecoder:
                 self._abort()  # strand clients on done.wait
                 raise
 
+    def _stall_abort(self, label: str, elapsed: float) -> None:
+        """Watchdog trip (runs on the MONITOR thread — the engine thread
+        is stuck inside the hung dispatch): mark the abort as a stall
+        FIRST, so the on_done callbacks the teardown fires read it and
+        shed 503 ``shed_engine_stall`` instead of erroring 500, then
+        poison-abort — in-flight handles resolve, pages free, and the
+        borrowed slabs drop (donated state is unknown while a dispatch is
+        wedged inside them)."""
+        self.abort_reason = "stall"
+        self._abort()
+
     def _abort(self) -> None:
         """Engine failure: resolve every queued/live handle as ``error``
         and drop the borrowed slabs (donated state unknown — the next
         borrower rebuilds zeros)."""
+        if self.abort_reason is None:
+            self.abort_reason = "error"
         with self._cond:
             self._closed = True
             self._poisoned = True
@@ -1816,6 +1951,12 @@ class ContinuousDecoder:
         cache, self._cache = self._cache, None
         if cache is not None:
             self.pool.return_cache(None if self._poisoned else cache)
+        if self.watchdog is not None:
+            # the engine is gone — nothing left to watch.  stop() is safe
+            # from the monitor thread itself (stall-abort path): it sets
+            # the stop event without self-joining.
+            self.watchdog.disarm()
+            self.watchdog.stop()
 
     def _cancel_arrival(self, h: StreamHandle, outcome: str,
                         leavers: List[StreamHandle]) -> None:
@@ -1829,6 +1970,35 @@ class ContinuousDecoder:
         with self._cond:
             self._free.append(h.slot)
             self._book_occupancy()
+
+    def drain(self, timeout_s: Optional[float] = None,
+              poll_s: float = 0.05) -> bool:
+        """Graceful wind-down (ISSUE 16): stop admitting — ``submit``
+        sheds :class:`EngineDraining` from here on — let queued arrivals
+        and live slots run to eos/budget/deadline, then :meth:`close`.
+
+        Returns True when every slot finished inside ``timeout_s`` (None
+        = wait indefinitely), False when the timeout cut the wait short —
+        ``close()`` then cancels the survivors (partial tokens stay on
+        their handles).  Needs the :meth:`start` engine thread (or a
+        concurrent external ``step()`` driver) to make progress; the wait
+        keys on ALL slots returning to the free list, so a join in flight
+        between the arrival snapshot and its splice can never be stranded
+        by the close racing it."""
+        with self._cond:
+            self._draining = True
+        deadline = None if timeout_s is None else self.clock() + timeout_s
+        drained = False
+        with self._cond:
+            while not self._torn:
+                if len(self._free) == self.slots and not self._arrivals:
+                    drained = True
+                    break
+                if deadline is not None and self.clock() >= deadline:
+                    break
+                self._cond.wait(poll_s)
+        self.close()
+        return drained
 
     def close(self) -> None:
         """Stop the engine, cancel queued arrivals and live slots (partial
@@ -1853,7 +2023,8 @@ class _RunnerScorer(Transformer):
     def __init__(self, runner: ModelRunner, input_col: str, reply_col: str,
                  prepare: Optional[Callable], encode: Optional[Callable],
                  mode: str, decode_kwargs: Dict[str, Any],
-                 continuous: bool = False, report_ttft: bool = False):
+                 continuous: bool = False, report_ttft: bool = False,
+                 supervisor=None):
         super().__init__()
         self.runner = runner
         self.input_col, self.reply_col = input_col, reply_col
@@ -1865,6 +2036,11 @@ class _RunnerScorer(Transformer):
         self.report_ttft = bool(report_ttft)
         self._decoder: Optional[ContinuousDecoder] = None
         self._dec_lock = threading.Lock()
+        #: duck-typed health signal (ISSUE 16): PipelineServer's /health
+        #: reads it — a quarantined runner flips it False so the fleet's
+        #: probes evict the worker
+        self.serving_healthy = True
+        self.supervisor = None
         if self.continuous:
             if mode != "decode":
                 raise ValueError("continuous=True requires mode='decode' "
@@ -1875,16 +2051,54 @@ class _RunnerScorer(Transformer):
             # when the model exposes it, so a score-mode scorer (or any
             # other Transformer) never matches
             self.continuous_submit = self._continuous_submit
+            # supervised engine recovery (ISSUE 16): rebuilds after an
+            # abort ride capped exponential backoff; repeated stalls
+            # quarantine the runner (serving_healthy -> False)
+            from ..utils.resilience import RestartSupervisor
+            self.supervisor = supervisor if supervisor is not None else \
+                RestartSupervisor(
+                    clock=self.decode_kwargs.get("clock") or time.monotonic)
+            self._pending_restart = False
+            self._c_restarts = runner.registry.counter(
+                "mmlspark_engine_restarts_total",
+                "supervised decode-engine rebuilds after an abort/stall",
+                labels=("runner",)).labels(runner=runner.name)
 
     # ---------------------------------------------------- continuous protocol
     def _ensure_decoder(self) -> ContinuousDecoder:
         with self._dec_lock:
-            if self._decoder is None or self._decoder.closed:
-                # a decoder whose engine aborted (poisoned dispatch) is
-                # permanently closed — rebuild rather than brick every
-                # later request on "decoder is closed"
-                self._decoder = self.runner.decode_stream(
-                    **self.decode_kwargs).start()
+            dec = self._decoder
+            if dec is not None and not dec.closed:
+                return dec
+            if dec is not None:
+                # the engine died under us (poisoned dispatch, stall
+                # abort): the first observer books the death; the backoff
+                # below gates every rebuilder, so a request storm cannot
+                # thrash rebuild-abort cycles (ISSUE 16)
+                self._decoder = None
+                self.supervisor.note_failure(dec.abort_reason or "error")
+                self._pending_restart = True
+            if self.supervisor.quarantined:
+                # repeated stalls inside the window: stop restarting and
+                # flip /health unhealthy — TopologyService probes evict
+                # this worker; the fleet routes around it
+                self.serving_healthy = False
+                raise EngineUnavailable(
+                    "decode engine quarantined after repeated stalls",
+                    reason="engine_quarantined",
+                    retry_after_s=self.supervisor.retry_after_s())
+            wait = self.supervisor.retry_after_s()
+            if wait > 0:
+                raise EngineUnavailable(
+                    f"decode engine restarting; backoff {wait:.2f}s left",
+                    reason="engine_restarting",
+                    retry_after_s=max(0.1, wait))
+            self._decoder = self.runner.decode_stream(
+                **self.decode_kwargs).start()
+            if self._pending_restart:
+                self._pending_restart = False
+                self.supervisor.note_restart()
+                self._c_restarts.inc()
             return self._decoder
 
     def continuous_close(self) -> None:
@@ -1894,6 +2108,25 @@ class _RunnerScorer(Transformer):
             decoder, self._decoder = self._decoder, None
         if decoder is not None:
             decoder.close()
+            if self.supervisor is not None:
+                # a clean operator close is engine health, not failure —
+                # the backoff exponent resets
+                self.supervisor.note_success()
+
+    def continuous_drain(self, timeout_s: Optional[float] = None) -> bool:
+        """Graceful wind-down of the owned stream (ISSUE 16): no new
+        joins, existing slots run to eos/budget, then close.  Returns
+        True when every in-flight slot finished inside ``timeout_s``.  A
+        later request lazily reopens a fresh engine (a drain is a clean
+        close — no restart backoff)."""
+        with self._dec_lock:
+            decoder, self._decoder = self._decoder, None
+        if decoder is None:
+            return True
+        drained = decoder.drain(timeout_s=timeout_s)
+        if self.supervisor is not None:
+            self.supervisor.note_success()
+        return drained
 
     def _reply_body(self, tokens, ttft_s: Optional[float]):
         body = self.encode(np.asarray(tokens, np.int32))
@@ -1944,6 +2177,14 @@ class _RunnerScorer(Transformer):
             elif h.status == "expired":
                 resolve(reply={"error": "deadline expired mid-decode"},
                         status=504, verdict="deadline_expired_decoding")
+            elif decoder.abort_reason == "stall":
+                # the watchdog killed a hung dispatch under this request:
+                # the prompt is fine and another worker (or this engine
+                # after its supervised restart) can serve it — a
+                # retryable 503, not a 500 (ISSUE 16)
+                resolve(reply={"error": "shed: decode engine stalled"},
+                        status=503, verdict="shed_engine_stall",
+                        retry_after_s=1.0)
             else:  # cancelled / error — the engine went away under us
                 resolve(reply={"error": f"decode {h.status}"},
                         status=500, verdict="error")

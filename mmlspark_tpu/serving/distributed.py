@@ -21,10 +21,12 @@ from __future__ import annotations
 import itertools
 import json
 import os
+import queue
 import threading
 import time
 import urllib.parse
 import urllib.request
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
@@ -37,7 +39,8 @@ from ..observability.slo import SLOEngine
 from ..observability.tracing import (TRACE_HEADER, TRACEPARENT_HEADER,
                                      current_span, current_trace_id,
                                      format_traceparent)
-from ..utils.resilience import CircuitBreaker, Deadline, current_deadline
+from ..utils.resilience import (CircuitBreaker, Deadline, RetryBudget,
+                                current_deadline)
 
 
 def _http_json(url: str, payload: Optional[dict] = None, timeout: float = 10.0,
@@ -436,7 +439,13 @@ class TopologyService:
             workers = {sid: {"role": w.get("role", "worker"),
                              "generation": int(w.get("generation", 0)),
                              "host": w.get("host"), "port": w.get("port"),
-                             "request_class": w.get("request_class")}
+                             "request_class": w.get("request_class"),
+                             # "up" | "draining" — a draining worker is
+                             # still a member (its in-flight slots are
+                             # finishing) but routing excludes it at pick
+                             # time; published by a same-generation
+                             # re-register so it never bumps the epoch
+                             "state": w.get("state", "up")}
                        for sid, w in self._workers.items()}
             return {"epoch": int(self._membership_epoch), "workers": workers,
                     "evicted": sorted(self._evicted),
@@ -792,16 +801,43 @@ class WorkerServer:
         self.generation = int(generation)
         self.server = PipelineServer(model, **kw)
 
+    def _registration(self, state: Optional[str] = None) -> Dict:
+        body = {"server_id": self.server_id, "host": self.server.host,
+                "port": self.server.port,
+                "api_path": self.server.api_path,
+                "partition_ids": self.partition_ids,
+                "request_class": self.request_class,
+                "role": self.role, "generation": self.generation}
+        if state is not None:
+            body["state"] = state
+        return body
+
     def start(self) -> "WorkerServer":
         self.server.start()
-        _http_json(f"{self.driver_address}/register",
-                   {"server_id": self.server_id, "host": self.server.host,
-                    "port": self.server.port,
-                    "api_path": self.server.api_path,
-                    "partition_ids": self.partition_ids,
-                    "request_class": self.request_class,
-                    "role": self.role, "generation": self.generation})
+        _http_json(f"{self.driver_address}/register", self._registration())
         return self
+
+    def drain(self, timeout_s: Optional[float] = None) -> bool:
+        """Zero-drop rolling-restart unit (ISSUE 16): publish the
+        ``draining`` membership state FIRST (a same-generation re-register
+        — a heartbeat row replacement, so ``RoutingClient`` stops picking
+        this worker without a membership-epoch bump), then drain the
+        wrapped :class:`PipelineServer` (shed new admissions, let
+        in-flight work finish, stop), then deregister.  Stragglers that
+        raced the state publication are shed with ``Retry-After`` and fail
+        over client-side.  Returns the server drain's verdict."""
+        try:
+            _http_json(f"{self.driver_address}/register",
+                       self._registration(state="draining"))
+        except Exception:  # noqa: BLE001 — a blind driver must not block
+            pass           # the drain; probes will evict us anyway
+        ok = self.server.drain(timeout_s=timeout_s)
+        try:
+            _http_json(f"{self.driver_address}/deregister",
+                       {"server_id": self.server_id})
+        except Exception:  # noqa: BLE001 — driver may already be gone
+            pass
+        return ok
 
     def stop(self) -> None:
         try:
@@ -963,13 +999,43 @@ class RoutingClient:
     keeps the default breaker; pass a factory for custom thresholds, or
     ``per_worker_breakers=False`` to disable.  Request/failover counters
     land per worker in the registry.
+
+    Tail tolerance (ISSUE 16):
+
+    - **Retry budget** — failover retries (and hedges) draw from a shared
+      token-bucket :class:`RetryBudget` that deposits per first-try
+      request: under a full outage, attempted exchanges stay within
+      ``(1 + ratio) x`` offered load instead of amplifying into a retry
+      storm.  ``retry_budget_ratio=None`` disables the budget; pass
+      ``retry_budget=`` to inject one (e.g. ``initial=0.0`` for the exact
+      asymptotic bound).  Bookings:
+      ``mmlspark_retry_budget_{granted,denied}_total``.
+    - **Hedged requests** (``hedge=True``, off by default: a hedge is a
+      deliberate traffic duplicate) — once the first exchange outlives
+      the rolling-p95 hedge delay (over the last ``hedge_window``
+      successful exchange latencies; no hedging until
+      ``hedge_min_samples`` exist), ONE speculative duplicate goes to a
+      *different* worker and the first response wins.  Bookings:
+      ``mmlspark_hedges_total{outcome}``.
+    - **Retry-After cooldown** — a 503 shed carrying ``Retry-After`` puts
+      that worker on a pick-time cooldown instead of charging its breaker
+      (a shed is backpressure by design, not a fault) — the very next
+      request routes elsewhere instead of re-picking the shedding worker.
+    - **Draining exclusion** — workers whose membership row carries
+      ``state="draining"`` are skipped at pick time (falling back to them
+      only when nobody else is left).
     """
 
     def __init__(self, driver_address: str, refresh_s: float = 5.0,
                  failover_retries: int = 1, registry=None,
                  per_worker_breakers: bool = True,
                  breaker_factory: Optional[Callable[[str], CircuitBreaker]] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 retry_budget: Optional[RetryBudget] = None,
+                 retry_budget_ratio: Optional[float] = 0.1,
+                 hedge: bool = False, hedge_window: int = 64,
+                 hedge_min_samples: int = 8,
+                 hedge_min_delay_s: float = 0.05):
         self.driver_address = driver_address.rstrip("/")
         self.refresh_s = refresh_s
         self.failover_retries = max(0, failover_retries)
@@ -981,6 +1047,19 @@ class RoutingClient:
                                        cooldown_s=5.0, clock=self.clock,
                                        name=f"worker:{sid}"))
         self.breakers: Dict[str, CircuitBreaker] = {}
+        if retry_budget is not None:
+            self.retry_budget: Optional[RetryBudget] = retry_budget
+        elif retry_budget_ratio is not None:
+            self.retry_budget = RetryBudget(ratio=retry_budget_ratio)
+        else:
+            self.retry_budget = None
+        self.hedge = hedge
+        self.hedge_min_samples = max(1, int(hedge_min_samples))
+        self.hedge_min_delay_s = float(hedge_min_delay_s)
+        self._lat_window: "deque" = deque(maxlen=max(1, int(hedge_window)))
+        # per-worker Retry-After cooldown: sid -> clock() time the shed
+        # verdict expires (consulted at pick time, like breakers)
+        self._cooldown: Dict[str, float] = {}
         self._m_requests = self.registry.counter(
             "mmlspark_routing_requests_total",
             "routed exchanges by worker and outcome",
@@ -988,6 +1067,16 @@ class RoutingClient:
         self._m_failovers = self.registry.counter(
             "mmlspark_routing_failovers_total",
             "failover hops away from a failed worker", labels=("worker",))
+        self._m_hedges = self.registry.counter(
+            "mmlspark_hedges_total",
+            "speculative duplicate exchanges by outcome",
+            labels=("outcome",))
+        self._m_budget_granted = self.registry.counter(
+            "mmlspark_retry_budget_granted_total",
+            "retry/hedge attempts the token-bucket budget allowed")
+        self._m_budget_denied = self.registry.counter(
+            "mmlspark_retry_budget_denied_total",
+            "retry/hedge attempts suppressed by an exhausted budget")
         self._table: List[Dict] = []
         self._fetched = 0.0
         self._rr = 0
@@ -1020,6 +1109,11 @@ class RoutingClient:
                 live = {w["server_id"] for w in self._table}
                 dead = [(sid, self.breakers.pop(sid))
                         for sid in list(self.breakers) if sid not in live]
+                # cooldown hygiene rides the same sweep: a departed
+                # worker's Retry-After verdict must not outlive its row
+                for sid in list(self._cooldown):
+                    if sid not in live:
+                        del self._cooldown[sid]
             for _sid, breaker in dead:  # registry ops outside our lock
                 uninstrument_breaker(breaker, self.registry)
 
@@ -1032,6 +1126,20 @@ class RoutingClient:
                 raise RuntimeError(
                     "no serving workers registered" if not self._table
                     else "no healthy serving workers left to fail over to")
+            # a draining worker sheds everything it is sent: skip it at
+            # pick time, falling back only when nobody else is left (its
+            # fast 503 still beats "no workers" for the caller)
+            up = [w for w in candidates if w.get("state") != "draining"]
+            if up:
+                candidates = up
+            # Retry-After cooldown: a worker that shed with an explicit
+            # back-off verdict is skipped until it expires — same
+            # last-resort fall-back as breakers
+            now = self.clock()
+            cool = [w for w in candidates
+                    if self._cooldown.get(w["server_id"], 0.0) <= now]
+            if cool:
+                candidates = cool
             if self.per_worker_breakers:
                 # skip workers whose breaker is open; keep them as a last
                 # resort when every candidate is open
@@ -1049,6 +1157,163 @@ class RoutingClient:
             self._rr += 1
             return w
 
+    @staticmethod
+    def _shed_retry_after(e) -> Optional[float]:
+        """The cooldown a 503 shed's ``Retry-After`` header asks for, or
+        None when ``e`` is not a shed (or carries no parseable header)."""
+        if not (isinstance(e, urllib.error.HTTPError) and e.code == 503):
+            return None
+        try:
+            ra = e.headers.get("Retry-After") if e.headers is not None \
+                else None
+            return float(ra) if ra is not None else None
+        except (TypeError, ValueError):
+            return None
+
+    def _attempt(self, w: Dict, payload, timeout: float,
+                 deadline: Optional[Deadline]):
+        """One exchange against one worker with ALL per-worker bookkeeping
+        — breaker feed, Retry-After shed cooldown, request counter, hedge
+        latency window.  Never raises; returns a verdict pair:
+
+        - ``("ok", out)`` — success;
+        - ``("raise", e)`` — 4xx: a verdict on the REQUEST, not the worker
+          (the caller re-raises; retrying elsewhere wastes a hop and five
+          bad payloads must never trip a healthy worker's breaker);
+        - ``("deadline", e)`` — the budget ran out mid-exchange: ambiguous
+          evidence, so nothing is booked against the worker (PR 2 rule);
+        - ``("err", e)`` — a failure the caller may fail over from.
+        """
+        sid = w["server_id"]
+        url = f"http://{w['host']}:{w['port']}{w.get('api_path', '/score')}"
+        breaker = self._breaker_for(sid)
+        t0 = self.clock()
+        try:
+            out = _http_json(url, payload, timeout=timeout,
+                             deadline=deadline)
+        except Exception as e:  # noqa: BLE001 — verdict, not propagation
+            if isinstance(e, urllib.error.HTTPError) and e.code < 500:
+                return ("raise", e)
+            if deadline is not None and deadline.expired():
+                return ("deadline", e)
+            cooldown_s = self._shed_retry_after(e)
+            if cooldown_s is not None:
+                # a shed is backpressure by design, not a fault: honor the
+                # worker's Retry-After with a pick-time cooldown instead
+                # of charging its breaker — and stop re-picking it on the
+                # very next request
+                with self._lock:
+                    self._cooldown[sid] = self.clock() + cooldown_s
+                self._m_requests.inc(worker=sid, result="shed")
+            else:
+                if breaker is not None:
+                    breaker.record_failure()
+                self._m_requests.inc(worker=sid, result="fail")
+            return ("err", e)
+        if breaker is not None:
+            if breaker.state == "half_open":
+                # the routing path filters on state at pick time rather
+                # than calling allow() (probe-slot leaks on the bail-out
+                # paths would pin the breaker), so a successful exchange
+                # against a half-open worker is accounted as the probe it
+                # de-facto was: take a slot, then record — the success
+                # closes it
+                breaker.allow()
+            breaker.record_success()
+        self._m_requests.inc(worker=sid, result="ok")
+        with self._lock:
+            # successful exchange latencies drive the rolling-p95 hedge
+            # delay; failures stay out (a hung worker must not teach the
+            # hedger that "slow is normal")
+            self._lat_window.append(max(0.0, self.clock() - t0))
+        return ("ok", out)
+
+    def _hedge_delay_s(self) -> Optional[float]:
+        """Rolling p95 of recent successful exchange latencies (floored at
+        ``hedge_min_delay_s``), or None while the window is too thin to
+        trust — no hedging during cold start."""
+        with self._lock:
+            n = len(self._lat_window)
+            if n < self.hedge_min_samples:
+                return None
+            lats = sorted(self._lat_window)
+        return max(self.hedge_min_delay_s, lats[min(n - 1, int(0.95 * n))])
+
+    def _hedged_exchange(self, w: Dict, payload, key: Optional[str],
+                         timeout: float, deadline: Optional[Deadline],
+                         tried: set):
+        """The first attempt with latency hedging: run the primary
+        exchange; once it outlives the hedge delay, issue ONE speculative
+        duplicate to a *different* worker and return whichever response
+        lands first.  The losing leg finishes its own (per-worker)
+        bookkeeping on its daemon thread.  Failed legs land in ``tried``
+        so a later failover never re-picks them."""
+        delay = self._hedge_delay_s()
+        if delay is None:
+            return self._attempt(w, payload, timeout, deadline)
+        results: "queue.Queue" = queue.Queue()
+
+        def leg(name: str, wk: Dict) -> None:
+            results.put((name, wk["server_id"],
+                         self._attempt(wk, payload, timeout, deadline)))
+
+        threading.Thread(target=leg, args=("primary", w), daemon=True,
+                         name="mmlspark-hedge-primary").start()
+        try:
+            _name, _sid, res = results.get(timeout=delay)
+            return res  # primary beat the hedge delay: no duplicate issued
+        except queue.Empty:
+            pass
+        # the primary outlived the p95 delay — speculate, to a different
+        # worker; a hedge is a retry in disguise, so it draws from the
+        # same budget (a storm of hedges is still a retry storm)
+        hw = None
+        try:
+            hw = self._pick(key, exclude=tried | {w["server_id"]})
+        except RuntimeError:
+            pass
+        if hw is None:
+            self._m_hedges.inc(outcome="no_candidate")
+        elif self.retry_budget is not None \
+                and not self.retry_budget.try_withdraw():
+            self._m_budget_denied.inc()
+            self._m_hedges.inc(outcome="budget_denied")
+            hw = None
+        else:
+            if self.retry_budget is not None:
+                self._m_budget_granted.inc()
+            threading.Thread(target=leg, args=("hedge", hw), daemon=True,
+                             name="mmlspark-hedge-dup").start()
+        # collect until the first success (or every launched leg failed);
+        # each leg's exchange is bounded by `timeout`, so the collection
+        # loop is too — no unbounded wait
+        legs = 1 if hw is None else 2
+        t_end = time.monotonic() + timeout + 1.0
+        raise_res = deadline_res = err_res = None
+        for _ in range(legs):
+            try:
+                name, sid, res = results.get(
+                    timeout=max(0.05, t_end - time.monotonic()))
+            except queue.Empty:
+                break
+            if res[0] == "ok":
+                if hw is not None:
+                    self._m_hedges.inc(
+                        outcome="hedge_won" if name == "hedge"
+                        else "primary_won")
+                return res
+            tried.add(sid)
+            if res[0] == "raise":
+                raise_res = res
+            elif res[0] == "deadline":
+                deadline_res = deadline_res or res
+            else:
+                err_res = err_res or res
+        if hw is not None:
+            self._m_hedges.inc(outcome="both_failed")
+        return raise_res or err_res or deadline_res or \
+            ("err", TimeoutError("hedged exchange produced no result"))
+
     def request(self, payload, key: Optional[str] = None,
                 timeout: float = 30.0, retries: Optional[int] = None,
                 deadline: Optional[Deadline] = None):
@@ -1056,12 +1321,18 @@ class RoutingClient:
         over to the next healthy worker — exactly once per extra attempt
         (the LB behavior the reference delegates to Azure LB,
         ``docs/mmlspark-serving.md:87``).  The ambient/explicit deadline
-        clips every attempt's timeout."""
+        clips every attempt's timeout.  Failover retries draw from the
+        retry budget; with ``hedge=True`` the first attempt may issue one
+        speculative duplicate (see the class docstring)."""
         deadline = deadline or current_deadline()
         failovers = self.failover_retries if retries is None else max(0, retries)
+        if self.retry_budget is not None:
+            # one deposit per OFFERED request: first tries fund retries
+            self.retry_budget.deposit()
         tried: set = set()
         last = None
         failed_over_from: Optional[str] = None
+        first_attempt = True
         for _ in range(failovers + 1):
             if deadline is not None and deadline.expired():
                 # the CALLER's budget is gone — a client-side condition, not
@@ -1075,52 +1346,39 @@ class RoutingClient:
                 if last is None:
                     raise  # empty table and nothing attempted yet
                 break  # nobody left to fail over to
-            url = f"http://{w['host']}:{w['port']}{w.get('api_path', '/score')}"
             sid = w["server_id"]
+            if not first_attempt and self.retry_budget is not None:
+                # a failover retry spends a token; an exhausted budget ends
+                # the request instead of amplifying the outage
+                if not self.retry_budget.try_withdraw():
+                    self._m_budget_denied.inc()
+                    break
+                self._m_budget_granted.inc()
             if failed_over_from is not None:
                 # a HOP is real only once a next candidate is attempted —
                 # a terminal failure with nobody left must not count one
                 self._m_failovers.inc(worker=failed_over_from)
                 failed_over_from = None
-            breaker = self._breaker_for(sid)
-            try:
-                out = _http_json(url, payload, timeout=timeout,
-                                 deadline=deadline)
-            except Exception as e:  # noqa: BLE001 — fail over
-                if isinstance(e, urllib.error.HTTPError) and e.code < 500:
-                    # 4xx is a verdict on the REQUEST, not the worker: the
-                    # same payload would 4xx anywhere, so retrying elsewhere
-                    # wastes a hop and five bad client payloads must never
-                    # trip a healthy worker's breaker
-                    raise
-                if deadline is not None and deadline.expired():
-                    # budget ran out mid-exchange: ambiguous evidence, so
-                    # don't blame the worker (no breaker/failover feed)
-                    raise last or e
-                last = e
-                tried.add(sid)
-                if breaker is not None:
-                    breaker.record_failure()
-                self._m_requests.inc(worker=sid, result="fail")
-                failed_over_from = sid
-                try:  # a briefly-unreachable driver must not abort the
-                    self._refresh(force=True)  # retry; stale table still works
-                except Exception:  # noqa: BLE001
-                    pass
-                key = None  # reroute away from the dead worker
+            if first_attempt and self.hedge:
+                verdict, out = self._hedged_exchange(
+                    w, payload, key, timeout, deadline, tried)
             else:
-                if breaker is not None:
-                    if breaker.state == "half_open":
-                        # the routing path filters on state at pick time
-                        # rather than calling allow() (probe-slot leaks on
-                        # the bail-out paths would pin the breaker), so a
-                        # successful exchange against a half-open worker is
-                        # accounted as the probe it de-facto was: take a
-                        # slot, then record — the success closes it
-                        breaker.allow()
-                    breaker.record_success()
-                self._m_requests.inc(worker=sid, result="ok")
+                verdict, out = self._attempt(w, payload, timeout, deadline)
+            first_attempt = False
+            if verdict == "ok":
                 return out
+            if verdict == "raise":
+                raise out
+            if verdict == "deadline":
+                raise last or out
+            last = out
+            tried.add(sid)
+            failed_over_from = sid
+            try:  # a briefly-unreachable driver must not abort the
+                self._refresh(force=True)  # retry; stale table still works
+            except Exception:  # noqa: BLE001
+                pass
+            key = None  # reroute away from the dead worker
         raise RuntimeError(f"all serving workers failed: {last}")
 
     def stats(self) -> Dict:

@@ -47,6 +47,8 @@ def check_gates(gates: Dict[str, float],
     class's INTENDED request count when ``stats`` carries ``intended`` —
     a client thread dying mid-run loses every remaining request, not one
     "error" — else the legacy transport-errors/attempts ratio),
+    ``max_failed`` (upper bound on the absolute COUNT of lost + non-2xx
+    requests — the zero-drop drills gate on ``max_failed: 0``),
     ``min_rps`` (lower bound on completed-request throughput).  Unknown
     gate keys fail loudly — a typo'd gate that silently always passes is
     worse than no gate."""
@@ -85,12 +87,21 @@ def check_gates(gates: Dict[str, float],
                 bad = stats["errors"] + stats.get("non_2xx", 0.0)
                 rate = bad / attempts if attempts else 1.0
             book(name, rate, limit, rate <= limit)
+        elif name == "max_failed":
+            # absolute count of failed requests (lost + non-2xx), the
+            # rolling-restart drill's gate: "zero dropped requests" is a
+            # COUNT invariant — a rate gate would wave through one drop
+            # per thousand, which is exactly the drop drains must not make
+            intended = stats.get("intended", 0.0)
+            bad = max(0.0, intended - stats["completed"]) \
+                + stats.get("non_2xx", 0.0)
+            book(name, bad, limit, bad <= limit)
         elif name == "min_rps":
             book(name, stats["rps"], limit, stats["rps"] >= limit)
         else:
             raise ValueError(f"unknown gate {name!r}; expected one of "
                              "p99_ms/p50_ms/ttft_p99_ms/ttft_p50_ms/"
-                             "max_error_rate/min_rps")
+                             "max_error_rate/max_failed/min_rps")
     return {"passed": not failures, "failures": failures, "checks": checks}
 
 

@@ -37,7 +37,9 @@ from ..observability.tracing import (Span, TRACE_HEADER, TRACEPARENT_HEADER,
                                      export_span, format_traceparent,
                                      new_trace_id, parse_traceparent,
                                      trace_span)
-from ..utils.resilience import Deadline, deadline_scope
+from ..utils.resilience import (Deadline, deadline_scope,
+                                register_preemption_hook,
+                                unregister_preemption_hook)
 
 # entry ids need uniqueness within the process, not entropy: uuid4's
 # per-call os.urandom syscall (~40 us on this kernel) sat inside the
@@ -143,7 +145,8 @@ class PipelineServer:
                  ewma_alpha: float = 0.2,
                  micro_batch_deadline_margin_s: float = 0.0,
                  micro_batch_ewma_flush_s: Optional[float] = None,
-                 slow_k: int = 10):
+                 slow_k: int = 10,
+                 drain_timeout_s: Optional[float] = 30.0):
         if mode not in ("continuous", "micro_batch"):
             raise ValueError("mode must be continuous|micro_batch")
         self.model = model
@@ -205,6 +208,17 @@ class PipelineServer:
         self.micro_batch_ewma_flush_s = micro_batch_ewma_flush_s
         # /debug/slow default depth
         self.slow_k = int(slow_k)
+        # graceful drain (ISSUE 16): once draining, admission sheds with
+        # 503 "draining" + Connection: close, the continuous engine stops
+        # accepting joins while existing slots run to eos/budget, and the
+        # server stops only after everything admitted resolved — a rolling
+        # restart drops zero in-flight requests.  The SIGTERM/preemption
+        # hook drains with this default budget.
+        self.drain_timeout_s = drain_timeout_s
+        self._draining = threading.Event()
+        self._drained = threading.Event()
+        self._drain_lock = threading.Lock()
+        self._preemption_hook = None
         # metrics: families on the (shared, injectable) registry; children
         # are labelled per server instance once the port is resolved so many
         # servers coexist in one registry/process
@@ -233,6 +247,10 @@ class PipelineServer:
             "mmlspark_serving_queue_delay_ewma_seconds",
             "EWMA of per-entry queue delay (adaptive shed signal)",
             labels=("server",))
+        self._m_drain = reg.histogram(
+            "mmlspark_serving_drain_seconds",
+            "graceful-drain duration: draining flag set -> server stopped",
+            labels=("server",))
         # profiling + postmortem plane (ISSUE 15): families registered at
         # construction (coverage-gated), and the per-registry flight
         # recorder created with its crash/preemption hooks installed so
@@ -251,6 +269,7 @@ class PipelineServer:
         self._h_latency = self._m_latency.detached_child()
         self._h_phase_queue = self._m_phase.detached_child()
         self._h_phase_score = self._m_phase.detached_child()
+        self._h_drain = self._m_drain.detached_child()
         self._q: "queue.Queue[_Entry]" = queue.Queue()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._threads: List[threading.Thread] = []
@@ -277,6 +296,7 @@ class PipelineServer:
         self._h_latency = self._m_latency.labels(server=label)
         self._h_phase_queue = self._m_phase.labels(server=label, phase="queue")
         self._h_phase_score = self._m_phase.labels(server=label, phase="score")
+        self._h_drain = self._m_drain.labels(server=label)
 
     # ------------------------------------------------------------------ http
     def _make_handler(self):
@@ -295,12 +315,24 @@ class PipelineServer:
 
             def do_GET(self):
                 if self.path == "/health":
-                    self._write_raw(200, b"ok", b"text/plain")
+                    # health is the eviction signal: TopologyService probes
+                    # GET this and treat non-200 as unhealthy.  Draining
+                    # (about to stop) and an unhealthy model (duck-typed
+                    # `serving_healthy` — a quarantined decode engine flips
+                    # it) must both fail the probe so routing stops sending
+                    # work here.
+                    if server.draining:
+                        self._write_raw(503, b"draining", b"text/plain")
+                    elif not getattr(server.model, "serving_healthy", True):
+                        self._write_raw(503, b"unhealthy", b"text/plain")
+                    else:
+                        self._write_raw(200, b"ok", b"text/plain")
                 elif self.path == "/stats":
                     d = server.stats.as_dict()
                     with server.stats.lock:
                         d["pending"] = server._pending
                         d["queue_delay_ewma_ms"] = 1000.0 * server._queue_ewma
+                    d["draining"] = server.draining
                     # every breaker instrumented into this registry, with
                     # state / consecutive failures / rolling failure rate
                     d["breakers"] = server.registry.breaker_stats()
@@ -414,6 +446,33 @@ class PipelineServer:
                 t0 = time.perf_counter()
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
+                if self.path == "/admin/drain":
+                    # kick the drain off-thread and ack immediately: drain
+                    # blocks until in-flight slots finish, and the admin
+                    # caller (an orchestrator mid rolling-restart) polls
+                    # /stats or just watches the port close.  Idempotent —
+                    # a second POST reports the drain already running.
+                    timeout_s = server.drain_timeout_s
+                    try:
+                        req = json.loads(body.decode() or "{}")
+                        if isinstance(req, dict) and "timeout_s" in req:
+                            timeout_s = float(req["timeout_s"])
+                    except (ValueError, TypeError):
+                        self._respond(400, {"error": "timeout_s must be "
+                                                     "numeric"})
+                        return
+                    already = server.draining
+                    if not already:
+                        threading.Thread(
+                            target=server.drain,
+                            kwargs={"timeout_s": timeout_s},
+                            daemon=True, name="mmlspark-drain").start()
+                    with server.stats.lock:
+                        pending = server._pending
+                    self._respond(200, {"draining": True,
+                                        "already_draining": already,
+                                        "pending": pending})
+                    return
                 if self.path != server.api_path:
                     self._respond(404, {"error": "not found"})
                     return
@@ -458,11 +517,18 @@ class PipelineServer:
                     trace_hdr[TRACEPARENT_HEADER] = format_traceparent(
                         trace_id, entry.span_id or None)
                 if shed_reason is not None:
+                    extra = {"Retry-After":
+                             _retry_after(server.shed_retry_after_s),
+                             **trace_hdr}
+                    if shed_reason == "draining":
+                        # the server is going away: tell the client to tear
+                        # the keep-alive connection down and re-resolve (a
+                        # pooled connection to a draining server would just
+                        # shed again until the port closes)
+                        extra["Connection"] = "close"
+                        self.close_connection = True
                     self._respond(503, {"error": f"overloaded: {shed_reason}"},
-                                  extra_headers={
-                                      "Retry-After":
-                                      _retry_after(server.shed_retry_after_s),
-                                      **trace_hdr})
+                                  extra_headers=extra)
                     return
                 if server.mode == "continuous" and \
                         server._inline_lock.acquire(blocking=False):
@@ -568,8 +634,11 @@ class PipelineServer:
     # ------------------------------------------------------------------ work
     def _try_admit(self) -> Optional[str]:
         """Count the request and decide admission; returns None when
-        admitted (pending slot taken) or the shed reason.  Two signals shed:
+        admitted (pending slot taken) or the shed reason.  Three signals
+        shed:
 
+        - ``draining`` — the server is emptying itself to stop (graceful
+          drain); takes precedence over the load signals;
         - ``queue_full`` — fixed bound: ``_pending >= max_queue_depth``;
         - ``queue_delay_ewma`` — adaptive bound: the scorer-maintained EWMA
           of queue delay exceeds ``shed_queue_delay_ewma_s`` AND a backlog
@@ -579,7 +648,11 @@ class PipelineServer:
         with self.stats.lock:
             self.stats.received += 1
             shed = None
-            if self._pending >= self.max_queue_depth:
+            if self._draining.is_set():
+                # draining beats every other signal: nothing new may join a
+                # server that is emptying itself to stop (ISSUE 16)
+                shed = "draining"
+            elif self._pending >= self.max_queue_depth:
                 shed = "queue_full"
             elif self.shed_queue_delay_ewma_s is not None \
                     and self._pending > 0 \
@@ -908,10 +981,76 @@ class PipelineServer:
         w = threading.Thread(target=self._worker, daemon=True)
         w.start()
         self._threads.append(w)
+        # SIGTERM/preemption -> graceful drain (ISSUE 16): any preemption
+        # event (a signal landing in a preemption_scope, or a programmatic
+        # request_preemption from a membership watcher) drains this server.
+        # The hook only spawns the drain thread — hooks must never block
+        # the checkpoint-and-exit path they observe.
+        def _drain_on_preemption(reason, _self=self):
+            threading.Thread(target=_self.drain,
+                             kwargs={"timeout_s": _self.drain_timeout_s},
+                             daemon=True, name="mmlspark-drain").start()
+        self._preemption_hook = _drain_on_preemption
+        register_preemption_hook(_drain_on_preemption)
         return self
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def drain(self, timeout_s: Optional[float] = None,
+              poll_s: float = 0.02) -> bool:
+        """Gracefully drain and stop: shed new admissions (503 ``draining``
+        + ``Connection: close``), let the continuous engine's in-flight
+        slots run to eos/budget (no new joins), wait for every admitted
+        entry to resolve, then :meth:`stop`.
+
+        Returns True when everything in flight resolved before the budget
+        ran out; False means the drain timed out and ``stop()`` cancelled
+        the stragglers (they resolve as cancelled — still counted, so the
+        exactly-once stats invariant holds either way).  Idempotent:
+        concurrent callers ride the first drain and share its verdict.
+        """
+        with self._drain_lock:
+            first = not self._draining.is_set()
+            if first:
+                self._draining.set()
+        if not first:
+            self._drained.wait(timeout_s)
+            return self._drained.is_set()
+        t0 = self.clock()
+        deadline = None if timeout_s is None else t0 + timeout_s
+        ok = True
+        # continuous engine first: existing slots run to eos/budget with no
+        # new joins (duck-typed like continuous_submit — a pure-python
+        # pipeline has nothing to drain)
+        drainer = getattr(self.model, "continuous_drain", None)
+        if drainer is not None:
+            budget = None if deadline is None \
+                else max(0.0, deadline - self.clock())
+            ok = bool(drainer(budget)) and ok
+        # then the admission ledger: every admitted entry must resolve
+        # (micro-batch queue drained, handler threads replied) before the
+        # listener goes away
+        while True:
+            with self.stats.lock:
+                pending = self._pending
+            if pending <= 0:
+                break
+            if deadline is not None and self.clock() >= deadline:
+                ok = False
+                break
+            time.sleep(poll_s)
+        self.stop()
+        self._h_drain.observe(max(0.0, self.clock() - t0))
+        self._drained.set()
+        return ok
 
     def stop(self) -> None:
         self._stop.set()
+        if self._preemption_hook is not None:
+            unregister_preemption_hook(self._preemption_hook)
+            self._preemption_hook = None
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()

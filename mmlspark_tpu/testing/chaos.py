@@ -30,7 +30,7 @@ from ..utils.resilience import FakeClock  # re-export for chaos suites
 __all__ = ["ChaosInjector", "LatencyInjector", "ConnectionErrorInjector",
            "StatusStormInjector", "WorkerKiller", "FakeClock",
            "FlakyLoadInjector", "PreemptionSimulator",
-           "ElasticTopologyDrill"]
+           "ElasticTopologyDrill", "HungWorkerInjector"]
 
 Transport = Callable[[HTTPRequestData, float], HTTPResponseData]
 
@@ -309,6 +309,113 @@ class ElasticTopologyDrill:
         with active_mesh(mesh):
             return gbdt_core.train(X, y, self.make_params(),
                                    shard_rows=True, **kw)
+
+
+class HungWorkerInjector:
+    """A worker that accepts connections and never replies — the SLOW
+    failure class (hung XLA dispatch, wedged TPU relay) the tail-tolerance
+    layer exists for (ISSUE 16).  Unlike :class:`WorkerKiller`'s crash, a
+    hung worker keeps its socket OPEN: a connect succeeds, the request is
+    swallowed, and without hedging/timeouts the client slot is tied up
+    forever.
+
+    Binds a real listening socket; :meth:`register` announces it to a
+    ``TopologyService`` as a routable worker so real traffic lands on it.
+    ``mode``:
+
+    - ``"black_hole"`` — accept, read the request, write nothing;
+    - ``"mid_body"`` — write the status line + headers and a partial body
+      (``Content-Length`` promises more), then stall forever.
+
+    ``/health`` probes hang identically, so the driver's prober fails
+    them by timeout and eviction proceeds.  Held connections close only
+    at :meth:`stop`.  ``accepted`` counts hung exchanges for assertions.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 mode: str = "black_hole"):
+        if mode not in ("black_hole", "mid_body"):
+            raise ValueError("mode must be black_hole|mid_body")
+        self.host, self.port = host, port
+        self.mode = mode
+        self.accepted = 0
+        self._sock = None
+        self._conns: list = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def start(self) -> "HungWorkerInjector":
+        import socket
+        self._stop.clear()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((self.host, self.port))
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(64)
+        self._sock.settimeout(0.2)  # bounded accept: stop() can join
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True, name="hung-worker")
+        self._thread.start()
+        return self
+
+    def _accept_loop(self) -> None:
+        import socket
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed under us
+            with self._lock:
+                self.accepted += 1
+                self._conns.append(conn)
+            if self.mode == "mid_body":
+                try:
+                    # promise a body that never arrives: the client is
+                    # left blocked mid-read, not mid-connect
+                    conn.sendall(b"HTTP/1.1 200 OK\r\n"
+                                 b"Content-Type: application/json\r\n"
+                                 b"Content-Length: 1000\r\n\r\n"
+                                 b'{"partial": ')
+                except OSError:
+                    pass
+            # never reply, never close: the connection hangs until stop()
+
+    def register(self, driver_address: str, server_id: str = "hung-worker",
+                 api_path: str = "/score", request_class: str = "default",
+                 role: str = "serving", generation: int = 0) -> None:
+        """Announce this socket to the driver as a routable worker."""
+        from ..serving.distributed import _http_json
+        _http_json(f"{driver_address.rstrip('/')}/register",
+                   {"server_id": server_id, "host": self.host,
+                    "port": self.port, "api_path": api_path,
+                    "request_class": request_class, "role": role,
+                    "generation": generation, "partition_ids": []})
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
+        self._thread = None
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    @property
+    def address(self) -> str:
+        return f"http://{self.host}:{self.port}"
 
 
 class WorkerKiller:

@@ -13,7 +13,12 @@ machinery those boundaries need:
   through batch scoring, HTTP fan-out, and retries, so no retry loop ever
   overshoots what the caller is still willing to wait for;
 - budget-aware ``with_retries`` / ``retry_with_timeout`` (the fault.py
-  originals, now deadline-clipped).
+  originals, now deadline-clipped);
+- ``Watchdog`` — arm/heartbeat stall detection around device dispatches
+  that can hang forever (a wedged TPU relay), so a *slow* failure is
+  surfaced and recovered like a crash instead of wedging a worker;
+- ``RetryBudget`` — token-bucket bound on retry amplification, so a full
+  outage degrades to sheds instead of a fleet-wide retry storm.
 
 Every primitive takes an injectable ``clock`` (and ``sleep`` where it
 waits), so the chaos suite (``testing/chaos.py`` + ``tests/
@@ -635,6 +640,309 @@ def retry_with_timeout(fn: Callable[[], T], timeout_s: float,
             # the worker thread is daemonic-ish leaked but control returns.
             ex.shutdown(wait=False)
     raise last
+
+
+# ---------------------------------------------------------------------------
+# dispatch hang watchdog (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+class Watchdog:
+    """Stall detector for device dispatches that can hang forever.
+
+    The thread doing the dispatch cannot observe its own hang — it is stuck
+    inside the blocked call — so detection is split: the *working* thread
+    brackets each potentially-hanging section with :meth:`arm` /
+    :meth:`disarm` (or the :meth:`section` context manager) and may
+    :meth:`heartbeat` mid-section to restart the clock; a *monitor* (either
+    the daemon thread from :meth:`start`, or a test calling :meth:`check`
+    directly on a :class:`FakeClock`) observes an armed section exceeding
+    ``stall_timeout_s`` and fires ``on_stall(label, elapsed_s)`` exactly
+    once per armed section (re-arming resets the latch).
+
+    ``on_stall`` runs on the monitor thread, outside the watchdog lock, and
+    must therefore be safe to run concurrently with the stalled worker —
+    the decode-engine integration uses it to poison-abort the engine, which
+    is exactly a cross-thread teardown.  A raising callback is swallowed:
+    the detector must keep detecting.
+    """
+
+    def __init__(self, stall_timeout_s: float,
+                 clock: Callable[[], float] = time.monotonic,
+                 on_stall: Optional[Callable[[str, float], None]] = None,
+                 name: str = ""):
+        if stall_timeout_s <= 0:
+            raise ValueError("stall_timeout_s must be > 0")
+        self.stall_timeout_s = float(stall_timeout_s)
+        self.clock = clock
+        self.on_stall = on_stall
+        self.name = name
+        self._lock = threading.Lock()
+        self._armed_at: Optional[float] = None
+        self._label = ""
+        self._generation = 0       # bumped per arm(); the trip latch key
+        self._tripped_generation = -1
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.trips = 0             # sections that exceeded the timeout
+
+    # ---------------------------------------------------------- worker side
+    def arm(self, label: str = "dispatch") -> None:
+        """Mark the start of a section that may hang.  Resets the
+        once-per-section trip latch."""
+        with self._lock:
+            self._armed_at = self.clock()
+            self._label = str(label)
+            self._generation += 1
+
+    def heartbeat(self) -> None:
+        """Restart the stall clock without ending the section (a decode
+        loop that made progress mid-section).  No-op when disarmed."""
+        with self._lock:
+            if self._armed_at is not None:
+                self._armed_at = self.clock()
+
+    def disarm(self) -> None:
+        """Mark the end of the section — the dispatch returned."""
+        with self._lock:
+            self._armed_at = None
+
+    @contextmanager
+    def section(self, label: str = "dispatch"):
+        self.arm(label)
+        try:
+            yield self
+        finally:
+            self.disarm()
+
+    # --------------------------------------------------------- monitor side
+    def stalled_for(self) -> float:
+        """Seconds the current armed section has run (0.0 when disarmed)."""
+        with self._lock:
+            if self._armed_at is None:
+                return 0.0
+            return max(0.0, self.clock() - self._armed_at)
+
+    def expired(self) -> bool:
+        return self.stalled_for() > self.stall_timeout_s
+
+    def check(self) -> bool:
+        """One monitor poll: True when the armed section has overrun
+        ``stall_timeout_s``.  Fires ``on_stall`` the FIRST time an armed
+        section is seen overrun; later polls of the same section return
+        True without re-firing."""
+        with self._lock:
+            if self._armed_at is None:
+                return False
+            elapsed = self.clock() - self._armed_at
+            if elapsed <= self.stall_timeout_s:
+                return False
+            already = self._tripped_generation == self._generation
+            if not already:
+                self._tripped_generation = self._generation
+                self.trips += 1
+            label = self._label
+        if not already and self.on_stall is not None:
+            try:
+                self.on_stall(label, elapsed)
+            except Exception:  # noqa: BLE001 — detector must keep detecting
+                pass
+        return True
+
+    def start(self, poll_interval_s: Optional[float] = None) -> "Watchdog":
+        """Start the daemon monitor thread (idempotent).  Polls at
+        ``poll_interval_s`` (default: a quarter of the stall timeout,
+        floored at 10ms) using real ``time.sleep`` — tests on a FakeClock
+        skip the thread and call :meth:`check` directly."""
+        with self._lock:
+            if self._thread is not None:
+                return self
+            self._stop.clear()
+            interval = poll_interval_s if poll_interval_s is not None \
+                else max(0.01, self.stall_timeout_s / 4.0)
+            thread = threading.Thread(
+                target=self._monitor, args=(float(interval),),
+                name=f"mmlspark-watchdog-{self.name or 'anon'}", daemon=True)
+            self._thread = thread
+        thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._lock:
+            thread, self._thread = self._thread, None
+        self._stop.set()
+        # an on_stall callback tearing its engine down reaches stop() ON
+        # the monitor thread itself — it cannot join itself; the set event
+        # ends the loop at the next poll
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=5.0)
+
+    def _monitor(self, interval: float) -> None:
+        while not self._stop.wait(interval):
+            self.check()
+
+    def as_dict(self) -> dict:
+        with self._lock:
+            armed = self._armed_at is not None
+            label = self._label if armed else ""
+        return {"armed": armed, "label": label, "trips": self.trips,
+                "stall_timeout_s": self.stall_timeout_s}
+
+
+# ---------------------------------------------------------------------------
+# retry budget (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+class RetryBudget:
+    """Token bucket bounding retry amplification fleet-wide.
+
+    Every FIRST attempt deposits ``ratio`` tokens; every retry must
+    withdraw a whole token or be denied.  Under a full outage the math is
+    the invariant: attempted exchanges <= (1 + ratio) * offered + initial
+    — retries can never amplify offered load into a storm, no matter how
+    many clients fail over at once.  ``initial`` (default: ``cap``) is the
+    cold-start burst: a freshly built client can still fail over its first
+    few requests before any deposits accrue; pass ``initial=0.0`` to prove
+    the asymptotic bound exactly.
+
+    Thread-safe; ``granted``/``denied`` counters are the observability
+    surface (`RoutingClient` mirrors them into
+    ``mmlspark_retry_budget_{granted,denied}_total``).
+    """
+
+    def __init__(self, ratio: float = 0.1, cap: float = 100.0,
+                 initial: Optional[float] = None):
+        if ratio < 0:
+            raise ValueError("ratio must be >= 0")
+        if cap <= 0:
+            raise ValueError("cap must be > 0")
+        self.ratio = float(ratio)
+        self.cap = float(cap)
+        self._tokens = self.cap if initial is None \
+            else min(self.cap, max(0.0, float(initial)))
+        self._lock = threading.Lock()
+        self.granted = 0
+        self.denied = 0
+
+    def deposit(self) -> None:
+        """Book one first-try request: the bucket earns ``ratio`` tokens."""
+        with self._lock:
+            self._tokens = min(self.cap, self._tokens + self.ratio)
+
+    def try_withdraw(self) -> bool:
+        """Spend one whole token for a retry; False (denied) when the
+        bucket holds less than one.  The epsilon absorbs float summation
+        of repeated ``ratio`` deposits (10 x 0.1 sums below 1.0), so the
+        documented "1/ratio offered requests earn one retry" holds
+        exactly."""
+        with self._lock:
+            if self._tokens >= 1.0 - 1e-9:
+                self._tokens = max(0.0, self._tokens - 1.0)
+                self.granted += 1
+                return True
+            self.denied += 1
+            return False
+
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+    def as_dict(self) -> dict:
+        with self._lock:
+            return {"tokens": round(self._tokens, 4), "ratio": self.ratio,
+                    "cap": self.cap, "granted": self.granted,
+                    "denied": self.denied}
+
+
+class RestartSupervisor:
+    """Supervised-restart policy for a crash/stall-prone engine.
+
+    The owner reports each engine death via :meth:`note_failure(reason)`;
+    the supervisor gates the rebuild behind capped exponential backoff
+    (:meth:`retry_after_s` > 0 while backing off) and QUARANTINES after
+    ``quarantine_stalls`` stall-deaths inside ``quarantine_window_s`` — a
+    runner stalling over and over is wedged hardware or a dead relay, and
+    the right move is to flip health unhealthy so the fleet's probes evict
+    the worker, not to burn restarts forever.
+
+    The consecutive-failure count (the backoff exponent) resets once the
+    engine stays up longer than ``quarantine_window_s`` past the last
+    death, or explicitly via :meth:`note_success` (a clean close).
+    Quarantine never lifts on its own — the worker is replaced, not
+    healed.  Injectable clock; thread-safe.
+    """
+
+    def __init__(self, initial_backoff_s: float = 0.5,
+                 backoff_cap_s: float = 30.0, quarantine_stalls: int = 3,
+                 quarantine_window_s: float = 300.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.initial_backoff_s = float(initial_backoff_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self.quarantine_stalls = max(1, int(quarantine_stalls))
+        self.quarantine_window_s = float(quarantine_window_s)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._stalls: Deque[float] = collections.deque()
+        self._consecutive = 0
+        self._last_failure_at: Optional[float] = None
+        self._not_before: Optional[float] = None
+        self.quarantined = False
+        self.failures = 0
+        self.restarts = 0
+
+    def note_failure(self, reason: str = "error") -> float:
+        """Record one engine death; returns the backoff applied to the
+        next rebuild.  ``reason == "stall"`` feeds the quarantine window."""
+        with self._lock:
+            now = self.clock()
+            if self._last_failure_at is not None and \
+                    now - self._last_failure_at > self.quarantine_window_s:
+                self._consecutive = 0
+            self._last_failure_at = now
+            self.failures += 1
+            self._consecutive += 1
+            backoff = min(self.backoff_cap_s,
+                          self.initial_backoff_s
+                          * (2.0 ** (self._consecutive - 1)))
+            self._not_before = now + backoff
+            if reason == "stall":
+                self._stalls.append(now)
+                while self._stalls and \
+                        now - self._stalls[0] > self.quarantine_window_s:
+                    self._stalls.popleft()
+                if len(self._stalls) >= self.quarantine_stalls:
+                    self.quarantined = True
+            return backoff
+
+    def retry_after_s(self) -> float:
+        """Seconds until a rebuild is admissible: 0.0 = go now;
+        ``backoff_cap_s`` forever while quarantined (the header-friendly
+        stand-in for never — the worker is being evicted)."""
+        with self._lock:
+            if self.quarantined:
+                return self.backoff_cap_s
+            if self._not_before is None:
+                return 0.0
+            return max(0.0, self._not_before - self.clock())
+
+    def note_restart(self) -> None:
+        """A supervised rebuild actually happened (observability)."""
+        with self._lock:
+            self.restarts += 1
+
+    def note_success(self) -> None:
+        """The engine proved healthy (clean close, sustained uptime): the
+        backoff exponent resets.  Quarantine does NOT lift — see class
+        docstring."""
+        with self._lock:
+            self._consecutive = 0
+            self._not_before = None
+
+    def as_dict(self) -> dict:
+        with self._lock:
+            return {"quarantined": self.quarantined,
+                    "failures": self.failures, "restarts": self.restarts,
+                    "consecutive": self._consecutive,
+                    "stalls_in_window": len(self._stalls)}
 
 
 def with_retries(fn: Callable[[], T], retries: int = 3,

@@ -1,0 +1,19 @@
+"""RES004 true-positive fixture: unbounded blocking primitives on the
+serving hot path.  Parsed by graft-lint only."""
+import queue
+import threading
+
+_q: "queue.Queue" = queue.Queue()
+
+
+def drain_one():
+    return _q.get()                          # RES004: Queue.get, no timeout
+
+
+def wait_for_reply(entry):
+    entry.done.wait()                        # RES004: Event.wait, no timeout
+    return entry.reply
+
+
+def stop_worker(thread: threading.Thread):
+    thread.join()                            # RES004: Thread.join, no timeout

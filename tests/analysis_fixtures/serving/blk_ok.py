@@ -1,0 +1,32 @@
+"""RES004 clean fixture: every blocking call carries a timeout (or is a
+non-blocking lookalike).  Parsed by graft-lint only."""
+import queue
+import threading
+
+_q: "queue.Queue" = queue.Queue()
+
+
+def drain_one():
+    try:
+        return _q.get(timeout=0.1)           # bounded
+    except queue.Empty:
+        return None
+
+
+def drain_now():
+    return _q.get_nowait()                   # non-blocking variant
+
+
+def wait_for_reply(entry, budget_s):
+    if not entry.done.wait(budget_s):        # positional timeout
+        return None
+    return entry.reply
+
+
+def stop_worker(thread: threading.Thread):
+    thread.join(timeout=5.0)                 # bounded
+
+
+def lookalikes(d: dict, parts):
+    # same attr names on non-blocking owners must not trip the rule
+    return d.get("key"), ",".join(parts)

@@ -731,6 +731,22 @@ def test_debug_profile_dump_and_compile_over_live_decode_stream(tmp_path):
                 raise AssertionError(f"request failed early: {first}")
             time.sleep(0.01)
 
+        # throttle the step executable to a wall-clock floor: on a fast
+        # host the bare tiny-LM step runs <1ms and the 900-step stream
+        # would finish INSIDE the profile window below.  A busy-wait (not
+        # sleep — the sampler would score the thread idle) keeps the
+        # engine thread attributable to the ambient decode-step phase
+        # while pinning the generation to a few seconds on any machine.
+        real_step = scorer._decoder._step
+
+        def throttled_step(*a, **k):
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 0.004:
+                pass
+            return real_step(*a, **k)
+
+        scorer._decoder._step = throttled_step
+
         # (a) dispatch-heavy stream: >= half the busy samples attribute to
         # the decode step loop by name
         status, rep = post_json(
@@ -771,15 +787,21 @@ def test_debug_profile_dump_and_compile_over_live_decode_stream(tmp_path):
         assert "runner.srv.prof.decode_step_paged" in \
             dump["compile"]["functions"], "dump lost the compile report"
 
-        # (d) the engine-thread resolve still lands the serving.request
-        # span + TTFT exemplar (satellite: the PR 13 attribution seam)
+        # (d) the preemption that dumped also DRAINS the server (ISSUE
+        # 16): the in-flight generation still resolves 200 — zero-drop —
+        # and only then does the listener stop.  The engine-thread
+        # resolve still lands the serving.request span + TTFT exemplar
+        # (the PR 13 attribution seam); with the HTTP plane gone by
+        # contract, read them from the in-process collector that backs
+        # ``/debug/slow``.
+        from mmlspark_tpu.observability.collector import get_collector
         assert done.wait(120) and first["res"][0] == 200
+        assert srv._drained.wait(60), "preemption hook never drained"
         trace_id = first["res"][2]["X-MMLSpark-Trace-Id"]
-        status, slow = post_json(srv.port, "/debug/slow?k=5", None,
-                                 method_get=True)
-        rows = json.loads(slow)["slowest"]
+        rows = get_collector(reg).slowest(k=5, name="serving.request",
+                                          server=srv._server_label)
         mine = [r for r in rows if r["traceId"] == trace_id]
-        assert mine, f"serving.request span missing from /debug/slow: {rows}"
+        assert mine, f"serving.request span missing from slowest: {rows}"
         assert mine[0]["verdict"] == "ok"
         assert mine[0]["ttft_s"] >= 0.0
         ex = reg.family("mmlspark_runner_ttft_seconds").labels(
@@ -819,12 +841,18 @@ def test_engine_thread_crash_dumps_via_excepthook_without_deadlock(tmp_path):
         dec.start()                     # engine thread picks up the stream
         assert h.done.wait(30), "client stranded by the crashed engine"
         assert h.status == "error"
+        # ignore atomic_write's same-directory ``.tmp-<pid>`` staging
+        # file: polling the bare listing can observe (and read) the
+        # in-flight temp before the rename publishes the dump
+        def _dumps():
+            return [n for n in os.listdir(tmp_path) if ".tmp-" not in n]
+
         deadline = time.monotonic() + 30
-        while not os.listdir(tmp_path):
+        while not _dumps():
             if time.monotonic() > deadline:
                 raise AssertionError("excepthook never dumped")
             time.sleep(0.01)
-        names = os.listdir(tmp_path)
+        names = _dumps()
         assert len(names) == 1 and "crash" in names[0]
         dump = json.load(open(tmp_path / names[0]))
         assert dump["trigger"] == "crash"

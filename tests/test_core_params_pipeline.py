@@ -102,5 +102,7 @@ def test_service_param():
 def test_telemetry_logged():
     from mmlspark_tpu.core.logging import recent_events
     AddConst().transform(df10())
-    evts = [e for e in recent_events() if e["className"] == "AddConst"]
+    # the event ring is shared fleet-wide: non-verb events (preemption,
+    # SLO burn, membership) carry no className — filter, don't index
+    evts = [e for e in recent_events() if e.get("className") == "AddConst"]
     assert evts and evts[-1]["method"] == "transform"

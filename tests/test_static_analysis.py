@@ -17,6 +17,7 @@ from mmlspark_tpu.analysis import (AnalysisEngine, BaselineEntry, Finding,
                                    ResilienceCoverageChecker,
                                    StageContractChecker, TracerSafetyChecker,
                                    TransferDisciplineChecker,
+                                   UnboundedBlockingChecker,
                                    UndeadlinedRetryChecker,
                                    load_baseline, main, rule_catalog,
                                    run_analysis, save_baseline,
@@ -53,6 +54,8 @@ PAIRS = [
      {"HOT001", "HOT002"}),
     (TransferDisciplineChecker, "parallel/cmp_bad.py", "parallel/cmp_ok.py",
      {"CMP001"}),
+    (UnboundedBlockingChecker, "serving/blk_bad.py", "serving/blk_ok.py",
+     {"RES004"}),
 ]
 
 

@@ -1,0 +1,681 @@
+"""Tail-tolerant serving fleet (ISSUE 16): dispatch hang watchdog +
+supervised engine recovery, zero-drop graceful drain, and retry budgets
+with hedged requests.
+
+Layered like the feature: deterministic FakeClock unit tests for the
+resilience primitives, real-socket single-server drain tests, and fleet
+drills (RoutingClient + TopologyService + chaos injectors) proving the
+end-to-end claims — a hung worker cannot capture client slots, a rolling
+restart drops zero requests, and a full outage cannot amplify offered
+load into a retry storm."""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.observability import MetricsRegistry
+from mmlspark_tpu.serving import (PipelineServer, RoutingClient,
+                                  TopologyService, WorkerServer)
+from mmlspark_tpu.utils.resilience import (FakeClock, RestartSupervisor,
+                                           RetryBudget, Watchdog)
+from tests.serving_helpers import Doubler
+
+
+def _counter(reg: MetricsRegistry, family: str, **labels) -> float:
+    """Sum a counter family's samples matching the given label subset."""
+    fam = reg.to_dict().get(family)
+    if not fam:
+        return 0.0
+    return sum(s["value"] for s in fam["samples"]
+               if all(s["labels"].get(k) == v for k, v in labels.items()))
+
+
+def _post(url, payload, timeout=10):
+    req = urllib.request.Request(url, data=json.dumps(payload).encode(),
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+class SlowDoubler(Doubler):
+    """Doubler with a per-batch sleep: keeps requests in flight long
+    enough for a drain/shed race to be observable."""
+
+    def __init__(self, delay_s: float = 0.05):
+        super().__init__()
+        self.delay_s = delay_s
+
+    def _transform(self, df):
+        time.sleep(self.delay_s)
+        return super()._transform(df)
+
+
+# ---------------------------------------------------------------------------
+# watchdog primitive (FakeClock — no threads, fully deterministic)
+# ---------------------------------------------------------------------------
+
+def test_watchdog_arms_heartbeats_and_trips_once_per_section():
+    clk = FakeClock()
+    trips = []
+    wd = Watchdog(stall_timeout_s=2.0, clock=clk,
+                  on_stall=lambda label, el: trips.append((label, el)))
+
+    assert wd.check() is False          # disarmed: nothing to observe
+    wd.arm("dispatch")
+    clk.advance(1.5)
+    assert wd.check() is False and wd.stalled_for() == pytest.approx(1.5)
+
+    wd.heartbeat()                      # progress mid-section resets clock
+    clk.advance(1.5)
+    assert wd.check() is False, "heartbeat must restart the stall clock"
+
+    clk.advance(1.0)                    # 2.5s since heartbeat: overrun
+    assert wd.check() is True
+    assert trips == [("dispatch", pytest.approx(2.5))]
+    assert wd.check() is True and len(trips) == 1, \
+        "on_stall fires once per armed section, later polls stay silent"
+    assert wd.trips == 1
+
+    wd.disarm()
+    assert wd.check() is False and wd.stalled_for() == 0.0
+
+    # re-arming opens a fresh section: the trip latch resets
+    wd.arm("dispatch#2")
+    clk.advance(3.0)
+    assert wd.check() is True
+    assert len(trips) == 2 and trips[1][0] == "dispatch#2"
+    assert wd.trips == 2
+
+
+def test_watchdog_section_contextmanager_and_raising_callback():
+    clk = FakeClock()
+    calls = []
+
+    def bad_hook(label, elapsed):
+        calls.append(label)
+        raise RuntimeError("hook crashed")
+
+    wd = Watchdog(stall_timeout_s=1.0, clock=clk, on_stall=bad_hook)
+    with wd.section("step"):
+        clk.advance(5.0)
+        assert wd.check() is True       # raising callback is swallowed
+        assert wd.check() is True       # ... and the detector keeps working
+    assert calls == ["step"]
+    assert wd.check() is False, "leaving the section disarms"
+    d = wd.as_dict()
+    assert d["armed"] is False and d["trips"] == 1
+
+
+def test_watchdog_monitor_thread_detects_real_stall():
+    fired = threading.Event()
+    wd = Watchdog(stall_timeout_s=0.05,
+                  on_stall=lambda label, el: fired.set())
+    wd.start(poll_interval_s=0.01)
+    try:
+        wd.arm("hung-dispatch")
+        assert fired.wait(5.0), "monitor thread never saw the stall"
+        assert wd.trips >= 1
+    finally:
+        wd.disarm()
+        wd.stop()
+    assert wd.start(poll_interval_s=0.01) is wd   # restartable
+    wd.stop()
+
+
+def test_watchdog_rejects_nonpositive_timeout():
+    with pytest.raises(ValueError):
+        Watchdog(stall_timeout_s=0.0)
+
+
+# ---------------------------------------------------------------------------
+# retry budget primitive
+# ---------------------------------------------------------------------------
+
+def test_retry_budget_ratio_accrual_and_denial():
+    b = RetryBudget(ratio=0.1, initial=0.0)
+    assert b.tokens() == 0.0
+    assert b.try_withdraw() is False and b.denied == 1
+    for _ in range(10):                 # 10 offered requests earn 1 token
+        b.deposit()
+    assert b.tokens() == pytest.approx(1.0)
+    assert b.try_withdraw() is True and b.granted == 1
+    assert b.try_withdraw() is False and b.denied == 2
+    d = b.as_dict()
+    assert d["granted"] == 1 and d["denied"] == 2
+
+
+def test_retry_budget_cold_start_burst_and_cap():
+    b = RetryBudget(ratio=0.5, cap=3.0)   # initial defaults to cap
+    assert b.tokens() == 3.0, "default initial is the cold-start burst"
+    for _ in range(100):
+        b.deposit()
+    assert b.tokens() == 3.0, "deposits never exceed the cap"
+    assert all(b.try_withdraw() for _ in range(3))
+    with pytest.raises(ValueError):
+        RetryBudget(ratio=-0.1)
+    with pytest.raises(ValueError):
+        RetryBudget(cap=0.0)
+
+
+# ---------------------------------------------------------------------------
+# restart supervisor primitive
+# ---------------------------------------------------------------------------
+
+def test_restart_supervisor_backoff_doubles_and_caps():
+    clk = FakeClock()
+    sup = RestartSupervisor(initial_backoff_s=0.5, backoff_cap_s=4.0,
+                            quarantine_stalls=99, clock=clk)
+    backoffs = [sup.note_failure("error") for _ in range(5)]
+    assert backoffs == [0.5, 1.0, 2.0, 4.0, 4.0], \
+        "exponential backoff must cap, not grow forever"
+    assert sup.retry_after_s() == pytest.approx(4.0)
+    clk.advance(4.0)
+    assert sup.retry_after_s() == 0.0
+    sup.note_success()                   # sustained health resets exponent
+    assert sup.note_failure("error") == pytest.approx(0.5)
+    assert sup.failures == 6 and not sup.quarantined
+
+
+def test_restart_supervisor_quarantines_repeated_stalls_in_window():
+    clk = FakeClock()
+    sup = RestartSupervisor(initial_backoff_s=0.1, backoff_cap_s=8.0,
+                            quarantine_stalls=3, quarantine_window_s=60.0,
+                            clock=clk)
+    # two stalls spaced wider than the window never quarantine
+    sup.note_failure("stall")
+    clk.advance(61.0)
+    sup.note_failure("stall")
+    assert not sup.quarantined
+    # ... but a third and fourth inside the window do (3 within 60s)
+    clk.advance(1.0)
+    sup.note_failure("stall")
+    assert not sup.quarantined
+    clk.advance(1.0)
+    sup.note_failure("stall")
+    assert sup.quarantined
+    assert sup.retry_after_s() == pytest.approx(8.0), \
+        "quarantine advertises the cap forever — the worker is evicted, " \
+        "not healed"
+    sup.note_success()
+    assert sup.quarantined, "note_success must not lift quarantine"
+    d = sup.as_dict()
+    assert d["quarantined"] is True and d["failures"] == 4
+
+
+def test_restart_supervisor_crash_loops_still_quarantine_only_on_stalls():
+    clk = FakeClock()
+    sup = RestartSupervisor(quarantine_stalls=2, clock=clk)
+    for _ in range(10):
+        sup.note_failure("error")
+        clk.advance(0.01)
+    assert not sup.quarantined, \
+        "plain crashes ride backoff; only stalls quarantine"
+
+
+# ---------------------------------------------------------------------------
+# runner stall telemetry: watchdog trip books the counter + postmortem dump
+# ---------------------------------------------------------------------------
+
+def test_runner_stall_watchdog_books_counter_and_flight_dump(tmp_path,
+                                                             monkeypatch):
+    from mmlspark_tpu.models import ModelRunner
+
+    monkeypatch.setenv("MMLSPARK_TPU_FLIGHT_DUMP_DIR", str(tmp_path))
+    reg = MetricsRegistry()
+    runner = ModelRunner(apply_fn=lambda v, x: x, variables={},
+                         name="stall.unit", batch_size=4, registry=reg)
+    clk = FakeClock()
+    chained = []
+    wd = runner.stall_watchdog(2.0, clock=clk,
+                               on_stall=lambda label, el:
+                               chained.append(label))
+    try:
+        wd.arm("decode-dispatch")
+        clk.advance(3.0)
+        assert wd.check() is True
+        assert chained == ["decode-dispatch"], \
+            "the caller's on_stall must chain after the telemetry"
+        assert _counter(reg, "mmlspark_runner_stalls_total",
+                        runner="stall.unit") == 1.0
+        dumps = list(tmp_path.glob("flightdump_*_stall.json"))
+        assert dumps, "a stall must leave a postmortem dump on disk"
+        assert json.loads(dumps[0].read_text())["trigger"] == "stall"
+    finally:
+        wd.stop()
+        reg._flight_recorder.close()
+
+
+# ---------------------------------------------------------------------------
+# supervised engine recovery + quarantine (continuous decode scorer)
+# ---------------------------------------------------------------------------
+
+def test_supervised_engine_recovery_backs_off_then_quarantines():
+    """An aborted engine rebuilds behind capped backoff (booked on
+    ``mmlspark_engine_restarts_total``); three stalls inside the window
+    quarantine the runner — ``serving_healthy`` flips False so /health
+    turns 503 and the fleet's probes evict the worker."""
+    from mmlspark_tpu.models import ModelRunner
+    from mmlspark_tpu.models.runner import EngineUnavailable
+    from tests.test_model_runner import _tiny_lm
+
+    clk = FakeClock()
+    reg = MetricsRegistry()
+    mod, variables = _tiny_lm(layers=1)
+    runner = ModelRunner(module=mod, variables=variables, name="sup.cont",
+                         registry=reg)
+    sup = RestartSupervisor(initial_backoff_s=0.5, backoff_cap_s=8.0,
+                            quarantine_stalls=3, quarantine_window_s=300.0,
+                            clock=clk)
+    scorer = runner.scorer(mode="decode", continuous=True, slots=2,
+                           prompt_bucket=8, max_new_tokens=2, page_size=4,
+                           supervisor=sup)
+    try:
+        for round_no in (1, 2):
+            dec = scorer._ensure_decoder()
+            dec._stall_abort("dispatch", 99.0)   # the watchdog's teardown
+            # first observer books the death; backoff gates the rebuild
+            with pytest.raises(EngineUnavailable) as exc:
+                scorer._ensure_decoder()
+            assert exc.value.shed_reason == "engine_restarting"
+            assert exc.value.shed is True
+            clk.advance(exc.value.retry_after_s + 0.1)
+            assert scorer.serving_healthy, \
+                "backoff alone must not flip health"
+        # the second rebuild has not happened yet — it books when the next
+        # request actually reopens the engine
+        assert _counter(reg, "mmlspark_engine_restarts_total",
+                        runner="sup.cont") == 1.0
+        dec = scorer._ensure_decoder()
+        assert _counter(reg, "mmlspark_engine_restarts_total",
+                        runner="sup.cont") == 2.0
+        # third stall inside the window: quarantine, not another restart
+        dec._stall_abort("dispatch", 99.0)
+        with pytest.raises(EngineUnavailable) as exc:
+            scorer._ensure_decoder()
+        assert exc.value.shed_reason == "engine_quarantined"
+        assert scorer.serving_healthy is False
+        assert sup.quarantined
+        # a quarantined scorer flips the server's /health to 503
+        srv = PipelineServer(scorer, port=0, mode="continuous").start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as h:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/health", timeout=10)
+            assert h.value.code == 503
+            assert h.value.read() == b"unhealthy"
+        finally:
+            srv.stop()
+    finally:
+        scorer.continuous_close()
+
+
+# ---------------------------------------------------------------------------
+# graceful drain: single server
+# ---------------------------------------------------------------------------
+
+def test_pipeline_server_drain_is_zero_drop_and_sheds_newcomers():
+    """In-flight requests finish; new admissions shed 503 ``draining`` +
+    ``Connection: close``; the exactly-once stats invariant holds at the
+    end; the drain books its duration histogram."""
+    reg = MetricsRegistry()
+    srv = PipelineServer(SlowDoubler(0.2), port=0, registry=reg,
+                         micro_batch_interval_ms=1).start()
+    results, fails = [], []
+
+    def fire(i):
+        try:
+            results.append((i, _post(srv.address, i, timeout=30)))
+        except Exception as e:  # noqa: BLE001
+            fails.append((i, e))
+
+    threads = [threading.Thread(target=fire, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:   # all four admitted: the drain's
+        with srv.stats.lock:             # job is the in-FLIGHT ledger, not
+            if srv.stats.received >= 4:  # a race against arrivals
+                break
+        time.sleep(0.005)
+
+    ok = srv.drain(timeout_s=30.0)
+    for t in threads:
+        t.join(timeout=30)
+    assert ok is True, "every in-flight request must resolve in budget"
+    assert not fails, f"drain dropped in-flight requests: {fails}"
+    assert sorted(v for _, v in results) == [0.0, 2.0, 4.0, 6.0]
+
+    # a drained server is stopped: fresh connections are refused
+    with pytest.raises(Exception):  # noqa: PT011 — refused/reset
+        _post(srv.address, 1, timeout=5)
+
+    s = srv.stats.as_dict()
+    assert s["received"] == s["replied"] + s["errors"] + s["shed"], \
+        "exactly-once accounting must survive the drain"
+    assert s["errors"] == 0
+    fam = reg.to_dict()["mmlspark_serving_drain_seconds"]
+    assert sum(s["count"] for s in fam["samples"]) == 1, \
+        "the drain must book exactly one duration observation"
+    assert srv.drain(timeout_s=5.0) is True, \
+        "drain is idempotent: late callers share the verdict"
+
+
+def test_admin_drain_endpoint_and_draining_shed_headers():
+    """POST /admin/drain flips the server into draining: /health 503s,
+    new scores shed 503 ``draining`` with Retry-After + Connection:
+    close, in-flight work still completes, and the server then stops."""
+    srv = PipelineServer(SlowDoubler(1.0), port=0, micro_batch_interval_ms=1).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    slot = {}
+
+    def long_request():
+        try:
+            slot["reply"] = _post(srv.address, 21, timeout=30)
+        except Exception as e:  # noqa: BLE001
+            slot["error"] = e
+
+    t = threading.Thread(target=long_request)
+    t.start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        with srv.stats.lock:
+            if srv._pending > 0:
+                break
+        time.sleep(0.005)
+
+    got = _post(f"{base}/admin/drain", {"timeout_s": 30.0})
+    assert got["draining"] is True and got["already_draining"] is False
+    assert srv.draining
+
+    # health flips immediately — probes stop sending fresh traffic
+    with pytest.raises(urllib.error.HTTPError) as h:
+        urllib.request.urlopen(f"{base}/health", timeout=10)
+    assert h.value.code == 503 and h.value.read() == b"draining"
+
+    # a newcomer is shed with the go-away trio: 503 + Retry-After +
+    # Connection: close (keep-alive to a dying socket helps nobody)
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(srv.address, 1, timeout=10)
+    assert exc.value.code == 503
+    assert int(exc.value.headers["Retry-After"]) >= 1
+    assert exc.value.headers.get("Connection", "").lower() == "close"
+    assert "draining" in json.loads(exc.value.read().decode())["error"]
+
+    # a second drain call reports already_draining (idempotent endpoint)
+    got2 = _post(f"{base}/admin/drain", {}, timeout=10)
+    assert got2["already_draining"] is True
+
+    t.join(timeout=30)
+    assert slot.get("reply") == 42.0, \
+        f"in-flight request must complete through the drain: {slot}"
+    # ... and the server wound itself down after the ledger emptied
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not srv._drained.is_set():
+        time.sleep(0.01)
+    assert srv._drained.is_set()
+    s = srv.stats.as_dict()
+    assert s["received"] == s["replied"] + s["errors"] + s["shed"]
+    assert s["shed"] >= 1 and s["errors"] == 0
+
+
+def test_admin_drain_rejects_malformed_timeout():
+    srv = PipelineServer(Doubler(), port=0).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(f"http://127.0.0.1:{srv.port}/admin/drain",
+                  {"timeout_s": "soon"})
+        assert exc.value.code == 400
+        assert not srv.draining, "a bad request must not start a drain"
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# fleet: draining membership state, Retry-After cooldown, budgets, hedging
+# ---------------------------------------------------------------------------
+
+def test_routing_client_skips_draining_workers_and_membership_shows_state():
+    svc = TopologyService(probe_interval_s=None).start()
+    workers = [WorkerServer(Doubler(), server_id=f"w{i}",
+                            driver_address=svc.address, port=0).start()
+               for i in range(2)]
+    try:
+        client = RoutingClient(svc.address, refresh_s=0.0)
+        for i in range(4):
+            assert client.request(i) == 2 * i
+        # publish draining for w0: a same-generation re-register — a
+        # heartbeat row replacement, not a membership epoch event
+        _post(f"{svc.address}/register",
+              workers[0]._registration(state="draining"))
+        mem = svc.membership()
+        assert mem["workers"]["w0"]["state"] == "draining"
+        assert mem["workers"]["w1"]["state"] == "up"
+
+        before = workers[0].server.stats.as_dict()["received"]
+        for i in range(6):
+            assert client.request(i) == 2 * i
+        after = workers[0].server.stats.as_dict()["received"]
+        assert after == before, \
+            "a draining worker must not be picked while others are up"
+        # ... but remains the last resort when it is all that's left
+        workers[1].server.stop()
+        assert client.request(3, retries=2) == 6
+    finally:
+        for w in workers:
+            w.stop()
+        svc.stop()
+
+
+def test_routing_client_honors_retry_after_shed_cooldown():
+    """A 503 shed carrying Retry-After puts the worker on a pick-time
+    cooldown: no breaker damage, and the very next requests route around
+    it without burning a failover hop each time."""
+    reg = MetricsRegistry()
+    svc = TopologyService(probe_interval_s=None).start()
+    shedding = WorkerServer(Doubler(), server_id="a-shed",
+                            driver_address=svc.address, port=0,
+                            shed_retry_after_s=30.0).start()
+    healthy = WorkerServer(Doubler(), server_id="b-ok",
+                           driver_address=svc.address, port=0).start()
+    try:
+        # flip the shedding worker's admission gate without stopping its
+        # listener: every request it sees sheds 503 "draining"+Retry-After
+        shedding.server._draining.set()
+        client = RoutingClient(svc.address, refresh_s=0.0, registry=reg)
+        for i in range(8):
+            assert client.request(i) == 2 * i, \
+                "sheds must fail over transparently"
+        shed_n = _counter(reg, "mmlspark_routing_requests_total",
+                          worker="a-shed", result="shed")
+        assert shed_n >= 1, "the shed verdict must be booked as shed"
+        assert _counter(reg, "mmlspark_routing_requests_total",
+                        worker="a-shed", result="fail") == 0, \
+            "a shed is backpressure, not a fault"
+        assert shed_n <= 2, \
+            "after the first shed the cooldown must keep a-shed out of " \
+            "the pick rotation"
+        assert client._cooldown.get("a-shed", 0) > client.clock()
+        b = client.breakers.get("a-shed")
+        assert b is None or b.state == "closed", \
+            "Retry-After sheds must never charge the breaker"
+        assert _counter(reg, "mmlspark_routing_requests_total",
+                        worker="b-ok", result="ok") == 8.0
+    finally:
+        shedding.server._draining.clear()
+        shedding.stop()
+        healthy.stop()
+        svc.stop()
+
+
+def test_retry_budget_bounds_amplification_under_full_outage():
+    """ISSUE 16 acceptance: with every worker down, attempted exchanges
+    stay <= (1 + ratio) * offered — proven from the metrics, not the
+    code: routed-exchange total vs granted/denied budget counters."""
+    reg = MetricsRegistry()
+    svc = TopologyService(probe_interval_s=None).start()
+    try:
+        # two registered-but-dead workers: connects are refused instantly
+        for sid in ("d0", "d1"):
+            _post(f"{svc.address}/register",
+                  {"server_id": sid, "host": "127.0.0.1", "port": 9})
+        budget = RetryBudget(ratio=0.1, initial=0.0)
+        client = RoutingClient(svc.address, refresh_s=3600.0, registry=reg,
+                               failover_retries=3, retry_budget=budget)
+        offered = 30
+        for i in range(offered):
+            with pytest.raises(RuntimeError):
+                client.request(i, timeout=2)
+        attempted = _counter(reg, "mmlspark_routing_requests_total")
+        granted = _counter(reg, "mmlspark_retry_budget_granted_total")
+        denied = _counter(reg, "mmlspark_retry_budget_denied_total")
+        assert attempted == offered + granted, \
+            "every exchange is a first try or a granted retry"
+        assert attempted <= (1 + budget.ratio) * offered, \
+            f"retry amplification {attempted}/{offered} exceeds the " \
+            f"budget's (1 + {budget.ratio}) bound"
+        assert granted == budget.granted and granted >= 1, \
+            "the budget must still allow SOME failover (not a zero gate)"
+        assert denied >= 1, "a full outage must exhaust the budget"
+    finally:
+        svc.stop()
+
+
+def test_hedged_request_escapes_hung_worker():
+    """The tail-tolerance core claim: with hedging on, a request routed
+    to a black-holed worker completes via the speculative duplicate in
+    ~the p95 delay instead of hanging until the transport timeout."""
+    from mmlspark_tpu.testing.chaos import HungWorkerInjector
+
+    reg = MetricsRegistry()
+    svc = TopologyService(probe_interval_s=None).start()
+    workers = [WorkerServer(Doubler(), server_id=f"w{i}",
+                            driver_address=svc.address, port=0).start()
+               for i in range(2)]
+    hung = HungWorkerInjector().start()
+    try:
+        client = RoutingClient(svc.address, refresh_s=0.0, registry=reg,
+                               hedge=True, hedge_min_samples=4,
+                               hedge_min_delay_s=0.05)
+        for i in range(8):     # teach the hedger the healthy latency
+            assert client.request(i) == 2 * i
+        assert client._hedge_delay_s() is not None
+
+        hung.register(svc.address, server_id="z-hung")
+        t0 = time.monotonic()
+        oks = 0
+        for i in range(12):    # round robin lands several on the hole
+            assert client.request(i, timeout=20) == 2 * i
+            oks += 1
+        elapsed = time.monotonic() - t0
+        assert oks == 12
+        assert hung.accepted >= 1, \
+            "the drill never exercised the hung worker"
+        assert _counter(reg, "mmlspark_hedges_total",
+                        outcome="hedge_won") >= 1, \
+            "escapes from the hung worker must be hedge wins"
+        assert elapsed < 12 * 2.0, \
+            f"hedging failed to cut the hung tail: {elapsed:.1f}s"
+    finally:
+        hung.stop()
+        for w in workers:
+            w.stop()
+        svc.stop()
+
+
+def test_hung_worker_fails_probes_and_gets_evicted():
+    """Eviction end to end: the injector hangs /health exactly like it
+    hangs /score, so the driver's prober times out and evicts it after
+    ``evict_after`` consecutive failures."""
+    from mmlspark_tpu.testing.chaos import HungWorkerInjector
+
+    svc = TopologyService(probe_interval_s=None, probe_timeout_s=0.2,
+                          evict_after=2).start()
+    worker = WorkerServer(Doubler(), server_id="w0",
+                          driver_address=svc.address, port=0).start()
+    hung = HungWorkerInjector().start()
+    try:
+        hung.register(svc.address, server_id="z-hung")
+        assert set(svc.routing_table()) == {"w0", "z-hung"}
+        assert svc.probe_once() == []          # one strike: still in
+        assert set(svc.routing_table()) == {"w0", "z-hung"}
+        assert svc.probe_once() == ["z-hung"]  # two strikes: evicted
+        assert set(svc.routing_table()) == {"w0"}
+    finally:
+        hung.stop()
+        worker.stop()
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# rolling-restart drill: zero dropped requests across a fleet restart
+# ---------------------------------------------------------------------------
+
+def test_rolling_restart_drill_drops_zero_requests():
+    """ISSUE 16 acceptance: drain + restart each worker in turn under
+    sustained client load; every request completes (failing over around
+    the drains), per-worker stats stay exactly-once, and the loadgen
+    ``max_failed: 0`` gate passes on the client-side ledger."""
+    from mmlspark_tpu.serving.loadgen import check_gates
+
+    svc = TopologyService(probe_interval_s=None).start()
+    workers = {i: WorkerServer(SlowDoubler(0.002), server_id=f"w{i}",
+                               driver_address=svc.address, port=0,
+                               micro_batch_interval_ms=1).start() for i in range(2)}
+    client = RoutingClient(svc.address, refresh_s=0.2, failover_retries=3)
+    n_clients, per_client = 3, 40
+    ok = [0] * n_clients
+    failures: list = []
+    drained_stats: list = []
+
+    def fire(c):
+        for i in range(per_client):
+            try:
+                assert client.request(i, timeout=30) == 2 * i
+                ok[c] += 1
+            except Exception as e:  # noqa: BLE001
+                failures.append((c, i, repr(e)))
+
+    threads = [threading.Thread(target=fire, args=(c,))
+               for c in range(n_clients)]
+    for t in threads:
+        t.start()
+    try:
+        for i in (0, 1):
+            time.sleep(0.15)           # let load land on both workers
+            w = workers[i]
+            assert w.drain(timeout_s=30.0) is True
+            s = w.server.stats.as_dict()
+            drained_stats.append(s)
+            # the worker returns at generation+1 (the WorkerKiller move)
+            workers[i] = WorkerServer(SlowDoubler(0.002),
+                                      server_id=f"w{i}",
+                                      driver_address=svc.address, port=0,
+                                      micro_batch_interval_ms=1,
+                                      generation=w.generation + 1).start()
+    finally:
+        for t in threads:
+            t.join(timeout=120)
+
+    try:
+        assert not failures, \
+            f"rolling restart dropped requests: {failures[:5]}"
+        intended = float(n_clients * per_client)
+        verdict = check_gates({"max_failed": 0},
+                              {"intended": intended,
+                               "completed": float(sum(ok)),
+                               "non_2xx": 0.0})
+        assert verdict["passed"], verdict["failures"]
+        for s in drained_stats:
+            assert s["received"] == s["replied"] + s["errors"] + s["shed"], \
+                f"exactly-once accounting broke across the drain: {s}"
+            assert s["errors"] == 0, s
+        assert all(s["replied"] > 0 for s in drained_stats), \
+            "the drill never exercised the drained workers"
+    finally:
+        for w in workers.values():
+            w.stop()
+        svc.stop()

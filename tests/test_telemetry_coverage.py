@@ -595,6 +595,94 @@ def test_profiler_recorder_surface_books_metrics():
     assert dist_mod.TOPOLOGY_ENDPOINTS["GET"].count("/fleet/dump") == 1
 
 
+def test_tail_tolerance_surface_books_metrics():
+    """ISSUE 16 coverage: the tail-tolerance plane acts exactly when the
+    fleet is at its worst — a hung dispatch, a draining worker, a full
+    outage — so its accounting must be un-droppable.  Source-level: the
+    stall watchdog books the stall counter and fires the stall-triggered
+    postmortem dump; the continuous resolve path sheds
+    ``shed_engine_stall``; the supervised rebuild books the restart
+    counter; the server's drain observes its duration histogram and sheds
+    ``draining`` with a connection teardown; the worker publishes the
+    draining membership state; the routing client books shed cooldowns,
+    hedge outcomes and budget grants/denials.  Live: PipelineServer
+    construction registers the drain histogram (and ModelRunner the
+    stall/restart families), RoutingClient construction registers the
+    hedge + budget families — the series exist before the first incident,
+    so dashboards and alerts can be built against a healthy fleet."""
+    from mmlspark_tpu.observability.metrics import MetricsRegistry
+    from mmlspark_tpu.serving import PipelineServer, RoutingClient
+    from mmlspark_tpu.serving import distributed as dist_mod
+    from mmlspark_tpu.serving import server as server_mod
+    from mmlspark_tpu.utils import resilience
+
+    # runner side (source-only: importing the models package costs a jax
+    # import, which this sweep already pays elsewhere)
+    from mmlspark_tpu.models import runner as runner_mod
+    wd_src = inspect.getsource(runner_mod.ModelRunner.stall_watchdog)
+    assert "_c_stalls" in wd_src, "stall trip lost its counter"
+    assert 'trigger="stall"' in wd_src, \
+        "stall trip lost the flight-recorder postmortem dump"
+    assert "mmlspark_runner_stalls_total" in inspect.getsource(
+        runner_mod.ModelRunner.__init__), \
+        "stall family no longer registered at runner construction"
+    submit_src = inspect.getsource(
+        runner_mod._RunnerScorer._continuous_submit)
+    assert 'verdict="shed_engine_stall"' in submit_src, \
+        "a stall-killed request must shed 503, not error 500"
+    ensure_src = inspect.getsource(runner_mod._RunnerScorer._ensure_decoder)
+    assert "_c_restarts.inc" in ensure_src and \
+        "note_failure" in ensure_src, \
+        "supervised rebuild lost its restart booking"
+    assert "serving_healthy = False" in ensure_src, \
+        "quarantine no longer flips the health signal probes evict on"
+
+    # server side: drain books its histogram; draining sheds tear the
+    # connection down; /health reads both drain + engine health signals
+    drain_src = inspect.getsource(server_mod.PipelineServer.drain)
+    assert "_h_drain.observe" in drain_src
+    handler_src = inspect.getsource(server_mod.PipelineServer._make_handler)
+    assert "/admin/drain" in handler_src
+    assert 'shed_reason == "draining"' in handler_src and \
+        "close_connection" in handler_src
+    assert "serving_healthy" in handler_src, \
+        "/health no longer reads the engine-quarantine signal"
+    assert 'state="draining"' in inspect.getsource(
+        dist_mod.WorkerServer.drain), \
+        "worker drain no longer publishes the draining membership state"
+
+    # routing client: shed cooldown, hedge outcomes, budget counters
+    attempt_src = inspect.getsource(dist_mod.RoutingClient._attempt)
+    assert 'result="shed"' in attempt_src and \
+        "_shed_retry_after" in attempt_src
+    hedge_src = inspect.getsource(dist_mod.RoutingClient._hedged_exchange)
+    for outcome in ("hedge_won", "primary_won", "both_failed",
+                    "budget_denied", "no_candidate"):
+        assert f'"{outcome}"' in hedge_src, \
+            f"hedge accounting lost outcome={outcome}"
+    request_src = inspect.getsource(dist_mod.RoutingClient.request)
+    assert "deposit()" in request_src and "try_withdraw()" in request_src
+    # the budget's own ledger backs the metrics
+    assert "granted" in inspect.getsource(
+        resilience.RetryBudget.try_withdraw)
+
+    # live: construction registers every family up front
+    reg = MetricsRegistry()
+    srv = PipelineServer(lambda df: df, registry=reg)  # never started
+    try:
+        assert reg.family("mmlspark_serving_drain_seconds") is not None, \
+            "PipelineServer no longer registers the drain histogram"
+    finally:
+        reg._flight_recorder.close()   # uninstall the process crash hooks
+    reg2 = MetricsRegistry()
+    RoutingClient("http://127.0.0.1:1", registry=reg2)  # never used
+    for family in ("mmlspark_hedges_total",
+                   "mmlspark_retry_budget_granted_total",
+                   "mmlspark_retry_budget_denied_total"):
+        assert reg2.family(family) is not None, \
+            f"RoutingClient no longer registers {family}"
+
+
 def test_topology_endpoint_sweep():
     """Every HTTP endpoint the TopologyService handler serves must appear
     in the declared ``TOPOLOGY_ENDPOINTS`` table (and vice versa): a new
