@@ -620,6 +620,15 @@ def phase_runner(n=2000, hw=32, batch=128, reps=3, vocab=512, dec_batch=8,
         return sum(getattr(w, "compiles", 0) for w in dec._wrappers
                    if "decode_step" in getattr(w, "name", ""))
 
+    # attribution bracket (ISSUE 17): snapshot the useful-vs-wasted token
+    # ledger and device-seconds counters around the measured A/B so the
+    # round artifact carries goodput%% and device-cost-per-1k-tokens for
+    # exactly the work the RUNNER_CONT numbers describe
+    from mmlspark_tpu.observability.attribution import OUTCOMES
+    att0 = {o: dec._c_tok_outcome.value(outcome=o) for o in OUTCOMES}
+    dev_s0 = dec._c_device_s.value()
+    gen0 = dec._c_decode_tokens.value
+
     # median of `reps` passes per engine (same protocol as the other
     # arms: single ~1s walls on this shared box swing 3x with neighbor
     # load, and the RATIO is the acceptance number)
@@ -639,6 +648,22 @@ def phase_runner(n=2000, hw=32, batch=128, reps=3, vocab=512, dec_batch=8,
         _log(f"[bench] runner continuous tokens/s {c_rates[-1]:.1f}")
     c_rates.sort()
     c_tps = c_rates[len(c_rates) // 2]
+    # goodput + device cost over the bracket: useful share of every token
+    # cell the ledger classified, and device-seconds per 1k real generated
+    # tokens (the /fleet/capacity per-class number's bench ground truth)
+    att = {o: dec._c_tok_outcome.value(outcome=o) - att0[o] for o in OUTCOMES}
+    g_useful = att["useful"]
+    g_wasted = sum(v for o, v in att.items() if o != "useful")
+    goodput_pct = 100.0 * g_useful / max(g_useful + g_wasted, 1e-9)
+    dev_s = dec._c_device_s.value() - dev_s0
+    gen_tokens = dec._c_decode_tokens.value - gen0
+    dev_per_1k = 1000.0 * dev_s / max(gen_tokens, 1e-9)
+    _log(f"[bench] runner goodput ledger: useful {g_useful:.0f} wasted "
+         f"{g_wasted:.0f} by-outcome "
+         f"{ {o: round(v) for o, v in att.items() if v} } "
+         f"device_s {dev_s:.3f} over {gen_tokens:.0f} tokens")
+    print(f"RUNNER_GOODPUT {goodput_pct} {dev_per_1k} {int(bool(proxy))}",
+          flush=True)
     # device work per useful token is the machine-independent half of the
     # story: the ticked drain burns slowest-member padding steps (every
     # step at full batch width) and full-width prefills, while the
@@ -1242,6 +1267,15 @@ def _record_runner(got: dict) -> bool:
             _note("runner", f"continuous/ticked {ct[2]:.3f} below the "
                             "1.5x on-chip gate")
         ok = True
+    gp = got.get("RUNNER_GOODPUT")
+    if gp and not isinstance(gp, str) and len(gp) >= 2:
+        # goodput & cost attribution (ISSUE 17): useful-token share and
+        # device-seconds per 1k generated tokens over the continuous A/B
+        # bracket — the bench ground truth the /fleet/capacity per-class
+        # cost number is judged against (agreement gate lives in tests)
+        ex["decode_goodput_pct"] = round(gp[0], 2)
+        ex["decode_device_s_per_1k_tokens"] = round(gp[1], 4)
+        ok = True
     return ok
 
 
@@ -1457,7 +1491,8 @@ def _run_measured_phases(tpu_ok: bool, cpu_rps: float) -> None:
         # the generative-serving number).
         got = _collect_multi(_spawn("runner", _tpu_env()),
                              ("RUNNER_AB", "RUNNER_DECODE", "RUNNER_PAGED",
-                              "RUNNER_CONT", "PHASE_METRICS"),
+                              "RUNNER_CONT", "RUNNER_GOODPUT",
+                              "PHASE_METRICS"),
                              idle=600, hard=1100)
         _record_phase_metrics("runner", got)
         if not _record_runner(got):
@@ -1494,7 +1529,8 @@ def _run_measured_phases(tpu_ok: bool, cpu_rps: float) -> None:
     if "runner_vs_legacy" not in RESULT["extras"]:
         got = _collect_multi(_spawn("runner", _cpu_env(), ["--proxy", "1"]),
                              ("RUNNER_AB", "RUNNER_DECODE", "RUNNER_PAGED",
-                              "RUNNER_CONT", "PHASE_METRICS"),
+                              "RUNNER_CONT", "RUNNER_GOODPUT",
+                              "PHASE_METRICS"),
                              idle=500, hard=900)
         _record_phase_metrics("runner", got)
         if not _record_runner(got):

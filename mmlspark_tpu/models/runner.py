@@ -200,7 +200,8 @@ class PagePool:
     OPS = ("allocate", "extend", "free", "denied")
 
     def __init__(self, module=None, num_pages: int = 0, page_size: int = 64,
-                 *, name: str = "pool", registry=None):
+                 *, name: str = "pool", registry=None,
+                 clock: Callable[[], float] = time.monotonic):
         if num_pages < 2:
             raise ValueError(f"num_pages {num_pages} < 2: page 0 is the "
                              "reserved trash page, so a usable pool needs "
@@ -244,6 +245,18 @@ class PagePool:
             "mmlspark_runner_page_pool_high_water_pages",
             "max KV pages ever simultaneously held",
             labels=("runner", "page_size"))
+        # page-seconds integral (ISSUE 17): pages held x wall time,
+        # integrated exactly at the alloc/extend/free edges — the memory
+        # half of the per-request cost ledger, and the pool-level total
+        # the per-request integrals must sum to
+        self._clock = clock
+        self._page_seconds = 0.0
+        self._t_integral = self._clock()
+        self._c_pagesec = reg.counter(
+            "mmlspark_runner_page_seconds_total",
+            "KV page-seconds consumed (pages held x wall time, integrated "
+            "at pool-op edges)", labels=("runner", "page_size")).labels(
+                runner=name, page_size=str(self.page_size))
         self._book("allocate", 0)   # gauges live from construction
 
     # ---------------------------------------------------------- accounting
@@ -262,6 +275,23 @@ class PagePool:
     def occupancy_pct(self) -> float:
         return 100.0 * self.pages_in_use() / max(self.capacity, 1)
 
+    def _integrate_locked(self) -> None:
+        """Advance the page-seconds integral to now (called under the pool
+        lock, BEFORE the free-list mutation — the interval just ended was
+        held at the pre-edge page count)."""
+        now = self._clock()
+        delta = self.pages_in_use() * max(0.0, now - self._t_integral)
+        self._t_integral = now
+        if delta > 0:
+            self._page_seconds += delta
+            self._c_pagesec.inc(delta)
+
+    def page_seconds(self) -> float:
+        """Cumulative pages-held x wall-time integral, current to now."""
+        with self._cond:
+            self._integrate_locked()
+            return self._page_seconds
+
     def _book(self, op: str, n: int) -> None:
         """Book one pool operation: the op counter plus the occupancy and
         high-water gauges (called under the pool lock)."""
@@ -279,6 +309,7 @@ class PagePool:
         size)`` per sequence).  Raises when the budget is exhausted —
         admission control, not silent overcommit."""
         with self._cond:
+            self._integrate_locked()
             if n > len(self._free):
                 # book the refusal before raising: the denied outcome is
                 # the admission-control signal dashboards alert on
@@ -306,6 +337,7 @@ class PagePool:
             raise ValueError(f"free() of invalid page in {pages} "
                              "(page 0 is the reserved trash page)")
         with self._cond:
+            self._integrate_locked()
             self._free.extend(pages)
             self._book("free", len(pages))
 
@@ -353,7 +385,8 @@ class PagePool:
                     "page(s) held, borrowed="
                     f"{self._borrowed}) — wait for in-flight decodes")
         pool = PagePool(self.module, num_pages, self.page_size,
-                        name=self._name, registry=self._registry)
+                        name=self._name, registry=self._registry,
+                        clock=self._clock)
         pool.auto_sized = self.auto_sized
         return pool
 
@@ -475,6 +508,10 @@ class ModelRunner:
         reg.gauge("mmlspark_runner_page_pool_high_water_pages",
                   "max KV pages ever simultaneously held",
                   labels=("runner", "page_size"))
+        reg.counter("mmlspark_runner_page_seconds_total",
+                    "KV page-seconds consumed (pages held x wall time, "
+                    "integrated at pool-op edges)",
+                    labels=("runner", "page_size"))
         # continuous-engine surface (ISSUE 13): families registered at
         # construction so the telemetry sweep gates on them even for
         # runners that never open a decode stream; ContinuousDecoder binds
@@ -503,6 +540,13 @@ class ModelRunner:
             "mmlspark_engine_restarts_total",
             "supervised decode-engine rebuilds after an abort/stall",
             labels=("runner",))
+        # goodput/cost-attribution surface (ISSUE 17): the useful-vs-
+        # wasted token ledger plus the amortized device-seconds counter —
+        # all host-side accounting, never a compile key
+        from ..observability.attribution import attribution_instruments
+        _att = attribution_instruments(reg)
+        self._c_tok_outcome = _att["tokens"]
+        self._c_device_s = _att["device"]
         #: (device key, page size) -> shared PagePool for paged decode
         self._pools: Dict[Tuple, PagePool] = {}
         #: resolved geometry of the most recent decode (DecodeResult.extras)
@@ -970,6 +1014,9 @@ class ModelRunner:
         finished[B:] = True
         steps = 0
         real_tokens = 0
+        #: per-row unfrozen emissions — the useful-vs-wasted ledger needs
+        #: a denied row's pre-denial tokens attributable (host-side only)
+        row_tokens = np.zeros(B, np.int64)
         #: row -> tokens emitted when its pool extend was DENIED (ISSUE 13
         #: bugfix: a budgeted pool exhausting mid-decode freezes the row and
         #: yields a clean partial result instead of raising out of the loop)
@@ -1039,6 +1086,7 @@ class ModelRunner:
                 # B * n_generated charge inflated fleet tokens/sec and the
                 # autoscale signal on early-finishing batches)
                 real_tokens += B - int(finished[:B].sum())
+                row_tokens += ~finished[:B]
                 out_tokens[:, t] = tok
                 if paged and eos_id is not None and not collect_logits:
                     # free on eos: pages return to the pool mid-flight; the
@@ -1151,12 +1199,36 @@ class ModelRunner:
             out_tokens[b, cut:] = eos_id if eos_id is not None else 0
         self._c_decode_tokens.inc(real_tokens)
         self._c_rows["decode"].inc(B)
+        # useful-vs-wasted ledger (ISSUE 17): every cell of the padded
+        # batch emitted this call lands in exactly one outcome bucket, so
+        # useful + wasted == B_b x iterations — a conservation law, not an
+        # estimate.  Denied rows' pre-denial tokens were real device work
+        # the caller only received truncated; pad cells cover bucket
+        # padding AND frozen rows still riding the fused step.
+        denied_tokens = int(sum(int(row_tokens[b]) for b in denied_at))
+        useful_tokens = int(real_tokens) - denied_tokens
+        pad_cells = B_b * n_generated - int(real_tokens)
+        if useful_tokens:
+            self._c_tok_outcome.inc(useful_tokens, outcome="useful")
+        if denied_tokens:
+            self._c_tok_outcome.inc(denied_tokens, outcome="denied_row")
+        if pad_cells:
+            self._c_tok_outcome.inc(pad_cells, outcome="pad_row")
+        # attributed device-seconds: host-observed step wall time (enqueue
+        # + the sampled residual device wait) — the cost denominator the
+        # capacity model divides tokens into
+        device_s_attr = dispatch_s_total + device_s_total
+        self._c_device_s.inc(device_s_attr)
         extras: Dict[str, Any] = {
             "kv_layout": "paged" if paged else "dense",
             "real_tokens": real_tokens,
             "batch_bucket": B_b,
             "dispatch_s": round(dispatch_s_total, 6),
             "device_s": round(device_s_total, 6),
+            "attribution": {"useful": useful_tokens,
+                            "denied_row": denied_tokens,
+                            "pad_row": pad_cells,
+                            "device_s_attributed": round(device_s_attr, 6)},
         }
         # one span per decode call carrying the split (never per token —
         # the export ring is bounded); joins the ambient trace when the
@@ -1270,7 +1342,7 @@ class StreamHandle:
 
     __slots__ = ("prompt", "length", "max_new_tokens", "deadline_s",
                  "on_done", "slot", "tokens", "status", "done",
-                 "t_submit_s", "t_first_s", "pages", "trace_id")
+                 "t_submit_s", "t_first_s", "pages", "trace_id", "cost")
 
     def __init__(self, prompt: np.ndarray, length: int, max_new_tokens: int,
                  deadline_s: Optional[float], on_done: Optional[Callable],
@@ -1293,6 +1365,9 @@ class StreamHandle:
         self.t_submit_s = 0.0
         self.t_first_s: Optional[float] = None
         self.pages: List[int] = []
+        # per-request cost ledger (ISSUE 17) — attached at submit; engine
+        # edges mutate it, the terminal outcome classifies its tokens
+        self.cost = None
 
     @property
     def ttft_s(self) -> Optional[float]:
@@ -1464,6 +1539,14 @@ class ContinuousDecoder:
             "mmlspark_runner_ttft_seconds",
             "submit-to-first-token latency of continuous decode",
             labels=("runner",)).labels(runner=name)
+        # attribution plane (ISSUE 17): the decoder books token outcomes
+        # and attributed device-seconds on the runner's shared families —
+        # all host-side, so the ledger can never mint a compile key
+        from ..observability.attribution import RequestCost, ENGINE_OUTCOME_MAP
+        self._RequestCost = RequestCost
+        self._outcome_map = ENGINE_OUTCOME_MAP
+        self._c_tok_outcome = runner._c_tok_outcome
+        self._c_device_s = runner._c_device_s
         self._book_occupancy()
         # flight-recorder roster (ISSUE 15): the postmortem dump reads the
         # live slot table + pool occupancy from here — WeakSet-held, so a
@@ -1559,6 +1642,8 @@ class ContinuousDecoder:
             handle.slot = slot
             handle.pages = list(pages)
             handle.t_submit_s = self.clock()
+            handle.cost = self._RequestCost(prefill_tokens=length)
+            handle.cost.page_edge(handle.t_submit_s, len(handle.pages))
             self._arrivals.append(handle)
             self._book_occupancy()
             self._cond.notify_all()
@@ -1715,6 +1800,8 @@ class ContinuousDecoder:
             self._tok_dev = None     # splice mutated host state
             self._fin_dev = None
             h.tokens.append(tok0)
+            if h.cost is not None:
+                h.cost.decode_tokens += 1
             runner._c_decode_tokens.inc()
             runner._c_rows["decode"].inc()
             if fin0 or h.max_new_tokens <= 1:
@@ -1751,6 +1838,8 @@ class ContinuousDecoder:
                     self._release(s, "denied", leavers)
                     continue
                 h.pages.append(new_page)
+                if h.cost is not None:
+                    h.cost.page_edge(now, 1)
                 self._table[s, pi] = new_page
                 self._table_dirty = True
         if not self._live:
@@ -1781,13 +1870,29 @@ class ContinuousDecoder:
         self._tok_dev, self._fin_dev = tok_d, fin_d
         t_dev0 = time.perf_counter()
         tok, fin = np.asarray(tok_d), np.asarray(fin_d)
+        # the fetch IS the device wait (already a sync) — measuring it
+        # every step costs one clock read, so the attribution charge below
+        # uses the true per-step device time, not a sampled estimate
+        dev_s = time.perf_counter() - t_dev0
         if self.watchdog is not None:
             self.watchdog.disarm()
         self.steps += 1
         dte = runner.device_time_every
         if dte and self.steps % dte == 0:
-            runner._h_phase_device.observe(time.perf_counter() - t_dev0)
+            runner._h_phase_device.observe(dev_s)
         runner._c_decode_steps.inc()
+        # attribution (ISSUE 17): the whole step's host-observed device
+        # work (enqueue + device wait) is amortized over the slots that
+        # had a live request behind them at dispatch; the rest of the
+        # batch width was pad cells — dispatched-but-wasted by definition
+        live = self._live
+        step_s = disp_s + dev_s
+        share = step_s / live if live else 0.0
+        if step_s > 0:
+            self._c_device_s.inc(step_s)
+        pad = self.slots - live
+        if pad > 0:
+            self._c_tok_outcome.inc(pad, outcome="pad_row")
         for s, h in enumerate(self._handles):
             if h is None:
                 continue
@@ -1795,6 +1900,9 @@ class ContinuousDecoder:
             self._fin[s] = bool(fin[s])
             self._emitted[s] += 1
             h.tokens.append(int(tok[s]))
+            if h.cost is not None:
+                h.cost.decode_tokens += 1
+                h.cost.device_s += share
             runner._c_decode_tokens.inc()
             if self._fin[s] or len(h.tokens) >= h.max_new_tokens:
                 self._release(s, "ok", leavers)
@@ -1807,6 +1915,13 @@ class ContinuousDecoder:
         h = self._handles[s]
         self._handles[s] = None
         h.status = outcome
+        if h.cost is not None:
+            # terminal classification: every token this request generated
+            # lands in exactly one outcome bucket — the conservation law
+            h.cost.close_pages(self.clock())
+            if h.cost.decode_tokens > 0:
+                self._c_tok_outcome.inc(h.cost.decode_tokens,
+                                        outcome=self._outcome_map[outcome])
         if h.pages:
             self.pool.free(h.pages)
             h.pages = []
@@ -1961,6 +2076,10 @@ class ContinuousDecoder:
     def _cancel_arrival(self, h: StreamHandle, outcome: str,
                         leavers: List[StreamHandle]) -> None:
         h.status = outcome
+        if h.cost is not None:
+            # a cancelled arrival never joined: zero decode tokens, so no
+            # outcome booking — only its reserved page-seconds close out
+            h.cost.close_pages(self.clock())
         if h.pages:
             self.pool.free(h.pages)
             h.pages = []
@@ -2012,6 +2131,22 @@ class ContinuousDecoder:
         if thread is not None:
             thread.join(timeout=60)
         self._teardown("cancelled")
+
+
+def _resolve_takes_cost(resolve: Callable) -> bool:
+    """Whether a serving ``resolve`` callback accepts the ``cost=`` kwarg
+    (ISSUE 17).  Introspected per request terminal — the server's resolve
+    closure is fresh each call — so older callers (the streaming facade,
+    out-of-tree fronts) keep working unchanged."""
+    import inspect
+    try:
+        sig = inspect.signature(resolve)
+    except (TypeError, ValueError):
+        return False
+    for p in sig.parameters.values():
+        if p.kind is inspect.Parameter.VAR_KEYWORD or p.name == "cost":
+            return True
+    return False
 
 
 class _RunnerScorer(Transformer):
@@ -2163,20 +2298,31 @@ class _RunnerScorer(Transformer):
         deadline_s = None if deadline_budget_s is None \
             else decoder.clock() + max(0.0, deadline_budget_s)
         pre_s = max(0.0, queue_age_s or 0.0)
+        takes_cost = _resolve_takes_cost(resolve)
 
         def on_done(h: StreamHandle) -> None:
+            # cost pass-through (ISSUE 17): the caller's queue wait lands
+            # on the ledger at terminal time (race-free — on_done runs
+            # once, on the engine thread) and rides resolve when the
+            # caller's closure accepts it
+            kw = {}
+            if h.cost is not None:
+                h.cost.queue_s = pre_s
+                if takes_cost:
+                    kw["cost"] = h.cost
             if h.status == "ok":
                 ttft_s = None if h.ttft_s is None else pre_s + h.ttft_s
                 resolve(reply=self._reply_body(h.tokens, ttft_s),
-                        status=200, verdict="ok", ttft_s=ttft_s)
+                        status=200, verdict="ok", ttft_s=ttft_s, **kw)
             elif h.status == "denied":
                 resolve(reply={"error": "shed: page pool exhausted "
                                         "mid-decode"},
                         status=503, verdict="shed_page_pool",
-                        retry_after_s=1.0)
+                        retry_after_s=1.0, **kw)
             elif h.status == "expired":
                 resolve(reply={"error": "deadline expired mid-decode"},
-                        status=504, verdict="deadline_expired_decoding")
+                        status=504, verdict="deadline_expired_decoding",
+                        **kw)
             elif decoder.abort_reason == "stall":
                 # the watchdog killed a hung dispatch under this request:
                 # the prompt is fine and another worker (or this engine
@@ -2184,10 +2330,10 @@ class _RunnerScorer(Transformer):
                 # retryable 503, not a 500 (ISSUE 16)
                 resolve(reply={"error": "shed: decode engine stalled"},
                         status=503, verdict="shed_engine_stall",
-                        retry_after_s=1.0)
+                        retry_after_s=1.0, **kw)
             else:  # cancelled / error — the engine went away under us
                 resolve(reply={"error": f"decode {h.status}"},
-                        status=500, verdict="error")
+                        status=500, verdict="error", **kw)
 
         decoder.submit(prompt, deadline_s=deadline_s, on_done=on_done,
                        trace_id=trace_id)

@@ -12,9 +12,16 @@ class**:
   ``depth_per_replica``;
 - **shed rate** (``mmlspark_serving_requests_total{status=shed}`` over
   ``{status=received}``, differenced over ``window_s`` like the SLO
-  windows) against ``shed_tolerance``.
+  windows) against ``shed_tolerance``;
+- **device-time saturation** (ISSUE 17's cost ledger:
+  ``mmlspark_request_class_device_seconds_total`` differenced over the
+  same window) against the class's device-seconds budget — each replica
+  contributes 1 device-second per wall-second, derated by
+  ``target_device_utilization``.  This is cost-aware pressure: the fleet
+  scales on *projected device-time saturation*, not just on the queue
+  symptoms that lag it.
 
-The scalar ``pressure`` is the max of the three ratios — any one signal
+The scalar ``pressure`` is the max of the four ratios — any one signal
 saturating is reason enough to scale.  Anti-flap machinery: a
 **hysteresis band** (``down_threshold < pressure < up_threshold`` holds
 the previous recommendation), a **cooldown** after every change, and a
@@ -36,6 +43,7 @@ from .metrics import MetricsRegistry, get_registry
 # the ONE cumulative edge-differencing + ring-maintenance implementation —
 # the shed-rate window and the SLO burn windows must never drift onto
 # different math (or different eviction behavior under high cadence)
+from .attribution import _window_delta
 from .slo import coalesce_append, window_fraction
 
 __all__ = ["AutoscaleAdvisor"]
@@ -58,12 +66,14 @@ class AutoscaleAdvisor:
     EWMA_FAMILY = "mmlspark_serving_queue_delay_ewma_seconds"
     DEPTH_FAMILY = "mmlspark_serving_queue_depth"
     REQUESTS_FAMILY = "mmlspark_serving_requests_total"
+    CLASS_DEVICE_FAMILY = "mmlspark_request_class_device_seconds_total"
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
                  clock: Callable[[], float] = time.monotonic,
                  target_queue_delay_s: float = 0.1,
                  shed_tolerance: float = 0.02,
                  depth_per_replica: float = 64.0,
+                 target_device_utilization: float = 0.8,
                  window_s: float = 300.0,
                  up_threshold: float = 1.0, down_threshold: float = 0.5,
                  cooldown_s: float = 60.0, calm_s_for_downscale: float = 300.0,
@@ -76,6 +86,9 @@ class AutoscaleAdvisor:
         self.target_queue_delay_s = float(target_queue_delay_s)
         self.shed_tolerance = float(shed_tolerance)
         self.depth_per_replica = float(depth_per_replica)
+        if not 0.0 < target_device_utilization <= 1.0:
+            raise ValueError("target_device_utilization must be in (0, 1]")
+        self.target_device_utilization = float(target_device_utilization)
         self.window_s = float(window_s)
         self.up_threshold = float(up_threshold)
         self.down_threshold = float(down_threshold)
@@ -100,6 +113,7 @@ class AutoscaleAdvisor:
     def _signals(self, view, workers: List[Dict], now: float,
                  st: Dict) -> Dict[str, float]:
         hist = st["hist"]
+        dev_hist = st["dev_hist"]
         addrs = {f"{w['host']}:{w['port']}" for w in workers}
         coverage = frozenset(
             sid for w in workers
@@ -112,6 +126,7 @@ class AutoscaleAdvisor:
             # window rather than read a lifetime's sheds as in-window
             # (the instantaneous EWMA/depth gauges keep steering meanwhile)
             hist.clear()
+            dev_hist.clear()
             st["coverage"] = coverage
         ewmas = [v for labels, v in view.gauge_values(self.EWMA_FAMILY)
                  if labels.get("server") in addrs and v == v]  # NaN out
@@ -133,10 +148,21 @@ class AutoscaleAdvisor:
             # "no data yet", never as a signal)
             hist.clear()
         coalesce_append(hist, (now, shed, recv), self._min_spacing_s)
+        # cost-aware signal (ISSUE 17): the class's cumulative device-time
+        # spend from the attribution ledger, differenced over the same
+        # window into a device-seconds-per-wall-second rate
+        dev = sum(v for labels, v in
+                  view.counters.get(self.CLASS_DEVICE_FAMILY, {}).items()
+                  if dict(labels).get("server") in addrs)
+        if dev_hist and dev < dev_hist[-1][1]:
+            dev_hist.clear()
+        coalesce_append(dev_hist, (now, dev), self._min_spacing_s)
+        w = _window_delta(list(dev_hist), now, self.window_s)
         return {
             "queue_delay_ewma_s": sum(ewmas) / len(ewmas) if ewmas else 0.0,
             "queue_depth": depth,
             "shed_rate": window_fraction(list(hist), now, self.window_s),
+            "device_seconds_per_s": (w[1][0] / w[0]) if w else 0.0,
         }
 
     # ------------------------------------------------------------ decision
@@ -161,7 +187,8 @@ class AutoscaleAdvisor:
                 st = self._state.setdefault(klass, {
                     "desired": None, "last_change": -math.inf,
                     "calm_since": None,
-                    "hist": collections.deque(maxlen=4096)})
+                    "hist": collections.deque(maxlen=4096),
+                    "dev_hist": collections.deque(maxlen=4096)})
                 signals = self._signals(view, workers, now, st)
                 # telemetry-blind guard: when NONE of the class's workers
                 # scraped ok (and ids were known to check), absent gauges
@@ -188,7 +215,13 @@ class AutoscaleAdvisor:
                     signals["queue_delay_ewma_s"] / self.target_queue_delay_s,
                     signals["shed_rate"] / self.shed_tolerance,
                     signals["queue_depth"]
-                    / (max(1, n) * self.depth_per_replica))
+                    / (max(1, n) * self.depth_per_replica),
+                    # cost-aware: measured device-seconds burn rate vs the
+                    # class's derated budget of one device-second per
+                    # wall-second per replica — saturating device time is
+                    # scale-up pressure before the queues ever feel it
+                    signals["device_seconds_per_s"]
+                    / (max(1, n) * self.target_device_utilization))
                 prev = st["desired"] if st["desired"] is not None else n
                 cooldown_left = self.cooldown_s - (now - st["last_change"])
                 in_cooldown = cooldown_left > 0

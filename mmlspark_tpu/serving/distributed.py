@@ -32,6 +32,7 @@ from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from .server import PipelineServer
 from ..observability import get_registry, instrument_breaker
+from ..observability.attribution import CapacityModel, attribution_instruments
 from ..observability.autoscale import AutoscaleAdvisor
 from ..observability.federation import MetricsFederator
 from ..observability.instruments import uninstrument_breaker
@@ -77,6 +78,7 @@ def _http_json(url: str, payload: Optional[dict] = None, timeout: float = 10.0,
 TOPOLOGY_ENDPOINTS = {
     "GET": ("/routing", "/flag/<key>", "/stats", "/fleet/slow",
             "/fleet/metrics", "/fleet/slo", "/fleet/autoscale",
+            "/fleet/capacity", "/fleet/trace/<id>",
             "/fleet/membership", "/fleet/dump", "/health"),
     "POST": ("/register", "/deregister", "/flag"),
 }
@@ -255,10 +257,16 @@ class TopologyService:
             SLOEngine(slos, registry=self.registry, clock=telemetry_clock)
         self.autoscaler = autoscaler if autoscaler is not None else \
             AutoscaleAdvisor(registry=self.registry, clock=telemetry_clock)
+        # fleet capacity model (ISSUE 17): folds the federated cost
+        # ledgers into goodput% + per-class device-seconds/1k-tokens and
+        # headroom — fed once per federation tick, served at
+        # GET /fleet/capacity
+        self.capacity = CapacityModel(clock=telemetry_clock)
         self._fleet_lock = threading.Lock()
         self._last_view = None
         self._last_slo: Optional[Dict] = None
         self._last_autoscale: Optional[Dict] = None
+        self._last_capacity: Optional[Dict] = None
         self._federation_thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------------ http
@@ -381,6 +389,29 @@ class TopologyService:
                     self._json(200, {"classes": recs,
                                      "workers": view.to_dict()["workers"],
                                      "evaluated_at": view.scraped_at})
+                elif path == "/fleet/capacity":
+                    params, err = _parse_query(query, {
+                        "refresh": _flag01, "deadline_ms": _pos_float})
+                    if err is not None:
+                        self._json(400, {"error": err})
+                        return
+                    dl = params.get("deadline_ms")
+                    self._json(200, svc.fleet_capacity(
+                        refresh=params.get("refresh"),
+                        deadline_s=dl / 1000.0 if dl is not None else None))
+                elif path.startswith("/fleet/trace/"):
+                    params, err = _parse_query(query,
+                                               {"deadline_ms": _pos_float})
+                    if err is not None:
+                        self._json(400, {"error": err})
+                        return
+                    dl = params.get("deadline_ms")
+                    body = svc.fleet_trace(
+                        path[len("/fleet/trace/"):],
+                        deadline_s=dl / 1000.0 if dl is not None else None)
+                    # 404 ONLY when no worker (and not the driver) holds
+                    # the id — a partial assembly past dead workers is 200
+                    self._json(200 if body["found"] else 404, body)
                 elif path == "/fleet/dump":
                     params, err = _parse_query(query,
                                                {"deadline_ms": _pos_float})
@@ -509,12 +540,16 @@ class TopologyService:
         never a blind endpoint."""
         view = self.federator.scrape_once(deadline_s=deadline_s)
         verdicts = self.slo_engine.evaluate(view)
-        recs = self.autoscaler.recommend(view, self.workers_by_class())
+        by_class = self.workers_by_class()
+        recs = self.autoscaler.recommend(view, by_class)
+        capacity = self.capacity.report(view, by_class)
         with self._fleet_lock:
             self._last_view = view
             self._last_slo = verdicts
             self._last_autoscale = recs
-        return {"view": view, "slo": verdicts, "autoscale": recs}
+            self._last_capacity = capacity
+        return {"view": view, "slo": verdicts, "autoscale": recs,
+                "capacity": capacity}
 
     def _fleet_state(self, refresh: Optional[bool] = None,
                      deadline_s: Optional[float] = None):
@@ -655,8 +690,8 @@ class TopologyService:
         for _sid, breaker in dead:
             uninstrument_breaker(breaker, self.registry)
 
-    def _fanout_debug(self, path: str,
-                      deadline: Deadline) -> Tuple[Dict, Dict]:
+    def _fanout_debug(self, path: str, deadline: Deadline,
+                      not_found_ok: bool = False) -> Tuple[Dict, Dict]:
         """Concurrent deadline-bounded GET of ``path`` against every live
         worker with the per-worker breaker discipline (ISSUE 15 factored
         this out of :meth:`fleet_slow` so ``/fleet/dump`` shares it
@@ -666,7 +701,13 @@ class TopologyService:
 
         Rules carried over: an open breaker costs one skip, not a timeout;
         a client-side deadline expiry mid-exchange is NEVER fed to the
-        breaker (PR 2 rule); partial results always serve."""
+        breaker (PR 2 rule); partial results always serve.
+
+        ``not_found_ok`` (ISSUE 17, ``/fleet/trace/<id>``): a worker's 404
+        is a healthy "I don't hold it" verdict — ``{"not_found": True}``
+        row, no payload, and NO breaker feed (a trace fanned out across a
+        fleet misses on most workers by design; charging their breakers
+        would open every breaker under normal trace lookups)."""
         with self._lock:
             workers = list(self._workers.items())
         self._prune_fleet_breakers({sid for sid, _ in workers})
@@ -680,6 +721,12 @@ class TopologyService:
                     f"http://{w['host']}:{w['port']}{path}",
                     timeout=self.probe_timeout_s, deadline=deadline)
             except Exception as e:  # noqa: BLE001 — a dead worker is a row
+                if not_found_ok and isinstance(e, urllib.error.HTTPError) \
+                        and e.code == 404:
+                    breaker.record_success()
+                    with results_lock:
+                        results[sid] = ({"not_found": True}, None)
+                    return
                 if deadline.expired():
                     # the budget ran out mid-exchange — that is the
                     # caller's deadline, not the worker's health: no
@@ -776,6 +823,40 @@ class TopologyService:
             dumps_c.inc(trigger="fleet", result=result)
         return {"workers": per_worker, "dumps": payloads}
 
+    def fleet_trace(self, trace_id: str,
+                    deadline_s: Optional[float] = None) -> Dict:
+        """Assemble ONE trace's span trees across the driver and every
+        live worker (``GET /fleet/trace/<id>``, the PR 4 cross-worker
+        follow-up): fan ``/trace/<id>`` out under one overall deadline
+        with the breaker discipline, treating a worker's 404 as a healthy
+        "not here" verdict.  Partial results serve past dead workers;
+        ``found`` is False only when NO reachable holder (driver
+        included) had the id — the endpoint's 404 signal."""
+        deadline = Deadline.after(deadline_s if deadline_s is not None
+                                  else self.fleet_slow_deadline_s)
+        per_worker, payloads = self._fanout_debug(
+            f"/trace/{urllib.parse.quote(trace_id, safe='')}", deadline,
+            not_found_ok=True)
+        trees = dict(payloads)
+        from ..observability.collector import get_collector
+        own = get_collector(self.registry).trace_tree(trace_id)
+        if own is not None:
+            trees["driver"] = own
+        return {"trace_id": trace_id, "found": bool(trees),
+                "workers": per_worker, "trees": trees}
+
+    def fleet_capacity(self, refresh: Optional[bool] = None,
+                       deadline_s: Optional[float] = None) -> Dict:
+        """Per-class capacity/headroom report (``GET /fleet/capacity``,
+        ISSUE 17): goodput%, measured device-seconds per 1k decode tokens,
+        arrival rate vs the class's device-seconds budget.  Rides the
+        federation cache exactly like the other fleet endpoints —
+        ``?refresh=1`` forces a sweep; the background poll keeps the
+        windowed rate history warm in between."""
+        self._fleet_state(refresh=refresh, deadline_s=deadline_s)
+        with self._fleet_lock:
+            return dict(self._last_capacity or {})
+
 
 class WorkerServer:
     """Executor-side server: a ``PipelineServer`` that registers its
@@ -799,6 +880,10 @@ class WorkerServer:
         # join even if the prober never noticed the crash
         self.role = role
         self.generation = int(generation)
+        # the class rides into the wrapped server too (ISSUE 17): its
+        # request records and per-class cost rollups must agree with what
+        # this worker registered as — an explicit kw still wins
+        kw.setdefault("request_class", request_class)
         self.server = PipelineServer(model, **kw)
 
     def _registration(self, state: Optional[str] = None) -> Dict:
@@ -1077,6 +1162,11 @@ class RoutingClient:
         self._m_budget_denied = self.registry.counter(
             "mmlspark_retry_budget_denied_total",
             "retry/hedge attempts suppressed by an exhausted budget")
+        # attribution (ISSUE 17): a hedge leg that completes 200 after the
+        # race was lost produced a whole reply the caller discards — its
+        # decode tokens book as hedge_loser waste, client-side (only the
+        # client knows which leg lost)
+        self._c_tok_outcome = attribution_instruments(self.registry)["tokens"]
         self._table: List[Dict] = []
         self._fetched = 0.0
         self._rr = 0
@@ -1252,10 +1342,23 @@ class RoutingClient:
         if delay is None:
             return self._attempt(w, payload, timeout, deadline)
         results: "queue.Queue" = queue.Queue()
+        race = {"winner": None}
+        race_lock = threading.Lock()
 
         def leg(name: str, wk: Dict) -> None:
-            results.put((name, wk["server_id"],
-                         self._attempt(wk, payload, timeout, deadline)))
+            res = self._attempt(wk, payload, timeout, deadline)
+            lost = False
+            with race_lock:
+                if res[0] == "ok":
+                    if race["winner"] is None:
+                        race["winner"] = name
+                    else:
+                        lost = True
+            if lost:
+                # the race already had a winner when this 200 landed: the
+                # whole reply is discarded device work (ISSUE 17)
+                self._book_hedge_loser(res[1])
+            results.put((name, wk["server_id"], res))
 
         threading.Thread(target=leg, args=("primary", w), daemon=True,
                          name="mmlspark-hedge-primary").start()
@@ -1313,6 +1416,22 @@ class RoutingClient:
             self._m_hedges.inc(outcome="both_failed")
         return raise_res or err_res or deadline_res or \
             ("err", TimeoutError("hedged exchange produced no result"))
+
+    def _book_hedge_loser(self, reply) -> None:
+        """Book a discarded-but-completed hedge reply's decode tokens as
+        ``hedge_loser`` waste.  The reply shape is the decode scorer's: a
+        token list, possibly wrapped in ``{"tokens": ...}`` (report_ttft)
+        and possibly one-row nested; an unparseable reply books nothing —
+        attribution must never fail a request path."""
+        body = reply.get("tokens") if isinstance(reply, dict) else reply
+        if isinstance(body, (list, tuple)):
+            if len(body) == 1 and isinstance(body[0], (list, tuple)):
+                body = body[0]
+            n = len(body)
+        else:
+            n = 0
+        if n > 0:
+            self._c_tok_outcome.inc(n, outcome="hedge_loser")
 
     def request(self, payload, key: Optional[str] = None,
                 timeout: float = 30.0, retries: Optional[int] = None,

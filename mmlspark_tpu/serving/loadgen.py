@@ -28,6 +28,16 @@ admission→first-token; the ticked scorer reports its honest value — the
 full latency, since no token is client-visible before the batch resolves).
 The class's stats gain ``ttft_p50_ms``/``ttft_p99_ms``/``ttft_count`` and
 the gate spec accepts ``ttft_p99_ms``/``ttft_p50_ms`` upper bounds.
+
+Goodput accounting (ISSUE 17): a workload carrying ``"tokens_key":
+"tokens"`` has each 2xx reply body parsed and that field counted as
+decode tokens (a list counts ``len``, a number its value) — the class's
+stats gain ``decode_tokens``/``decode_tokens_per_sec`` so mixed-class
+runs report per-class token throughput, the denominator the fleet
+capacity model is judged against.  ``check_gates`` accepts
+``min_goodput_pct``, a lower bound on the ``goodput_pct`` the caller
+folds into ``stats`` (from ``GET /fleet/capacity``); it fails on zero
+``goodput_samples`` — never vacuous, the PR 11/13 gate discipline.
 """
 from __future__ import annotations
 
@@ -98,10 +108,20 @@ def check_gates(gates: Dict[str, float],
             book(name, bad, limit, bad <= limit)
         elif name == "min_rps":
             book(name, stats["rps"], limit, stats["rps"] >= limit)
+        elif name == "min_goodput_pct":
+            # lower bound on useful-token share (ISSUE 17).  The caller
+            # folds the fleet ledger's goodput into stats as
+            # goodput_pct/goodput_samples (e.g. from GET /fleet/capacity);
+            # zero samples FAIL — a run whose ledger recorded no tokens
+            # must not pass a goodput gate on a 0.0 placeholder
+            actual = stats.get("goodput_pct", 0.0)
+            ok = stats.get("goodput_samples", 0.0) > 0 and actual >= limit
+            book(name, actual, limit, ok)
         else:
             raise ValueError(f"unknown gate {name!r}; expected one of "
                              "p99_ms/p50_ms/ttft_p99_ms/ttft_p50_ms/"
-                             "max_error_rate/max_failed/min_rps")
+                             "max_error_rate/max_failed/min_rps/"
+                             "min_goodput_pct")
     return {"passed": not failures, "failures": failures, "checks": checks}
 
 
@@ -113,10 +133,12 @@ def mixed_load(host: str, port: int,
 
     Each workload is ``{"name", "path", "body", "headers", "n_clients",
     "per_client"}`` (``n_clients`` default 4, ``per_client`` default 100)
-    plus an optional ``"gates"`` spec (see :func:`check_gates`) and an
+    plus an optional ``"gates"`` spec (see :func:`check_gates`), an
     optional ``"ttft_key"`` naming the reply-body field carrying in-band
     first-token latency (adds ``ttft_p50_ms``/``ttft_p99_ms``/
-    ``ttft_count`` to the class's stats; see the module docstring).  Every
+    ``ttft_count`` to the class's stats; see the module docstring), and an
+    optional ``"tokens_key"`` naming the reply-body field carrying the
+    generated tokens (adds ``decode_tokens``/``decode_tokens_per_sec``).  Every
     client opens its own persistent connection, fires ``warm`` untimed
     requests, then waits on ONE barrier shared by every workload — the
     clock starts when the whole mixed fleet is warm, so the classes
@@ -140,6 +162,7 @@ def mixed_load(host: str, port: int,
     errors: Dict[str, List[str]] = {w["name"]: [] for w in workloads}
     non_2xx: Dict[str, int] = {w["name"]: 0 for w in workloads}
     ttfts: Dict[str, List[float]] = {w["name"]: [] for w in workloads}
+    tokens: Dict[str, float] = {w["name"]: 0.0 for w in workloads}
     lock = threading.Lock()
     total_clients = sum(int(w.get("n_clients", 4)) for w in workloads)
     barrier = threading.Barrier(total_clients + 1)
@@ -148,9 +171,11 @@ def mixed_load(host: str, port: int,
         name = w["name"]
         body, headers = w["body"], w.get("headers") or {}
         ttft_key = w.get("ttft_key")
+        tokens_key = w.get("tokens_key")
         mine: List[float] = []
         mine_ttft: List[float] = []
         mine_bad = 0
+        mine_tokens = 0.0
         try:
             conn = http.client.HTTPConnection(host, port, timeout=30)
             for _ in range(warm):
@@ -177,17 +202,32 @@ def mixed_load(host: str, port: int,
                 mine.append(time.perf_counter() - t0)
                 if not 200 <= resp.status < 300:
                     mine_bad += 1
-                elif ttft_key:
+                elif ttft_key or tokens_key:
                     # in-band TTFT: the decode scorer reports first-token
                     # latency inside the reply body (see module docstring);
                     # a reply without the field just contributes no sample
                     # — the ttft gate fails on a zero sample count
                     try:
-                        val = json.loads(data.decode()).get(ttft_key)
-                        if val is not None:
-                            mine_ttft.append(float(val))
+                        reply = json.loads(data.decode())
                     except (ValueError, AttributeError):
-                        pass
+                        reply = None
+                    if isinstance(reply, dict):
+                        if ttft_key:
+                            val = reply.get(ttft_key)
+                            if val is not None:
+                                mine_ttft.append(float(val))
+                        if tokens_key:
+                            # generated tokens: a (possibly row-nested)
+                            # list counts its leaves, a bare number counts
+                            # its value — only DELIVERED (2xx) tokens
+                            # count, matching the ledger's "useful" lane
+                            tok = reply.get(tokens_key)
+                            if isinstance(tok, (list, tuple)):
+                                mine_tokens += sum(
+                                    len(r) if isinstance(r, (list, tuple))
+                                    else 1 for r in tok)
+                            elif isinstance(tok, (int, float)):
+                                mine_tokens += float(tok)
         except Exception as e:  # noqa: BLE001 - count what completed
             with lock:
                 errors[name].append(repr(e))
@@ -196,6 +236,7 @@ def mixed_load(host: str, port: int,
                 lats[name].extend(mine)
                 non_2xx[name] += mine_bad
                 ttfts[name].extend(mine_ttft)
+                tokens[name] += mine_tokens
 
     threads = [threading.Thread(target=fire, args=(w,))
                for w in workloads for _ in range(int(w.get("n_clients", 4)))]
@@ -233,6 +274,11 @@ def mixed_load(host: str, port: int,
         st = stats(lats[name], errors[name], non_2xx[name])
         if w.get("ttft_key"):
             st.update(ttft_stats(ttfts[name]))
+        if w.get("tokens_key"):
+            # per-class decode token throughput over the SHARED wall
+            # window, so classes' tokens/sec add up like their rps does
+            st["decode_tokens"] = tokens[name]
+            st["decode_tokens_per_sec"] = tokens[name] / wall
         # the class's intended request count: the honest error-rate
         # denominator (a dead client loses all its remaining requests)
         st["intended"] = float(int(w.get("n_clients", 4))
@@ -246,6 +292,10 @@ def mixed_load(host: str, port: int,
     all_ttfts = [v for vs in ttfts.values() for v in vs]
     if all_ttfts:
         result["combined"].update(ttft_stats(all_ttfts))
+    if any(w.get("tokens_key") for w in workloads):
+        total_tokens = sum(tokens.values())
+        result["combined"]["decode_tokens"] = total_tokens
+        result["combined"]["decode_tokens_per_sec"] = total_tokens / wall
     return result
 
 

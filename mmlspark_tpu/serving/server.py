@@ -116,7 +116,9 @@ class PipelineServer:
     breakdown and shed/deadline verdict (see docs/OBSERVABILITY.md,
     "Debugging a slow request");
     GET /debug/compile -> compute-plane compile state (per-function compile
-    counts, abstract signatures, last cost analysis, recompile-storm trips).
+    counts, abstract signatures, last cost analysis, recompile-storm trips);
+    GET /debug/requests[?k=&class=&verdict=] -> newest-first canonical
+    request records with per-request cost stanzas (ISSUE 17).
 
     Graceful degradation: admission is bounded — once ``max_queue_depth``
     requests are in flight, further POSTs are shed immediately with 503 +
@@ -146,7 +148,9 @@ class PipelineServer:
                  micro_batch_deadline_margin_s: float = 0.0,
                  micro_batch_ewma_flush_s: Optional[float] = None,
                  slow_k: int = 10,
-                 drain_timeout_s: Optional[float] = 30.0):
+                 drain_timeout_s: Optional[float] = 30.0,
+                 request_class: str = "default",
+                 request_record_k: int = 256):
         if mode not in ("continuous", "micro_batch"):
             raise ValueError("mode must be continuous|micro_batch")
         self.model = model
@@ -260,6 +264,21 @@ class PipelineServer:
         from ..observability.profiling import profiler_instruments
         profiler_instruments(reg)
         self._recorder = get_flight_recorder(reg)
+        # goodput & cost attribution (ISSUE 17): this server's request
+        # class labels the fleet cost rollups, and every terminal request
+        # emits one bounded canonical record (trace id, class, verdict,
+        # cost stanza) into the ring behind GET /debug/requests — also the
+        # flight recorder's `source.requests:<addr>` postmortem section
+        from ..observability.attribution import (RequestRecordRing,
+                                                 attribution_instruments)
+        self.request_class = str(request_class)
+        self._records = RequestRecordRing(request_record_k)
+        _att = attribution_instruments(reg)
+        self._c_class_tokens = _att["class_tokens"].labels(
+            **{"class": self.request_class})
+        self._c_class_device = _att["class_device"].labels(
+            **{"class": self.request_class})
+        self._record_source: Optional[str] = None
         # pre-start sinks: port=0 is unresolved, and registering children
         # under "host:0" would leave a ghost zero series in the (usually
         # shared) registry for every constructed-but-restarted server.
@@ -425,6 +444,30 @@ class PipelineServer:
                             {"error": str(e)}).encode())
                         return
                     self._respond(200, report)
+                elif self.path.split("?", 1)[0] == "/debug/requests":
+                    # canonical request records (ISSUE 17): newest-first,
+                    # filterable by class/verdict — the wide-event ring a
+                    # wasted-work investigation starts from (each record
+                    # carries the request's full cost stanza)
+                    k, klass, verdict = 50, None, None
+                    query = self.path.partition("?")[2]
+                    try:
+                        for part in query.split("&"):
+                            if part.startswith("k="):
+                                k = int(part[len("k="):])
+                            elif part.startswith("class="):
+                                klass = part[len("class="):]
+                            elif part.startswith("verdict="):
+                                verdict = part[len("verdict="):]
+                    except ValueError:
+                        self._respond(400, {"error": "k must be an integer"})
+                        return
+                    self._respond(200, {
+                        "server": server._server_label,
+                        "class": server.request_class,
+                        "appended": server._records.appended,
+                        "records": server._records.query(
+                            k=k, klass=klass, verdict=verdict)})
                 elif self.path == "/debug/dump":
                     # on-demand flight-recorder snapshot: books the dump
                     # (and writes the file when a dump dir is configured),
@@ -863,21 +906,44 @@ class PipelineServer:
             # joined to the caller's trace.  `server` scopes /debug/slow to
             # one instance in a shared registry; `verdict` names the
             # shed/deadline decision the slow-request view reports.
+            verdict = verdicts.get(e.uid,
+                                   "ok" if e.status == 200 else "error")
             span = Span("serving.request", trace_id=e.trace_id,
                         clock=self.clock, start_s=e.t_enq,
                         attributes={"status": e.status,
                                     "queue_s": round(max(0.0, now - e.t_enq), 6),
                                     "score_s": round(score_s, 6),
                                     "server": self._server_label,
-                                    "verdict": verdicts.get(
-                                        e.uid, "ok" if e.status == 200
-                                        else "error")})
+                                    "verdict": verdict})
             if e.status != 200:
                 span.status = f"http:{e.status}"
             span.finish()
             e.span_id = span.span_id  # before done.set(): the handler may
             export_span(span, self.registry)  # echo it in `traceparent`
+            self._emit_record(e, verdict, max(0.0, now - e.t_enq), score_s)
             e.done.set()
+
+    def _emit_record(self, e: _Entry, verdict: str, queue_s: float,
+                     score_s: float, ttft_s: Optional[float] = None,
+                     cost=None) -> None:
+        """Append one canonical wide-event record for a terminal request
+        (ISSUE 17) and, when it carried a decode cost ledger, book the
+        per-class fleet rollups: tokens delivered only on 200s (the
+        goodput numerator), device-seconds always — waste is exactly the
+        cost the capacity model must keep seeing."""
+        rec: Dict[str, Any] = {
+            "trace_id": e.trace_id, "class": self.request_class,
+            "verdict": verdict, "status": int(e.status),
+            "queue_s": round(queue_s, 6), "score_s": round(score_s, 6)}
+        if ttft_s is not None:
+            rec["ttft_s"] = round(ttft_s, 6)
+        if cost is not None:
+            rec["cost"] = cost.as_dict()
+            if e.status == 200 and cost.decode_tokens > 0:
+                self._c_class_tokens.inc(cost.decode_tokens)
+            if cost.device_s > 0:
+                self._c_class_device.inc(cost.device_s)
+        self._records.append(rec)
 
     def _submit_continuous(self, e: _Entry, queue_s: float) -> bool:
         """Hand one admitted entry to the model's continuous engine.
@@ -894,7 +960,7 @@ class PipelineServer:
         t_submit = self.clock()
 
         def resolve(reply=None, status=200, verdict="ok",
-                    retry_after_s=None, ttft_s=None):
+                    retry_after_s=None, ttft_s=None, cost=None):
             # 200 replies ride the server's reply_encoder exactly like the
             # batch path — a custom encoder applies to both drains
             e.status = status
@@ -919,6 +985,8 @@ class PipelineServer:
             span.finish()
             e.span_id = span.span_id  # before done.set(): traceparent echo
             export_span(span, self.registry)
+            self._emit_record(e, verdict, queue_s, score_s,
+                              ttft_s=ttft_s, cost=cost)
             e.done.set()
 
         try:
@@ -975,6 +1043,11 @@ class PipelineServer:
                                        server=self._server_label)
         self._m_ewma.set_function(lambda: self._queue_ewma,
                                   server=self._server_label)
+        # postmortem source (ISSUE 17 satellite): a stall/crash/preemption
+        # dump shows the last-K requests this server resolved before it
+        # died, cost stanzas included
+        self._record_source = f"requests:{self._server_label}"
+        self._recorder.add_source(self._record_source, self._records.tail)
         t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         t.start()
         self._threads.append(t)
@@ -1051,6 +1124,9 @@ class PipelineServer:
         if self._preemption_hook is not None:
             unregister_preemption_hook(self._preemption_hook)
             self._preemption_hook = None
+        if self._record_source is not None:
+            self._recorder.remove_source(self._record_source)
+            self._record_source = None
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -1,0 +1,560 @@
+"""Goodput & cost attribution — the useful-vs-wasted ledger (ISSUE 17).
+
+The acceptance contracts this file pins:
+
+- token conservation is a LAW, not a dashboard approximation: on the
+  continuous engine ``sum(outcome buckets) == steps x slots + joins``
+  across mixed ok/denied/expired traffic, and on one-shot ``decode()``
+  ``useful + denied + pad == batch_bucket x generated_steps`` — every
+  wasted token attributed to exactly one cause;
+- the accounting plane adds ZERO new compile keys: a trace that
+  exercises every ledger edge (joins, deadline leaves, mid-flight
+  denials) leaves ``compile_stats()`` bit-identical;
+- the per-request cost ledger (queue wait, prefill/decode tokens,
+  amortized device-seconds, page-second integral) lands in the canonical
+  wide-event ring behind ``GET /debug/requests`` (filters, bounded k,
+  400 on bad k) and the flight recorder's ``source.requests`` section;
+- ``hedge_loser`` books client-side from a discarded hedge reply in
+  every reply shape the decode scorer produces;
+- ``CapacityModel`` turns federated ledgers into exact windowed rates
+  (device-seconds per 1k tokens, arrival rate, headroom) with the
+  SLO/autoscale window discipline: coverage changes and counter resets
+  clear history, thin history reports null instead of wrong;
+- end to end over real sockets: a mixed fleet with deadline-expiring
+  traffic reports fleet goodput < 100%, conserves tokens, and
+  ``GET /fleet/capacity`` agrees with the registry-derived
+  device-seconds/1k-tokens within +-20%; ``/fleet/trace/<id>`` serves
+  partial results past dead workers and 404s only when no holder.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tests.test_continuous_batching import post_json, _drain, _runner
+
+
+def _fresh(name):
+    from mmlspark_tpu.observability import MetricsRegistry
+    reg = MetricsRegistry()
+    return reg, _runner(name, layers=1, registry=reg)
+
+
+def _outcome_totals(reg):
+    from mmlspark_tpu.observability.attribution import OUTCOMES
+    fam = reg.family("mmlspark_decode_tokens_outcome_total")
+    return {o: fam.labels(outcome=o).value for o in OUTCOMES}
+
+
+# ---------------------------------------------------------------------------
+# ledger primitives
+# ---------------------------------------------------------------------------
+
+def test_request_cost_page_integral_is_exact():
+    """page_edge integrates piecewise-constant holdings exactly at the
+    alloc/extend/free edges — no sampling error."""
+    from mmlspark_tpu.observability.attribution import RequestCost
+
+    cost = RequestCost(queue_s=0.25, prefill_tokens=4)
+    cost.page_edge(10.0, 2)          # hold 2 pages from t=10
+    cost.page_edge(13.0, 1)          # 2 pages x 3s, now hold 3
+    cost.close_pages(15.0)           # 3 pages x 2s, drop all
+    assert cost.page_seconds == pytest.approx(2 * 3.0 + 3 * 2.0)
+    assert cost.pages_held == 0 and cost.pages_peak == 3
+    d = cost.as_dict()
+    assert d["queue_s"] == 0.25 and d["prefill_tokens"] == 4
+    assert d["page_seconds"] == pytest.approx(12.0)
+    assert set(d) == {"queue_s", "prefill_tokens", "decode_tokens",
+                      "device_s", "page_seconds", "pages_peak"}
+
+
+def test_window_delta_base_pick_and_clamp():
+    """The autoscale/SLO base-pick rule generalized to n-field tuples:
+    newest sample at/older than the window edge is the base; negative
+    deltas clamp to zero; degenerate histories return None."""
+    from mmlspark_tpu.observability.attribution import _window_delta
+
+    assert _window_delta([(1.0, 5.0)], now=2.0, window_s=10.0) is None
+    s = [(0.0, 10.0, 1.0), (5.0, 20.0, 2.0), (9.0, 30.0, 3.0)]
+    dt, deltas = _window_delta(s, now=10.0, window_s=6.0)
+    # cutoff t=4 -> base is the t=0 sample (newest at/older than cutoff)
+    assert dt == 9.0 and deltas == (20.0, 2.0)
+    # every sample inside the window: base falls back to the oldest
+    dt, deltas = _window_delta(s[1:], now=10.0, window_s=100.0)
+    assert dt == 4.0 and deltas == (10.0, 1.0)
+    # a residual counter regression clamps, never goes negative
+    dt, deltas = _window_delta([(0.0, 10.0), (5.0, 7.0)], 5.0, 100.0)
+    assert deltas == (0.0,)
+
+
+# ---------------------------------------------------------------------------
+# continuous-engine conservation
+# ---------------------------------------------------------------------------
+
+def test_continuous_conservation_across_ok_and_denied_leaves():
+    """Mixed ok + mid-flight-denied traffic: every decode-step cell lands
+    in exactly one bucket and the buckets sum to steps x slots + joins;
+    attributed device-seconds equal the per-handle shares they were
+    amortized into."""
+    from mmlspark_tpu.models import PagePool
+
+    reg, runner = _fresh("att.deny")
+    pool = PagePool(runner.module, num_pages=6, page_size=2,
+                    name="att.deny", registry=reg)
+    dec = runner.decode_stream(slots=2, prompt_bucket=4, max_new_tokens=6,
+                               pool=pool)
+    p = np.asarray([3, 1, 4, 1], np.int32)
+    hA = dec.submit(p, max_new_tokens=6)
+    hB = dec.submit(p + 1, max_new_tokens=6)
+    _drain(dec)
+    assert sorted([hA.status, hB.status]) == ["denied", "ok"]
+    tot = _outcome_totals(reg)
+    denied = hA if hA.status == "denied" else hB
+    okh = hB if denied is hA else hA
+    assert tot["denied_row"] == denied.cost.decode_tokens > 0
+    assert tot["useful"] == okh.cost.decode_tokens == 6
+    assert tot["deadline_expired_midflight"] == tot["hedge_loser"] == 0
+    # THE conservation law
+    assert sum(tot.values()) == dec.steps * dec.slots + dec.joined
+    # the device counter is exactly what was amortized into the handles
+    dev = reg.family("mmlspark_decode_device_seconds_total").value()
+    assert dev == pytest.approx(hA.cost.device_s + hB.cost.device_s,
+                                rel=1e-6, abs=1e-9)
+    # the page-second integral ran: both requests held pages over >0 steps
+    assert denied.cost.page_seconds >= 0.0 and denied.cost.pages_held == 0
+    assert okh.cost.pages_peak >= 2 and okh.cost.prefill_tokens == 4
+    dec.close()
+
+
+def test_continuous_deadline_expiry_books_midflight_waste():
+    """A request whose deadline expires after decode work started books
+    every token it generated as deadline_expired_midflight — and the
+    conservation law still closes."""
+    from mmlspark_tpu.utils.resilience import FakeClock
+
+    reg, runner = _fresh("att.expire")
+    clk = FakeClock()
+    dec = runner.decode_stream(slots=2, prompt_bucket=4, max_new_tokens=5,
+                               page_size=2, clock=clk)
+    p = np.asarray([5, 7], np.int32)
+    h = dec.submit(p, deadline_s=clk() + 0.5)
+    dec.step()                       # joins: first token emitted
+    clk.advance(1.0)                 # budget burned mid-flight
+    dec.step()                       # deadline leave before the dispatch
+    assert h.status == "expired"
+    tot = _outcome_totals(reg)
+    assert tot["deadline_expired_midflight"] == h.cost.decode_tokens > 0
+    # finish a healthy one so the mix has useful tokens too
+    h2 = dec.submit(p + 1)
+    _drain(dec)
+    assert h2.status == "ok"
+    tot = _outcome_totals(reg)
+    assert tot["useful"] == h2.cost.decode_tokens == 5
+    assert sum(tot.values()) == dec.steps * dec.slots + dec.joined
+    dec.close()
+
+
+def test_ledger_adds_zero_new_compile_keys():
+    """The acceptance pin: a trace exercising every ledger edge (join,
+    deadline leave, mid-flight denial, pad rows) leaves the executable
+    cache bit-identical — accounting never touches a signature."""
+    from mmlspark_tpu.models import PagePool
+    from mmlspark_tpu.utils.resilience import FakeClock
+
+    reg, runner = _fresh("att.pin")
+    pool = PagePool(runner.module, num_pages=6, page_size=2,
+                    name="att.pin", registry=reg)
+    clk = FakeClock()
+    dec = runner.decode_stream(slots=2, prompt_bucket=4, max_new_tokens=6,
+                               pool=pool, clock=clk)
+    dec.warmup()
+    before = runner.compile_stats()
+    p = np.asarray([3, 1, 4, 1], np.int32)
+    dec.submit(p, max_new_tokens=6)
+    dec.submit(p + 1, max_new_tokens=6)          # one of these is denied
+    _drain(dec)
+    h = dec.submit(p, deadline_s=clk() + 0.1)    # expires mid-flight
+    dec.step()
+    clk.advance(1.0)
+    _drain(dec)
+    assert h.status == "expired"
+    after = runner.compile_stats()
+    assert after["executables"] == before["executables"]
+    assert after["compiles"] == before["compiles"]
+    assert sum(_outcome_totals(reg).values()) \
+        == dec.steps * dec.slots + dec.joined
+    dec.close()
+
+
+# ---------------------------------------------------------------------------
+# one-shot decode()
+# ---------------------------------------------------------------------------
+
+def test_one_shot_decode_conservation_and_denial_attribution():
+    """One-shot ledger: useful + denied + pad == batch_bucket x generated
+    steps, surfaced in extras['attribution'] AND booked on the registry;
+    a mid-decode pool denial moves the denied row's tokens out of
+    useful."""
+    from mmlspark_tpu.models import PagePool
+
+    reg, runner = _fresh("att.oneshot")
+    res = runner.decode(np.asarray([[3, 1, 4, 1]], np.int32),
+                        max_new_tokens=6, kv_layout="paged", page_size=2)
+    att = res.extras["attribution"]
+    T = res.tokens.shape[1]
+    assert att["useful"] == res.extras["real_tokens"] == 6
+    assert att["denied_row"] == 0
+    assert att["useful"] + att["denied_row"] + att["pad_row"] \
+        == res.extras["batch_bucket"] * T
+    tot = _outcome_totals(reg)
+    assert tot["useful"] == att["useful"]
+    assert tot["pad_row"] == att["pad_row"]
+    dev = reg.family("mmlspark_decode_device_seconds_total").value()
+    # the extras stanza is rounded to 6 decimals for the wide-event record
+    assert dev == pytest.approx(att["device_s_attributed"], abs=1e-6)
+    assert dev > 0
+    # 2 prefill pages + zero headroom: the first extend is denied
+    pool = PagePool(runner.module, num_pages=3, page_size=2,
+                    name="att.oneshot", registry=reg)
+    res2 = runner.decode(np.asarray([[3, 1, 4, 1]], np.int32),
+                         max_new_tokens=6, pool=pool)
+    att2 = res2.extras["attribution"]
+    assert res2.extras["denied_rows"] == [0]
+    cut = res2.extras["denied_at"][0]
+    assert att2["denied_row"] == cut > 0
+    assert att2["useful"] == res2.extras["real_tokens"] - cut
+    assert att2["useful"] + att2["denied_row"] + att2["pad_row"] \
+        == res2.extras["batch_bucket"] * res2.tokens.shape[1]
+    tot2 = _outcome_totals(reg)
+    assert tot2["denied_row"] == att2["denied_row"]
+    assert tot2["useful"] == att["useful"] + att2["useful"]
+
+
+# ---------------------------------------------------------------------------
+# wide-event ring + flight recorder source
+# ---------------------------------------------------------------------------
+
+def test_debug_requests_ring_filters_and_recorder_source():
+    """GET /debug/requests: newest-first canonical records with the cost
+    stanza, bounded at request_record_k, class/verdict filterable, 400 on
+    a malformed k — and the same ring feeds the flight recorder's
+    source.requests section so a postmortem dump shows what the server
+    was serving."""
+    from mmlspark_tpu.models import ModelRunner
+    from mmlspark_tpu.serving import PipelineServer
+
+    reg, runner = _fresh("att.ring")
+    scorer = runner.scorer(mode="decode", continuous=True, slots=2,
+                           prompt_bucket=8, max_new_tokens=3, page_size=4,
+                           encode=lambda t: [int(x) for x in t])
+    srv = PipelineServer(scorer, port=0, mode="continuous", registry=reg,
+                         request_class="chat", request_record_k=3).start()
+    try:
+        for i in range(5):
+            status, reply = post_json(srv.port, srv.api_path, [5, 7, 11 + i])
+            assert status == 200
+        status, raw = post_json(srv.port, "/debug/requests", None,
+                                method_get=True)
+        body = json.loads(raw)
+        assert status == 200 and body["class"] == "chat"
+        assert body["appended"] == 5            # every terminal request
+        recs = body["records"]
+        assert len(recs) == 3                   # ring bounded at k=3
+        for rec in recs:
+            assert rec["class"] == "chat" and rec["verdict"] == "ok"
+            assert rec["status"] == 200 and rec["trace_id"]
+            assert rec["cost"]["decode_tokens"] == 3
+            assert rec["cost"]["device_s"] > 0.0
+            assert rec["cost"]["prefill_tokens"] == 3
+        # filters: a class nobody served is empty, k caps the page
+        status, raw = post_json(srv.port, "/debug/requests?class=nope",
+                                None, method_get=True)
+        assert json.loads(raw)["records"] == []
+        status, raw = post_json(srv.port,
+                                "/debug/requests?k=1&verdict=ok", None,
+                                method_get=True)
+        assert len(json.loads(raw)["records"]) == 1
+        status, raw = post_json(srv.port, "/debug/requests?k=abc", None,
+                                method_get=True)
+        assert status == 400
+        # class-labelled fleet rollups booked at record emission
+        tok = reg.family("mmlspark_request_class_decode_tokens_total")
+        assert tok.labels(**{"class": "chat"}).value == 15.0
+        dev = reg.family("mmlspark_request_class_device_seconds_total")
+        assert dev.labels(**{"class": "chat"}).value > 0.0
+        # the recorder source: last-K records ride every dump
+        status, raw = post_json(srv.port, "/debug/dump", None,
+                                method_get=True)
+        snap = json.loads(raw)
+        key = f"source.requests:{srv._server_label}"
+        assert key in snap and len(snap[key]) == 3
+        assert snap[key][-1]["cost"]["decode_tokens"] == 3
+    finally:
+        srv.stop()
+    assert srv._record_source is None           # source removed at stop
+
+
+def test_hedge_loser_books_discarded_reply_tokens():
+    """RoutingClient books a losing hedge leg's tokens client-side, for
+    every decode-reply shape — and never throws on junk."""
+    from mmlspark_tpu.observability import MetricsRegistry
+    from mmlspark_tpu.serving.distributed import RoutingClient
+
+    reg = MetricsRegistry()
+    rc = RoutingClient("http://127.0.0.1:9", registry=reg)
+    fam = reg.family("mmlspark_decode_tokens_outcome_total")
+    rc._book_hedge_loser([1, 2])                      # bare token list
+    rc._book_hedge_loser({"tokens": [1, 2, 3]})       # report_ttft body
+    rc._book_hedge_loser({"tokens": [[4, 5, 6, 7]]})  # one-row nested
+    rc._book_hedge_loser({"error": "shed"})           # junk books nothing
+    rc._book_hedge_loser("oops")
+    assert fam.labels(outcome="hedge_loser").value == 2 + 3 + 4
+
+
+# ---------------------------------------------------------------------------
+# CapacityModel
+# ---------------------------------------------------------------------------
+
+def _capacity_view(dev, tok, recv, useful, pad, ok=True):
+    from mmlspark_tpu.observability.federation import FleetView
+    view = FleetView()
+    view.workers = {"w1": {"ok": ok}}
+    view.counters = {
+        "mmlspark_request_class_device_seconds_total":
+            {frozenset({("class", "chat"), ("worker", "w1")}): dev},
+        "mmlspark_request_class_decode_tokens_total":
+            {frozenset({("class", "chat"), ("worker", "w1")}): tok},
+        "mmlspark_serving_requests_total":
+            {frozenset({("status", "received"), ("server", "h:1"),
+                        ("worker", "w1")}): recv},
+        "mmlspark_decode_tokens_outcome_total":
+            {frozenset({("outcome", "useful"), ("worker", "w1")}): useful,
+             frozenset({("outcome", "pad_row"), ("worker", "w1")}): pad},
+    }
+    view.scraped_at = 0.0
+    return view
+
+
+def test_capacity_model_windowed_rates_are_exact():
+    """Two polls with known counter deltas produce exact windowed rates:
+    device-seconds/1k-tokens, token + arrival rates, utilization against
+    the one-device-second-per-replica-second budget, headroom, and the
+    fleet goodput share — with null rates on thin history."""
+    from mmlspark_tpu.observability.attribution import CapacityModel
+    from mmlspark_tpu.utils.resilience import FakeClock
+
+    clk = FakeClock()
+    cm = CapacityModel(clock=clk, window_s=100.0)
+    wbc = {"chat": [{"server_id": "w1", "host": "h", "port": 1}]}
+    r1 = cm.report(_capacity_view(1.0, 500.0, 10.0, 450.0, 50.0), wbc)
+    row = r1["classes"]["chat"]
+    assert row["samples"] == 1 and row["replicas"] == 1
+    assert row["device_seconds_per_1k_tokens"] is None   # thin history
+    assert r1["goodput_pct"] == pytest.approx(90.0)      # 450 of 500
+    assert r1["token_samples"] == 500.0
+    clk.advance(10.0)
+    r2 = cm.report(_capacity_view(3.0, 1500.0, 30.0, 1350.0, 150.0), wbc)
+    row = r2["classes"]["chat"]
+    # deltas over 10s: +2 dev-s, +1000 tokens, +20 requests
+    assert row["device_seconds_per_1k_tokens"] == pytest.approx(2.0)
+    assert row["decode_tokens_per_s"] == pytest.approx(100.0)
+    assert row["arrival_rps"] == pytest.approx(2.0)
+    assert row["device_utilization"] == pytest.approx(0.2)   # 2s / 10s / 1
+    assert row["headroom_pct"] == pytest.approx(80.0)
+    assert r2["goodput_pct"] == pytest.approx(90.0)
+
+
+def test_capacity_model_clears_on_coverage_change_and_reset():
+    """The re-baselining discipline: a scrape-coverage change or a
+    counter reset makes cumulative counts incomparable — history clears
+    and the next report is null-rated, never confidently wrong."""
+    from mmlspark_tpu.observability.attribution import CapacityModel
+    from mmlspark_tpu.utils.resilience import FakeClock
+
+    clk = FakeClock()
+    cm = CapacityModel(clock=clk, window_s=100.0)
+    wbc = {"chat": [{"server_id": "w1", "host": "h", "port": 1}]}
+    cm.report(_capacity_view(1.0, 500.0, 10.0, 450.0, 50.0), wbc)
+    clk.advance(10.0)
+    # the worker dropped out of the scrape: coverage change clears
+    r = cm.report(_capacity_view(3.0, 1500.0, 30.0, 1350.0, 150.0, ok=False),
+                  wbc)
+    assert r["classes"]["chat"]["device_seconds_per_1k_tokens"] is None
+    assert r["classes"]["chat"]["samples"] == 1
+    clk.advance(10.0)
+    cm.report(_capacity_view(5.0, 2500.0, 50.0, 2250.0, 250.0, ok=False),
+              wbc)
+    clk.advance(10.0)
+    # a replica restart zeroed its counters: reset detection clears
+    r = cm.report(_capacity_view(0.5, 100.0, 2.0, 90.0, 10.0, ok=False), wbc)
+    assert r["classes"]["chat"]["device_seconds_per_1k_tokens"] is None
+    assert r["classes"]["chat"]["samples"] == 1
+    # a class with no workers anymore is dropped from state
+    r = cm.report(_capacity_view(0.5, 100.0, 2.0, 90.0, 10.0), {})
+    assert r["classes"] == {} and not cm._state
+
+
+def test_min_goodput_gate_verdicts():
+    """min_goodput_pct: lower bound on the folded-in goodput share; zero
+    ledger samples FAIL (never a vacuous pass); unknown gates still fail
+    loudly and name the new gate."""
+    from mmlspark_tpu.serving.loadgen import check_gates
+
+    ok = check_gates({"min_goodput_pct": 80.0},
+                     {"goodput_pct": 92.5, "goodput_samples": 640.0})
+    assert ok["passed"] and ok["checks"]["min_goodput_pct"]["actual"] == 92.5
+    bad = check_gates({"min_goodput_pct": 95.0},
+                      {"goodput_pct": 92.5, "goodput_samples": 640.0})
+    assert not bad["passed"]
+    vacuous = check_gates({"min_goodput_pct": 1.0},
+                          {"goodput_pct": 0.0, "goodput_samples": 0.0})
+    assert not vacuous["passed"]
+    with pytest.raises(ValueError, match="min_goodput_pct"):
+        check_gates({"min_goodput": 1.0}, {})
+
+
+# ---------------------------------------------------------------------------
+# fleet endpoints (real sockets)
+# ---------------------------------------------------------------------------
+
+def _get_json(address, path):
+    try:
+        with urllib.request.urlopen(f"{address}{path}", timeout=10) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def test_fleet_trace_serves_partial_past_dead_workers():
+    """GET /fleet/trace/<id>: found on whichever worker holds the trace,
+    a dead worker costs an error row (never the result), and 404 only
+    when NO reachable holder had the id."""
+    from mmlspark_tpu.observability import MetricsRegistry
+    from mmlspark_tpu.serving.distributed import TopologyService, WorkerServer
+    from tests.serving_helpers import Doubler
+
+    reg = MetricsRegistry()
+    svc = TopologyService(registry=reg, probe_interval_s=None,
+                          fleet_slow_deadline_s=5.0).start()
+    w = None
+    try:
+        wreg = MetricsRegistry()
+        w = WorkerServer(Doubler(), server_id="w1",
+                         driver_address=svc.address, port=0,
+                         registry=wreg).start()
+        # a registered-but-dead peer: the fan-out must serve past it
+        urllib.request.urlopen(urllib.request.Request(
+            f"{svc.address}/register",
+            data=json.dumps({"server_id": "dead", "host": "127.0.0.1",
+                             "port": 9, "api_path": "/score"}).encode(),
+            headers={"Content-Type": "application/json"}), timeout=10).close()
+        tid = "0af7651916cd43dd8448eb211c80319c"
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{w.server.port}{w.server.api_path}",
+            data=json.dumps(3.0).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-MMLSpark-Trace-Id": tid})
+        urllib.request.urlopen(req, timeout=10).close()
+        status, body = _get_json(svc.address, f"/fleet/trace/{tid}")
+        assert status == 200 and body["found"]
+        assert "w1" in body["trees"]
+        assert body["workers"]["w1"] == {"ok": True}
+        assert "error" in body["workers"]["dead"]      # partial, visibly
+        # the miss: every reachable worker said "not here" -> 404
+        status, body = _get_json(svc.address, "/fleet/trace/deadbeef")
+        assert status == 404 and not body["found"]
+        assert body["workers"]["w1"] == {"not_found": True}
+    finally:
+        if w is not None:
+            w.stop()
+        svc.stop()
+
+
+def test_e2e_mixed_load_goodput_capacity_agreement():
+    """THE acceptance drill: a continuous-decode worker fed mixed traffic
+    whose deadline class expires mid-flight.  The fleet capacity report
+    shows goodput < 100%, every wasted token is attributed (conservation
+    closes against the engine's own step/join counts), per-class token
+    throughput rides the loadgen stats, the goodput gate passes on real
+    samples, and /fleet/capacity's device-seconds/1k-tokens agrees with
+    the registry-derived figure within +-20%."""
+    from mmlspark_tpu.models import ModelRunner
+    from mmlspark_tpu.observability import MetricsRegistry
+    from mmlspark_tpu.observability.attribution import OUTCOMES
+    from mmlspark_tpu.serving.distributed import TopologyService, WorkerServer
+    from mmlspark_tpu.serving.loadgen import check_gates, mixed_load
+
+    reg, runner = _fresh("att.e2e")
+    scorer = runner.scorer(mode="decode", continuous=True, report_ttft=True,
+                           slots=4, prompt_bucket=8, max_new_tokens=96,
+                           page_size=4,
+                           encode=lambda t: [int(x) for x in t])
+    dreg = MetricsRegistry()
+    svc = TopologyService(registry=dreg, probe_interval_s=None,
+                          fleet_slow_deadline_s=10.0).start()
+    w = None
+    try:
+        w = WorkerServer(scorer, server_id="w0", driver_address=svc.address,
+                         request_class="decode", port=0, registry=reg,
+                         mode="continuous").start()
+        # baseline poll, then the class counters it will be differenced
+        # against — same instant, same data
+        status, _ = _get_json(svc.address, "/fleet/capacity?refresh=1")
+        assert status == 200
+        ctok = reg.family("mmlspark_request_class_decode_tokens_total")
+        cdev = reg.family("mmlspark_request_class_device_seconds_total")
+        tok0 = ctok.labels(**{"class": "decode"}).value
+        dev0 = cdev.labels(**{"class": "decode"}).value
+        prompt = json.dumps([5, 7, 11, 2])
+        res = mixed_load(
+            "127.0.0.1", w.server.port,
+            [{"name": "ok", "path": w.server.api_path, "body": prompt,
+              "headers": {"Content-Type": "application/json"},
+              "tokens_key": "tokens", "n_clients": 2, "per_client": 6},
+             {"name": "tight", "path": w.server.api_path, "body": prompt,
+              "headers": {"Content-Type": "application/json",
+                          "X-MMLSpark-Deadline-Ms": "10"},
+              "n_clients": 2, "per_client": 6}],
+            warm=1)
+        assert res["ok"]["completed"] > 0
+        # per-class decode token throughput (loadgen satellite)
+        assert res["ok"]["decode_tokens"] > 0
+        assert res["ok"]["decode_tokens_per_sec"] > 0
+        assert res["combined"]["decode_tokens"] == res["ok"]["decode_tokens"]
+        status, cap = _get_json(svc.address, "/fleet/capacity?refresh=1")
+        assert status == 200
+        by_outcome = cap["tokens_by_outcome"]
+        assert set(by_outcome) == set(OUTCOMES)
+        # wasted work happened and was attributed: the 10ms-deadline class
+        # expired mid-flight (and pad cells rode the partly-empty batch)
+        wasted = sum(v for o, v in by_outcome.items() if o != "useful")
+        assert wasted > 0 and cap["goodput_pct"] < 100.0
+        assert by_outcome["deadline_expired_midflight"] > 0
+        # conservation, fleet-ledger vs the engine's own accounting
+        dec = scorer._decoder
+        assert sum(by_outcome.values()) \
+            == dec.steps * dec.slots + dec.joined
+        # the goodput gate passes on real ledger samples
+        gate = check_gates({"min_goodput_pct": 1.0},
+                           {"goodput_pct": cap["goodput_pct"],
+                            "goodput_samples": cap["token_samples"]})
+        assert gate["passed"], gate
+        # capacity's windowed device cost agrees with the registry delta
+        row = cap["classes"]["decode"]
+        assert row["replicas"] == 1 and row["samples"] >= 2
+        d_tok = ctok.labels(**{"class": "decode"}).value - tok0
+        d_dev = cdev.labels(**{"class": "decode"}).value - dev0
+        assert d_tok > 0 and d_dev > 0
+        direct = 1000.0 * d_dev / d_tok
+        assert row["device_seconds_per_1k_tokens"] == \
+            pytest.approx(direct, rel=0.2)
+        assert 0.0 < row["device_utilization"] <= 1.0
+        assert row["arrival_rps"] > 0
+    finally:
+        if w is not None:
+            w.stop()
+        svc.stop()

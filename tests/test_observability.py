@@ -9,6 +9,7 @@ the histogram percentile math.
 import json
 import math
 import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -309,11 +310,21 @@ def test_metrics_endpoint_serves_prometheus_with_breakers():
                 srv.address, data=str(i).encode(),
                 headers={"Content-Type": "application/json"})
             urllib.request.urlopen(req, timeout=5).read()
-        text = urllib.request.urlopen(
-            f"http://127.0.0.1:{srv.port}/metrics").read().decode()
-        values, types, _ = parse_prometheus(text)
         label = f"127.0.0.1:{srv.port}"
         sv = frozenset([("server", label)])
+        # the reply reaches the client BEFORE the handler books its latency
+        # sample (observed after the response write, deliberately — the
+        # metric includes write time), so poll the scrape briefly until the
+        # last request's sample lands instead of racing it
+        deadline = time.monotonic() + 5.0
+        while True:
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics").read().decode()
+            values, types, _ = parse_prometheus(text)
+            if values.get(("mmlspark_serving_request_latency_seconds_count",
+                           sv)) == 3 or time.monotonic() > deadline:
+                break
+            time.sleep(0.01)
         # acceptance: latency histogram, queue gauge, counters, breaker state
         assert types["mmlspark_serving_request_latency_seconds"] == "histogram"
         assert values[("mmlspark_serving_request_latency_seconds_count", sv)] == 3

@@ -683,6 +683,104 @@ def test_tail_tolerance_surface_books_metrics():
             f"RoutingClient no longer registers {family}"
 
 
+def test_every_metric_family_has_a_docs_row():
+    """ISSUE 17 docs-coverage gate: every ``mmlspark_*`` family registered
+    anywhere in source (a literal first argument to a registry
+    ``counter``/``gauge``/``histogram`` call) must have a table row in
+    docs/OBSERVABILITY.md — this drift was hand-patched in every PR since
+    PR 2, so it is now machine-enforced like the stage sweep.  A row means
+    the backticked family name appears on a markdown table line; prose
+    mentions do not count (an operator greps the table)."""
+    root = pathlib.Path(mmlspark_tpu.__file__).parent
+    families = {}
+    for path in sorted(root.rglob("*.py")):
+        for node in ast.walk(ast.parse(path.read_text())):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("counter", "gauge", "histogram") \
+                    and node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str) \
+                    and node.args[0].value.startswith("mmlspark_"):
+                families.setdefault(node.args[0].value, []).append(
+                    f"{path.relative_to(root)}:{node.lineno}")
+    assert len(families) >= 80, \
+        f"only {len(families)} families found — the sweep itself broke"
+    doc = (root.parent / "docs" / "OBSERVABILITY.md").read_text()
+    table = "\n".join(ln for ln in doc.splitlines()
+                      if ln.lstrip().startswith("|"))
+    undocumented = {f: sites for f, sites in sorted(families.items())
+                    if f"`{f}`" not in table}
+    assert not undocumented, (
+        "metric families registered in source without a docs/"
+        f"OBSERVABILITY.md table row: {undocumented}")
+
+
+def test_attribution_surface_books_metrics():
+    """ISSUE 17 coverage: the goodput/cost plane is the denominator every
+    later decode optimisation is judged on, so its accounting must be
+    un-droppable.  Source-level (like the continuous-engine sweep): the
+    continuous step must amortize device time over live slots and book pad
+    cells, terminal releases must classify tokens through the outcome map,
+    the one-shot decode must book its ledger, the page pool must integrate
+    page-seconds at its edges, the server must emit the wide-event record
+    on both reply paths, and the hedge race must book the losing leg.
+    Live: runner construction registers the ledger families; server
+    construction registers the class-cost children."""
+    from mmlspark_tpu.models import runner as runner_mod
+    from mmlspark_tpu.observability import attribution
+    from mmlspark_tpu.observability.metrics import MetricsRegistry
+    from mmlspark_tpu.serving import PipelineServer
+    from mmlspark_tpu.serving import distributed as dist_mod
+    from mmlspark_tpu.serving import server as server_mod
+
+    adv_src = inspect.getsource(runner_mod.ContinuousDecoder._advance)
+    for needle in ("_c_device_s.inc", 'outcome="pad_row"',
+                   "cost.device_s += share"):
+        assert needle in adv_src, f"_advance() lost {needle}"
+    rel_src = inspect.getsource(runner_mod.ContinuousDecoder._release)
+    assert "_outcome_map[outcome]" in rel_src, \
+        "_release() no longer classifies terminal tokens"
+    dec_src = inspect.getsource(runner_mod.ModelRunner.decode)
+    for needle in ('outcome="useful"', 'outcome="pad_row"',
+                   'outcome="denied_row"'):
+        assert needle in dec_src, f"one-shot decode() lost {needle}"
+    pool_src = inspect.getsource(runner_mod.PagePool)
+    assert "_integrate_locked" in pool_src, \
+        "PagePool lost its page-seconds integral"
+    for fn in (server_mod.PipelineServer._score_batch,
+               server_mod.PipelineServer._submit_continuous):
+        assert "_emit_record" in inspect.getsource(fn), \
+            f"{fn.__name__} no longer emits the wide-event record"
+    emit_src = inspect.getsource(server_mod.PipelineServer._emit_record)
+    assert "_c_class_tokens" in emit_src and "_c_class_device" in emit_src
+    hedge_src = inspect.getsource(dist_mod.RoutingClient._hedged_exchange)
+    assert "_book_hedge_loser" in hedge_src, \
+        "the losing hedge leg's tokens are no longer booked"
+    assert 'outcome="hedge_loser"' in inspect.getsource(
+        dist_mod.RoutingClient._book_hedge_loser)
+    for outcome in attribution.ENGINE_OUTCOME_MAP.values():
+        assert outcome in attribution.OUTCOMES
+
+    reg = MetricsRegistry()
+    runner_mod.ModelRunner(apply_fn=lambda v, x: x, variables={},
+                           name="sweep17", registry=reg)
+    for family in ("mmlspark_decode_tokens_outcome_total",
+                   "mmlspark_decode_device_seconds_total",
+                   "mmlspark_runner_page_seconds_total"):
+        assert reg.family(family) is not None, \
+            f"ModelRunner no longer registers {family}"
+    reg2 = MetricsRegistry()
+    srv = PipelineServer(lambda df: df, registry=reg2)  # never started
+    try:
+        for family in ("mmlspark_request_class_decode_tokens_total",
+                       "mmlspark_request_class_device_seconds_total"):
+            assert reg2.family(family) is not None, \
+                f"PipelineServer no longer registers {family}"
+        assert srv._records is not None
+    finally:
+        reg2._flight_recorder.close()
+
+
 def test_topology_endpoint_sweep():
     """Every HTTP endpoint the TopologyService handler serves must appear
     in the declared ``TOPOLOGY_ENDPOINTS`` table (and vice versa): a new
@@ -690,6 +788,7 @@ def test_topology_endpoint_sweep():
     query-validation tests, and this sweep all key off.  Live half: every
     declared parameterless GET answers non-404 on a real socket."""
     import json
+    import urllib.error
     import urllib.request
 
     from mmlspark_tpu.serving import TopologyService
@@ -698,10 +797,13 @@ def test_topology_endpoint_sweep():
     svc = TopologyService(probe_interval_s=None)
     handler_src = inspect.getsource(svc._make_handler)
     # literal paths compared/prefixed in the handler, normalized: the
-    # prefix-matched "/flag/" read is declared as "/flag/<key>"
+    # prefix-matched "/flag/" and "/fleet/trace/" reads are declared as
+    # "/flag/<key>" / "/fleet/trace/<id>"
     import re
     literals = set(re.findall(r'"(/[a-z/]+)"', handler_src))
-    normalized = {"/flag/<key>" if p == "/flag/" else p for p in literals}
+    normalized = {{"/flag/": "/flag/<key>",
+                   "/fleet/trace/": "/fleet/trace/<id>"}.get(p, p)
+                  for p in literals}
     declared = {p for paths in TOPOLOGY_ENDPOINTS.values() for p in paths}
     assert normalized == declared, (
         f"handler endpoints {sorted(normalized)} drifted from the declared "
@@ -711,9 +813,17 @@ def test_topology_endpoint_sweep():
     svc.start()
     try:
         for path in TOPOLOGY_ENDPOINTS["GET"]:
-            url = f"{svc.address}{path.replace('<key>', 'sweep')}"
-            with urllib.request.urlopen(url, timeout=10) as r:
-                assert r.status == 200, f"{path} -> {r.status}"
+            url = f"{svc.address}" \
+                  f"{path.replace('<key>', 'sweep').replace('<id>', 'sweep')}"
+            try:
+                with urllib.request.urlopen(url, timeout=10) as r:
+                    status = r.status
+            except urllib.error.HTTPError as e:
+                status = e.code
+            # the trace lookup is the one declared GET whose healthy
+            # empty-fleet answer is 404 ("no worker holds the id")
+            want = 404 if path == "/fleet/trace/<id>" else 200
+            assert status == want, f"{path} -> {status} (want {want})"
     finally:
         svc.stop()
 
