@@ -20,11 +20,13 @@ from .checkers import (CheckpointAtomicityChecker, HotPathChecker,
                        TracerSafetyChecker, TransferDisciplineChecker,
                        UnboundedBlockingChecker, UndeadlinedRetryChecker)
 from .cli import default_checkers, main, rule_catalog, run_analysis
+from .concurrency import ConcurrencyChecker
 from .engine import AnalysisEngine, Checker, Finding, iter_python_files
 from .stagecheck import StageContractChecker
 
 __all__ = [
     "AnalysisEngine", "BaselineEntry", "Checker", "CheckpointAtomicityChecker",
+    "ConcurrencyChecker",
     "Finding", "HotPathChecker", "LockDisciplineChecker", "ResilienceCoverageChecker",
     "StageContractChecker", "TracerSafetyChecker",
     "TransferDisciplineChecker", "UnboundedBlockingChecker",

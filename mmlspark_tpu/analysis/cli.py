@@ -3,11 +3,19 @@
     python -m mmlspark_tpu.analysis [paths...] [--format text|json]
                                     [--update-baseline] [--baseline FILE]
                                     [--rules TRC001,RES001,...] [--no-baseline]
+                                    [--changed-only]
 
 Exit status: 0 when every finding is baselined (or none), 1 when any
 unbaselined finding exists, 2 on usage errors.  Default scan target is the
 ``mmlspark_tpu`` package the module was imported from; default baseline is
 ``analysis-baseline.toml`` next to the package (the repo root).
+
+``--changed-only`` scopes REPORTING to files git sees as changed (staged,
+unstaged, and untracked), while the analysis still parses the whole
+package: the cross-module passes (STG inheritance, TRC call BFS, the CCY
+lock graph) need every module in view to resolve — a staged-files-only
+SCAN would false-positive — but a reviewer only wants findings their
+diff can have introduced.
 """
 from __future__ import annotations
 
@@ -23,6 +31,7 @@ from .checkers import (CheckpointAtomicityChecker, HotPathChecker,
                        LockDisciplineChecker, ResilienceCoverageChecker,
                        TracerSafetyChecker, TransferDisciplineChecker,
                        UnboundedBlockingChecker, UndeadlinedRetryChecker)
+from .concurrency import ConcurrencyChecker
 from .engine import AnalysisEngine, Checker, Finding, iter_python_files
 from .stagecheck import StageContractChecker
 
@@ -34,7 +43,7 @@ def default_checkers() -> List[Checker]:
             UndeadlinedRetryChecker(), CheckpointAtomicityChecker(),
             LockDisciplineChecker(), HotPathChecker(),
             TransferDisciplineChecker(), StageContractChecker(),
-            UnboundedBlockingChecker()]
+            UnboundedBlockingChecker(), ConcurrencyChecker()]
 
 
 def rule_catalog() -> dict:
@@ -43,6 +52,30 @@ def rule_catalog() -> dict:
     for checker in default_checkers():
         catalog.update(checker.rules)
     return catalog
+
+
+def git_changed_files(root: str) -> Optional[List[str]]:
+    """Repo-relative paths of changed ``.py`` files (staged + unstaged +
+    untracked), or None when ``root`` is not a git work tree — the caller
+    then falls back to an unscoped report rather than reporting nothing."""
+    import subprocess
+    try:
+        out = subprocess.run(
+            ["git", "-C", root, "status", "--porcelain", "-uall"],
+            capture_output=True, text=True, timeout=30, check=True).stdout
+    except Exception:  # noqa: BLE001 — not a repo / no git: fall back
+        return None
+    changed: List[str] = []
+    for line in out.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:]
+        if " -> " in path:           # rename: report the new side
+            path = path.split(" -> ", 1)[1]
+        path = path.strip().strip('"')
+        if path.endswith(".py"):
+            changed.append(path)
+    return changed
 
 
 def _package_root() -> str:
@@ -82,7 +115,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         description="AST invariant checker: tracer safety (TRC), resilience "
                     "coverage (RES), lock discipline (LCK), hot-path "
                     "hygiene (HOT), transfer discipline (CMP), stage "
-                    "contracts (STG).")
+                    "contracts (STG), concurrency/deadlock (CCY).")
     parser.add_argument("paths", nargs="*",
                         help="files/dirs to scan (default: the "
                              "mmlspark_tpu package)")
@@ -105,6 +138,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--root", default=None,
                         help="repo root for relative paths (default: the "
                              "package's parent directory)")
+    parser.add_argument("--changed-only", action="store_true",
+                        help="report findings only in git-changed files "
+                             "(staged+unstaged+untracked); the full "
+                             "package is still parsed so cross-module "
+                             "rules resolve correctly")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -118,6 +156,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     rules = [r.strip() for r in args.rules.split(",") if r.strip()] \
         if args.rules else None
     findings = run_analysis(args.paths or None, root=root, rules=rules)
+
+    changed_scope: Optional[List[str]] = None
+    if args.changed_only:
+        if args.update_baseline:
+            parser.error("--changed-only cannot combine with "
+                         "--update-baseline (a scoped rewrite would drop "
+                         "every entry outside the diff)")
+        changed_scope = git_changed_files(root)
+        if changed_scope is not None:
+            in_scope = set(changed_scope)
+            findings = [f for f in findings if f.file in in_scope]
 
     baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE_NAME)
     if args.update_baseline:
@@ -138,6 +187,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if rules:
         # a restricted scan must not report out-of-scope entries as stale
         entries = [e for e in entries if e.rule.startswith(tuple(rules))]
+    if changed_scope is not None:
+        # same guard for the diff scope: an entry for an unchanged file has
+        # no matching finding left after the filter above and would be
+        # reported stale on every pre-commit run
+        in_scope = set(changed_scope)
+        entries = [e for e in entries if e.file in in_scope]
     new, accepted, stale = split_findings(findings, entries)
 
     if args.format == "json":

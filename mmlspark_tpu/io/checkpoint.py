@@ -39,6 +39,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
+from ..utils.concurrency import make_lock
+
 __all__ = ["atomic_write", "CheckpointManager", "checkpoint_instruments",
            "book_resume", "check_resume_arg", "snapshot_steps",
            "SNAPSHOT_RE", "topology_stanza", "topology_delta",
@@ -312,7 +314,7 @@ class CheckpointManager:
         self.last_error: Optional[BaseException] = None
         self._q: "queue.Queue" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
-        self._lock = threading.Lock()
+        self._lock = make_lock("CheckpointManager._lock")
         os.makedirs(self.directory, exist_ok=True)
 
     # ---------------------------------------------------------------- save

@@ -49,4 +49,11 @@ def stream(source_df_fn, url: str, interval_s: float = 1.0, max_batches: int = 0
 
     t = threading.Thread(target=loop, daemon=True)
     t.start()
-    return stop_evt.set
+
+    def stop() -> None:
+        stop_evt.set()
+        # the loop wakes within one interval; join so callers observe the
+        # final push complete instead of racing it into teardown
+        t.join(timeout=interval_s + 5.0)
+
+    return stop

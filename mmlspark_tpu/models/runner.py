@@ -55,6 +55,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..core import DataFrame, Transformer
+from ..utils.concurrency import make_condition, make_lock
 from ..core.schema import ColumnType
 
 __all__ = ["ModelRunner", "DecodeResult", "PagePool", "ContinuousDecoder",
@@ -214,7 +215,7 @@ class PagePool:
         self._name = name
         #: free physical pages; page 0 (trash) is never in this list
         self._free = list(range(self.num_pages - 1, 0, -1))
-        self._cond = threading.Condition(threading.Lock())
+        self._cond = make_condition("PagePool._cond")
         self._cache = None          # built lazily, rebuilt if dropped
         self._cache_nbytes = 0
         self._borrowed = False
@@ -454,7 +455,7 @@ class ModelRunner:
         #: name -> InstrumentedJit wrappers this runner created (compile
         #: introspection for tests and compile_stats)
         self._wrappers: list = []
-        self._lock = threading.Lock()
+        self._lock = make_lock("ModelRunner._lock")
         reg = self.registry
         c_batches = reg.counter(
             "mmlspark_runner_batches_total",
@@ -1497,7 +1498,7 @@ class ContinuousDecoder:
         self._handles: List[Optional[StreamHandle]] = [None] * self.slots
         self._free: List[int] = list(range(self.slots - 1, -1, -1))
         self._arrivals: "deque[StreamHandle]" = deque()
-        self._cond = threading.Condition(threading.Lock())
+        self._cond = make_condition("ContinuousDecoder._cond")
         self._cache = None
         self._live = 0
         self._closed = False
@@ -1693,7 +1694,8 @@ class ContinuousDecoder:
                 jnp.zeros((S, self.table_w), jnp.int32),
                 jnp.ones(S, bool), self._cache)
         except Exception:
-            self._poisoned = True  # donated slab state unknown (see step)
+            with self._cond:  # same lock as close()/_abort readers (CCY002)
+                self._poisoned = True  # donated slab state unknown (see step)
             raise
         if self._live == 0:
             self._return_cache_if_idle()
@@ -1723,8 +1725,11 @@ class ContinuousDecoder:
         except Exception:
             # a failed dispatch leaves the donated slab state unknown —
             # poison the borrow so close()/abort return None and the next
-            # borrower rebuilds zeros instead of consuming a dead buffer
-            self._poisoned = True
+            # borrower rebuilds zeros instead of consuming a dead buffer;
+            # under the engine lock: close() on another thread reads the
+            # flag deciding return-vs-drop of the borrowed slabs (CCY002)
+            with self._cond:
+                self._poisoned = True
             raise
         finally:
             _exit_phase(_phase)
@@ -2170,7 +2175,7 @@ class _RunnerScorer(Transformer):
         self.continuous = bool(continuous)
         self.report_ttft = bool(report_ttft)
         self._decoder: Optional[ContinuousDecoder] = None
-        self._dec_lock = threading.Lock()
+        self._dec_lock = make_lock("_RunnerScorer._dec_lock")
         #: duck-typed health signal (ISSUE 16): PipelineServer's /health
         #: reads it — a quarantined runner flips it False so the fleet's
         #: probes evict the worker

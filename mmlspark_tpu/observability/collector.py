@@ -39,6 +39,7 @@ import time
 from typing import Any, Callable, Deque, Dict, List, Optional
 
 from .metrics import MetricsRegistry, get_registry
+from ..utils.concurrency import make_lock
 
 __all__ = ["SpanCollector", "get_collector", "OTLP_ENDPOINT_ENV",
            "OTLP_SAMPLE_ENV", "OTLP_SLOW_S_ENV"]
@@ -119,7 +120,7 @@ class SpanCollector:
             epoch_offset_s = time.time() - time.monotonic() \
                 if clock is time.monotonic else 0.0
         self.epoch_offset_s = float(epoch_offset_s)
-        self._lock = threading.Lock()
+        self._lock = make_lock("SpanCollector._lock")
         self._ring: Deque = collections.deque(maxlen=self.capacity)
         self._export_q: Deque = collections.deque(maxlen=self.capacity)
         self._wake = threading.Event()
@@ -328,7 +329,7 @@ class SpanCollector:
                 "spans": out}]}]}
 
 
-_collector_lock = threading.Lock()
+_collector_lock = make_lock("collector._collector_lock")
 
 
 def get_collector(registry: Optional[MetricsRegistry] = None) -> SpanCollector:

@@ -34,6 +34,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .metrics import MetricsRegistry, _escape_label, _fmt_value, get_registry
+from ..utils.concurrency import make_lock
 from ..utils.resilience import Deadline
 
 __all__ = ["parse_prometheus", "FleetView", "MetricsFederator",
@@ -448,7 +449,7 @@ class MetricsFederator:
         # need distinct names or the later one owns the shared series
         self.name = str(name)
         self._client = None  # lazily built io/http client
-        self._lock = threading.Lock()
+        self._lock = make_lock("MetricsFederator._lock")
         self._last_ok: Dict[str, float] = {}
         self._view: Optional[FleetView] = None
         self.reopen()
@@ -483,7 +484,7 @@ class MetricsFederator:
             self.deadline_s if deadline_s is None else float(deadline_s),
             self.clock)
         results: Dict[str, Tuple[str, object]] = {}
-        results_lock = threading.Lock()
+        results_lock = make_lock("MetricsFederator._results_lock")
 
         def fetch(sid: str, w: Dict) -> None:
             url = f"http://{w['host']}:{w['port']}/metrics"

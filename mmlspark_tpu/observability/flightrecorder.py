@@ -48,6 +48,7 @@ import weakref
 from typing import Any, Callable, Dict, List, Optional
 
 from .metrics import MetricsRegistry, get_registry
+from ..utils.concurrency import make_lock
 
 __all__ = ["FlightRecorder", "get_flight_recorder",
            "flightrecorder_instruments", "DUMP_DIR_ENV"]
@@ -113,7 +114,7 @@ class FlightRecorder:
         self.clock = clock
         self._label = f"r{next(_RECORDER_IDS)}"
         self._m = flightrecorder_instruments(self.registry)
-        self._lock = threading.Lock()
+        self._lock = make_lock("FlightRecorder._lock")
         self._seq = itertools.count()
         self._last_dump_s: Optional[float] = None
         #: counter-family baseline from the previous snapshot: the dump
@@ -353,7 +354,7 @@ class FlightRecorder:
             self.registry._flight_recorder = None
 
 
-_recorder_lock = threading.Lock()
+_recorder_lock = make_lock("flightrecorder._recorder_lock")
 
 
 def get_flight_recorder(registry: Optional[MetricsRegistry] = None,

@@ -51,6 +51,7 @@ import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from .metrics import MetricsRegistry, get_registry
+from ..utils.concurrency import make_lock
 from .tracing import thread_phases
 
 __all__ = ["SamplingProfiler", "ProfilerBusy", "profile_window",
@@ -183,7 +184,7 @@ class SamplingProfiler:
         self.include_idle = bool(include_idle)
         self.clock = clock
         self._m = profiler_instruments(self.registry)
-        self._lock = threading.Lock()
+        self._lock = make_lock("SamplingProfiler._lock")
         #: (span, folded_stack) -> count, bounded at max_stacks entries
         self._stacks: Dict[Tuple[str, str], int] = {}
         self._by_span: Dict[str, int] = {}
@@ -300,7 +301,7 @@ class SamplingProfiler:
 
 # one window at a time per process: two concurrent samplers would double
 # the very overhead each is trying to measure (and race the jax trace dir)
-_WINDOW_LOCK = threading.Lock()
+_WINDOW_LOCK = make_lock("profiling._WINDOW_LOCK")
 
 
 class _JaxTraceHatch:

@@ -40,6 +40,7 @@ from ..observability.slo import SLOEngine
 from ..observability.tracing import (TRACE_HEADER, TRACEPARENT_HEADER,
                                      current_span, current_trace_id,
                                      format_traceparent)
+from ..utils.concurrency import make_lock
 from ..utils.resilience import (CircuitBreaker, Deadline, RetryBudget,
                                 current_deadline)
 
@@ -232,12 +233,13 @@ class TopologyService:
         self._m_fr = flightrecorder_instruments(self.registry)
         get_flight_recorder(self.registry)
         _roster(self.registry, "_topology_services").add(self)
-        self._lock = threading.Lock()
+        self._lock = make_lock("TopologyService._lock")
         self._workers: Dict[str, Dict] = {}
         self._fail_counts: Dict[str, int] = {}
         self._evicted: Dict[str, Dict] = {}
         self._flags: Dict[str, str] = {}
         self._httpd: Optional[ThreadingHTTPServer] = None
+        self._httpd_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._probe_thread: Optional[threading.Thread] = None
         # fleet telemetry plane (ISSUE 11): federated /metrics, SLO
@@ -262,7 +264,7 @@ class TopologyService:
         # headroom — fed once per federation tick, served at
         # GET /fleet/capacity
         self.capacity = CapacityModel(clock=telemetry_clock)
-        self._fleet_lock = threading.Lock()
+        self._fleet_lock = make_lock("TopologyService._fleet_lock")
         self._last_view = None
         self._last_slo: Optional[Dict] = None
         self._last_autoscale: Optional[Dict] = None
@@ -581,8 +583,9 @@ class TopologyService:
         self._httpd = ThreadingHTTPServer((self.host, self.port),
                                           self._make_handler())
         self.port = self._httpd.server_port
-        t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
-        t.start()
+        self._httpd_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._httpd_thread.start()
         if self.probe_interval_s is not None:
             self._probe_thread = threading.Thread(target=self._probe_loop,
                                                   daemon=True)
@@ -606,10 +609,12 @@ class TopologyService:
         # and an old loop still mid-probe when it is cleared would revive
         # and run ALONGSIDE the restart's fresh threads (double-counted
         # probes evict healthy workers at half the intended threshold)
-        for t in (self._probe_thread, self._federation_thread):
+        for t in (self._probe_thread, self._federation_thread,
+                  self._httpd_thread):
             if t is not None and t.is_alive():
                 t.join(timeout=10.0)
         self._probe_thread = self._federation_thread = None
+        self._httpd_thread = None
         # the federator's stale-workers callback gauge closes over this
         # service's routing table — a stopped driver must not scrape on
         self.federator.close()
@@ -713,7 +718,7 @@ class TopologyService:
         self._prune_fleet_breakers({sid for sid, _ in workers})
         per_worker: Dict[str, Dict] = {}
         results: Dict[str, tuple] = {}
-        results_lock = threading.Lock()
+        results_lock = make_lock("TopologyService._stats_results_lock")
 
         def fetch(sid: str, w: Dict, breaker: CircuitBreaker) -> None:
             try:
@@ -971,6 +976,11 @@ class MembershipWatcher:
         self.last_workers: Optional[Dict[str, int]] = None  # sid -> generation
         self.last_instance: Optional[str] = None
         self.shrinks = 0
+        # guards the view compare-and-update: poll_once runs on the
+        # watcher thread AND as a public probe (tests, manual ticks) — an
+        # unlocked interleaving can diff against a half-updated view and
+        # preempt a healthy collective (CCY002)
+        self._state_lock = make_lock("MembershipWatcher._state_lock")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -988,41 +998,46 @@ class MembershipWatcher:
                    if self.roles is None
                    or (w or {}).get("role") in self.roles}
         inst = m.get("instance")
-        first = self.last_epoch is None
-        restarted = not first and (
-            (inst is not None and self.last_instance is not None
-             and inst != self.last_instance)
-            or epoch < self.last_epoch)
-        if restarted:
-            # a NEW instance token (or, pre-upgrade, an epoch that went
-            # backwards): a restarted (fresh, in-memory) membership
-            # plane, not a transition — the old view is incomparable.
-            # The token matters because a restart whose re-registrations
-            # already pushed the fresh epoch PAST our last-seen value
-            # looks like a plain advance.  Rebaseline instead of diffing
-            # across service instances: a restarted driver's half-empty
-            # registry would read as "every peer lost" and preempt a
-            # healthy collective, and a lost membership view must
-            # degrade to signal-only preemption, never kill the run it
-            # guards.
+        # compare-and-update under the state lock (the HTTP fetch above
+        # and the on_shrink callback below stay outside it); the callback
+        # fires AFTER the view commits, so a reentrant poll_once from
+        # inside on_shrink diffs against the new baseline, not a torn one
+        with self._state_lock:
+            first = self.last_epoch is None
+            restarted = not first and (
+                (inst is not None and self.last_instance is not None
+                 and inst != self.last_instance)
+                or epoch < self.last_epoch)
+            if restarted:
+                # a NEW instance token (or, pre-upgrade, an epoch that went
+                # backwards): a restarted (fresh, in-memory) membership
+                # plane, not a transition — the old view is incomparable.
+                # The token matters because a restart whose re-registrations
+                # already pushed the fresh epoch PAST our last-seen value
+                # looks like a plain advance.  Rebaseline instead of diffing
+                # across service instances: a restarted driver's half-empty
+                # registry would read as "every peer lost" and preempt a
+                # healthy collective, and a lost membership view must
+                # degrade to signal-only preemption, never kill the run it
+                # guards.
+                self.last_epoch, self.last_workers = epoch, workers
+                self.last_instance = inst
+                return None
+            # a shrink is a worker the last view HAD that this one lost —
+            # keyed by id AND generation, not a count compare: an eviction
+            # masked by an unrelated join keeps the count flat, and a crash
+            # whose supervisor re-registers the same id with generation+1
+            # inside one poll interval keeps even the ID SET flat — in both
+            # cases the collective's original peer process is dead
+            lost = set() if first else {
+                sid for sid, gen in self.last_workers.items()
+                if workers.get(sid, -1) != gen}
+            shrunk = not first and epoch > self.last_epoch and bool(lost)
             self.last_epoch, self.last_workers = epoch, workers
             self.last_instance = inst
-            return None
-        # a shrink is a worker the last view HAD that this one lost —
-        # keyed by id AND generation, not a count compare: an eviction
-        # masked by an unrelated join keeps the count flat, and a crash
-        # whose supervisor re-registers the same id with generation+1
-        # inside one poll interval keeps even the ID SET flat — in both
-        # cases the collective's original peer process is dead
-        lost = set() if first else {
-            sid for sid, gen in self.last_workers.items()
-            if workers.get(sid, -1) != gen}
-        shrunk = not first and epoch > self.last_epoch and bool(lost)
-        self.last_epoch, self.last_workers = epoch, workers
-        self.last_instance = inst
-        if not shrunk:
-            return None
-        self.shrinks += 1
+            if not shrunk:
+                return None
+            self.shrinks += 1
         info = {"epoch": epoch, "workers": len(workers),
                 "lost": sorted(lost)}
         if self.on_shrink is not None:
@@ -1170,7 +1185,7 @@ class RoutingClient:
         self._table: List[Dict] = []
         self._fetched = 0.0
         self._rr = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("RoutingClient._lock")
 
     def _breaker_for(self, sid: str) -> Optional[CircuitBreaker]:
         if not self.per_worker_breakers:
@@ -1343,7 +1358,7 @@ class RoutingClient:
             return self._attempt(w, payload, timeout, deadline)
         results: "queue.Queue" = queue.Queue()
         race = {"winner": None}
-        race_lock = threading.Lock()
+        race_lock = make_lock("RoutingClient._race_lock")
 
         def leg(name: str, wk: Dict) -> None:
             res = self._attempt(wk, payload, timeout, deadline)

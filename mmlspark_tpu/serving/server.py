@@ -37,6 +37,7 @@ from ..observability.tracing import (Span, TRACE_HEADER, TRACEPARENT_HEADER,
                                      export_span, format_traceparent,
                                      new_trace_id, parse_traceparent,
                                      trace_span)
+from ..utils.concurrency import make_lock
 from ..utils.resilience import (Deadline, deadline_scope,
                                 register_preemption_hook,
                                 unregister_preemption_hook)
@@ -85,7 +86,7 @@ class ServingStats:
     """
 
     def __init__(self):
-        self.lock = threading.Lock()
+        self.lock = make_lock("ServingStats.lock")
         self.received = 0
         self.replied = 0
         self.errors = 0
@@ -221,7 +222,7 @@ class PipelineServer:
         self.drain_timeout_s = drain_timeout_s
         self._draining = threading.Event()
         self._drained = threading.Event()
-        self._drain_lock = threading.Lock()
+        self._drain_lock = make_lock("PipelineServer._drain_lock")
         self._preemption_hook = None
         # metrics: families on the (shared, injectable) registry; children
         # are labelled per server instance once the port is resolved so many
@@ -297,7 +298,7 @@ class PipelineServer:
         # request inline instead of paying two thread hand-offs through the
         # queue (reference continuous mode reaches ~1 ms,
         # docs/mmlspark-serving.md:10-11; the hand-off alone costs ~0.5 ms)
-        self._inline_lock = threading.Lock()
+        self._inline_lock = make_lock("PipelineServer._inline_lock")
 
     _STATUSES = ("received", "replied", "shed", "error", "write_error")
 
@@ -1136,6 +1137,15 @@ class PipelineServer:
         closer = getattr(self.model, "continuous_close", None)
         if closer is not None:
             closer()
+        # retire the accept/worker threads before returning: a stop() that
+        # leaves the worker mid-drain races a restart's fresh worker into
+        # the same scorer, and chaos drills cannot tell a leaked thread
+        # from a hang.  Both loops observe _stop within one 0.1s poll, so
+        # the join bound is slack, not a grace period.
+        for t in self._threads:
+            if t.is_alive() and t is not threading.current_thread():
+                t.join(timeout=5.0)
+        self._threads = []
         # unhook the callback gauges: their closures capture this server,
         # so leaving them registered would pin a stopped server (and emit
         # frozen queue/EWMA series) for process lifetime.  Counter and

@@ -62,6 +62,7 @@ class HTTPStreamSource:
         self._pending: Dict[str, _Pending] = {}
         self._lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
+        self._accept_thread: Optional[threading.Thread] = None
 
     def _make_handler(self):
         src = self
@@ -146,13 +147,20 @@ class HTTPStreamSource:
         self._httpd = ThreadingHTTPServer((self.host, self.port),
                                           self._make_handler())
         self.port = self._httpd.server_port
-        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        self._accept_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._accept_thread.start()
         return self
 
     def stop(self) -> None:
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
+        t, self._accept_thread = self._accept_thread, None
+        if t is not None and t.is_alive():
+            # shutdown() already unwound serve_forever; the join only
+            # fences the handoff so a restart cannot race the old acceptor
+            t.join(timeout=5.0)
 
     @property
     def address(self) -> str:
@@ -268,6 +276,12 @@ class StreamingQuery:
 
     def stop(self) -> None:
         self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None and thread.is_alive():
+            # the trigger loop wakes within one interval (or one drained
+            # batch); an unjoined loop here would race a restarted query
+            # into the same source's pending map
+            thread.join(timeout=5.0)
         self.source.stop()
         closer = getattr(self.model, "continuous_close", None)
         if closer is not None:

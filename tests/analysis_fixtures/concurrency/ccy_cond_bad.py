@@ -1,0 +1,21 @@
+"""CCY003 fixture: a ``Condition.wait()`` guarded by a bare ``if`` (a
+spurious wakeup or stolen predicate proceeds on stale state) and a
+``notify()`` fired without the condition's lock held (the waiter can miss
+the wakeup racing the predicate write)."""
+import threading
+
+
+class Mailbox:
+    def __init__(self):
+        self._cond = threading.Condition(threading.Lock())
+        self._items = []
+
+    def take(self):
+        with self._cond:
+            if not self._items:            # bad: if, not while
+                self._cond.wait(timeout=1.0)
+            return self._items.pop()
+
+    def put(self, item):
+        self._items.append(item)
+        self._cond.notify()                # bad: lock not held

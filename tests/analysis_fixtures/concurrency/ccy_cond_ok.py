@@ -1,0 +1,25 @@
+"""CCY003 near-miss: wait sits in a predicate loop (or uses ``wait_for``),
+notify fires with the condition's lock held."""
+import threading
+
+
+class Mailbox:
+    def __init__(self):
+        self._cond = threading.Condition(threading.Lock())
+        self._items = []
+
+    def take(self):
+        with self._cond:
+            while not self._items:
+                self._cond.wait(timeout=1.0)
+            return self._items.pop()
+
+    def take_for(self):
+        with self._cond:
+            self._cond.wait_for(lambda: bool(self._items), timeout=1.0)
+            return self._items.pop()
+
+    def put(self, item):
+        with self._cond:
+            self._items.append(item)
+            self._cond.notify()

@@ -1,0 +1,28 @@
+"""CCY001 fixture: lock-order cycle, lexically and through a call edge.
+
+``Booker`` takes ``_stats_lock`` then ``_flush_lock``; ``Flusher`` takes
+``_flush_lock`` and then CALLS into a helper that takes ``_stats_lock`` —
+the cycle only closes across the call edge, which is exactly what a
+per-function lexical scan misses.
+"""
+import threading
+
+
+class Booker:
+    def __init__(self):
+        self._stats_lock = threading.Lock()
+        self._flush_lock = threading.Lock()
+        self.stats = {}
+
+    def book(self, key):
+        with self._stats_lock:
+            with self._flush_lock:        # order: stats -> flush
+                self.stats[key] = self.stats.get(key, 0) + 1
+
+    def _update_stats(self):
+        with self._stats_lock:
+            self.stats["flushes"] = self.stats.get("flushes", 0) + 1
+
+    def flush(self):
+        with self._flush_lock:            # order: flush -> (call) -> stats
+            self._update_stats()

@@ -1,0 +1,23 @@
+"""CCY001 near-miss: same two locks, every path takes them in ONE order
+(stats before flush) — the graph has edges but no cycle."""
+import threading
+
+
+class Booker:
+    def __init__(self):
+        self._stats_lock = threading.Lock()
+        self._flush_lock = threading.Lock()
+        self.stats = {}
+
+    def book(self, key):
+        with self._stats_lock:
+            with self._flush_lock:
+                self.stats[key] = self.stats.get(key, 0) + 1
+
+    def _flush_locked(self):
+        with self._flush_lock:
+            pass
+
+    def flush(self):
+        with self._stats_lock:            # same order as book()
+            self._flush_locked()

@@ -1,0 +1,22 @@
+"""CCY004 fixture: a worker thread started onto ``self._thread`` whose
+class has a ``close()`` that never joins it, plus a fire-and-forget
+anonymous thread with no handle at all."""
+import threading
+
+
+class Pumper:
+    def __init__(self):
+        self._thread = None
+        self.closed = False
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while not self.closed:
+            pass
+
+    def close(self):
+        self.closed = True                 # bad: no join on _thread

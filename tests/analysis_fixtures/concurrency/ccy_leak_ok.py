@@ -1,0 +1,36 @@
+"""CCY004 near-miss: every started thread has a bounded join (or
+``Timer.cancel``) reachable from the teardown path — ``close()`` delegates
+to ``stop()``, which joins with a timeout; the local worker joins in the
+same function; the timer is cancelled."""
+import threading
+
+
+class Pumper:
+    def __init__(self):
+        self._thread = None
+        self._timer = None
+        self.closed = False
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        self._timer = threading.Timer(30.0, self._loop)
+        self._timer.start()
+
+    def _loop(self):
+        while not self.closed:
+            pass
+
+    def stop(self):
+        self.closed = True
+        self._timer.cancel()
+        self._thread.join(timeout=5.0)
+
+    def close(self):
+        self.stop()
+
+
+def run_batch(items):
+    t = threading.Thread(target=list, args=(items,))
+    t.start()
+    t.join(timeout=10.0)

@@ -1,0 +1,28 @@
+"""CCY002 fixture: ``_backlog`` is mutated by the flusher thread's loop and
+by the public ``submit()`` with no common lock (the check-then-act shape);
+``_generation`` is mutated under two DIFFERENT locks — disjoint locks are
+the same race wearing a disguise."""
+import threading
+
+
+class Flusher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._aux_lock = threading.Lock()
+        self._backlog = []
+        self._generation = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        while True:
+            if self._backlog:
+                self._backlog = []          # thread side: no lock
+            with self._aux_lock:
+                self._generation += 1       # thread side: aux lock only
+
+    def submit(self, item):
+        self._backlog = self._backlog + [item]   # public side: no lock
+
+    def bump(self):
+        with self._lock:
+            self._generation += 1           # public side: other lock

@@ -1,0 +1,25 @@
+"""CCY002 near-miss: every mutation of the shared attributes — thread loop
+and public API alike — happens under the SAME lock."""
+import threading
+
+
+class Flusher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._backlog = []
+        self._generation = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                if self._backlog:
+                    self._backlog = []
+                self._generation += 1
+
+    def submit(self, item):
+        with self._lock:
+            self._backlog = self._backlog + [item]
+
+    def stop(self):
+        self._thread.join(timeout=5.0)

@@ -8,6 +8,14 @@ in-process without TPU hardware; bench.py separately targets the real chip.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: env presets a TPU platform
+
+# Runtime lock-order sanitizer (ISSUE 18): every make_lock/make_condition
+# in the package becomes an order-validating wrapper for the whole tier-1
+# run, so any lock inversion a test provokes trips HERE, not in a
+# production hang.  setdefault is the kill switch: export
+# MMLSPARK_TPU_LOCK_SANITIZER=0 to opt a run out (or =strict to fail on
+# first inversion instead of recording).
+os.environ.setdefault("MMLSPARK_TPU_LOCK_SANITIZER", "1")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

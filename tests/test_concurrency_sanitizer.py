@@ -1,0 +1,373 @@
+"""Runtime lock-order sanitizer (utils/concurrency) — registry semantics,
+make_lock mode gating, the static x dynamic composition, and the two-lock
+inversion drill proving the sanitizer trips BEFORE the hang it predicts.
+
+The static CCY pass (tests/test_static_analysis.py) proves the graph the
+AST can see; this file proves the half that watches orders actually
+happen — and that the same inverted-fixture shape is caught by BOTH
+halves (ISSUE 18 acceptance).
+"""
+import os
+import threading
+
+import pytest
+
+from mmlspark_tpu.utils.concurrency import (LockOrderRegistry,
+                                            LockOrderViolation, OrderedLock,
+                                            SANITIZER_ENV, get_lock_registry,
+                                            make_condition, make_lock,
+                                            make_rlock, sanitizer_mode,
+                                            validate_lock_order)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _pair(registry):
+    return (OrderedLock("A", registry), OrderedLock("B", registry))
+
+
+# ---------------------------------------------------------------------------
+# registry semantics (own instances — never the global tier-1 registry)
+# ---------------------------------------------------------------------------
+
+def test_nested_acquire_books_an_order_edge():
+    reg = LockOrderRegistry(strict=False, book=False)
+    a, b = _pair(reg)
+    with a:
+        assert reg.held() == ["A"]
+        with b:
+            assert reg.held() == ["A", "B"]
+    assert reg.held() == []
+    assert ("A", "B") in reg.edges()
+    assert ("B", "A") not in reg.edges()
+    assert reg.total_violations == 0
+
+
+def test_inversion_is_booked_in_record_mode():
+    reg = LockOrderRegistry(strict=False, book=False)
+    a, b = _pair(reg)
+    with a:
+        with b:
+            pass
+    with b:
+        with a:              # inverts the observed A -> B order
+            pass
+    vs = reg.violations()
+    assert [v.kind for v in vs] == ["inversion"]
+    assert vs[0].chain == ["B", "A"]
+    assert "deadlock" in vs[0].message
+
+
+def test_strict_mode_raises_before_the_blocking_acquire():
+    """The violation fires at note_acquiring — BEFORE OrderedLock touches
+    the inner primitive — so a strict drill trips where a real inversion
+    would hang.  Proof: the inner lock is still free after the raise."""
+    reg = LockOrderRegistry(strict=True, book=False)
+    a, b = _pair(reg)
+    with a:
+        with b:
+            pass
+    with b:
+        with pytest.raises(LockOrderViolation):
+            a.acquire()
+    assert a._inner.acquire(blocking=False), \
+        "strict trip must not leave the inner lock held"
+    a._inner.release()
+
+
+def test_violation_dedups_once_per_pair_per_thread():
+    reg = LockOrderRegistry(strict=False, book=False)
+    a, b = _pair(reg)
+    with a:
+        with b:
+            pass
+    for _ in range(5):       # same inversion, same thread: booked once
+        with b:
+            with a:
+                pass
+    assert reg.total_violations == 1
+    # a DIFFERENT thread hitting the same pair books its own violation
+    def invert():
+        with b:
+            with a:
+                pass
+    t = threading.Thread(target=invert)
+    t.start()
+    t.join(timeout=5.0)
+    assert reg.total_violations == 2
+
+
+def test_validate_finds_cycles_pairwise_checks_cannot():
+    """A 3-cycle (A->B, B->C, C->A) never inverts any single pair, so no
+    acquire-time check fires — only the graph pass sees it."""
+    reg = LockOrderRegistry(strict=False, book=False)
+    a, b = _pair(reg)
+    c = OrderedLock("C", reg)
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with c:
+        with a:
+            pass
+    assert reg.total_violations == 0, "no pairwise inversion exists"
+    vs = reg.validate()
+    assert [v.kind for v in vs] == ["cycle"]
+    assert vs[0].chain == ["A", "B", "C"]
+
+
+def test_validate_composes_static_edges_with_observed_orders():
+    """The composite neither half sees alone: runtime observed A -> B,
+    the static CCY001 graph carries B -> A (an order some OTHER code path
+    establishes) — merged, they cycle."""
+    reg = LockOrderRegistry(strict=False, book=False)
+    a, b = _pair(reg)
+    with a:
+        with b:
+            pass
+    assert reg.validate() == []
+    vs = reg.validate(static_edges=[("B", "A")])
+    assert [v.kind for v in vs] == ["cycle"]
+    assert vs[0].chain == ["A", "B"]
+
+
+def test_release_out_of_lifo_order_pops_the_right_hold():
+    reg = LockOrderRegistry(strict=False, book=False)
+    a, b = _pair(reg)
+    a.acquire(); b.acquire()
+    a.release()              # Condition.wait-style mid-stack release
+    assert reg.held() == ["B"]
+    b.release()
+    assert reg.held() == []
+
+
+def test_rlock_reentry_books_no_self_edge():
+    reg = LockOrderRegistry(strict=False, book=False)
+    r = OrderedLock("R", reg, reentrant=True)
+    with r:
+        with r:
+            pass
+    assert ("R", "R") not in reg.edges()
+    assert reg.total_violations == 0
+
+
+# ---------------------------------------------------------------------------
+# the make_lock factory and the env knob
+# ---------------------------------------------------------------------------
+
+def test_make_lock_mode_gating(monkeypatch):
+    monkeypatch.setenv(SANITIZER_ENV, "0")
+    assert sanitizer_mode() == "off"
+    assert isinstance(make_lock("X"), type(threading.Lock()))
+    monkeypatch.setenv(SANITIZER_ENV, "1")
+    assert sanitizer_mode() == "record"
+    assert isinstance(make_lock("X"), OrderedLock)
+    assert isinstance(make_rlock("X"), OrderedLock)
+    monkeypatch.setenv(SANITIZER_ENV, "strict")
+    assert sanitizer_mode() == "strict"
+    # an explicit registry forces the wrapper even when the knob is off
+    monkeypatch.setenv(SANITIZER_ENV, "0")
+    reg = LockOrderRegistry(strict=False, book=False)
+    assert isinstance(make_lock("X", registry=reg), OrderedLock)
+
+
+def test_make_condition_waits_release_and_rebook_the_hold(monkeypatch):
+    monkeypatch.setenv(SANITIZER_ENV, "1")
+    reg = LockOrderRegistry(strict=False, book=False)
+    cond = make_condition("M._cond", reg)
+    got = []
+
+    def consumer():
+        with cond:
+            while not got:
+                cond.wait(timeout=5.0)
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    with cond:               # wait() released the hold: this cannot hang
+        got.append(1)
+        cond.notify()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert reg.held() == []
+    assert reg.total_violations == 0
+
+
+def test_violations_are_booked_to_the_metric_and_event_ring():
+    from mmlspark_tpu.core.logging import recent_events
+    from mmlspark_tpu.observability.metrics import get_registry
+    reg = LockOrderRegistry(strict=False, book=True)
+    a, b = _pair(reg)
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    fam = get_registry().counter(
+        "mmlspark_lock_order_violations_total", "", labels=("kind",))
+    assert fam.value(kind="inversion") >= 1
+    assert any(e.get("event") == "lock_order_violation"
+               for e in recent_events())
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate: the whole suite ran under the sanitizer — stay clean
+# ---------------------------------------------------------------------------
+
+def test_global_registry_has_no_violations_and_serializable_orders():
+    """conftest exports MMLSPARK_TPU_LOCK_SANITIZER=1, so every make_lock
+    in the package reported here all suite long.  Zero booked violations
+    AND a cycle-free observed graph composed with the static CCY001 edges
+    is the runtime acceptance bar (ISSUE 18)."""
+    if sanitizer_mode() == "off":
+        pytest.skip("sanitizer disabled for this run")
+    reg = get_lock_registry()
+    assert [v.as_dict() for v in reg.violations()] == []
+    from mmlspark_tpu.analysis import AnalysisEngine, ConcurrencyChecker
+    from mmlspark_tpu.analysis.engine import iter_python_files
+    checker = ConcurrencyChecker()
+    engine = AnalysisEngine([checker], root=REPO)
+    engine.run(iter_python_files(os.path.join(REPO, "mmlspark_tpu")))
+    assert validate_lock_order(
+        static_edges=checker.lock_order_edges()) == []
+
+
+# ---------------------------------------------------------------------------
+# regressions for the true positives this PR fixed (CCY002 / CCY004)
+# ---------------------------------------------------------------------------
+
+def test_pipeline_server_stop_joins_its_threads():
+    """CCY004 fix: stop() used to leave the worker/drain threads running
+    (they poll a 0.1s queue timeout) — a restarted server then raced two
+    drainers into one queue.  stop() must retire every thread it started."""
+    from mmlspark_tpu.serving import PipelineServer
+    from tests.serving_helpers import Doubler
+    srv = PipelineServer(Doubler(), port=0).start()
+    started = list(srv._threads)
+    assert started, "server should have started worker threads"
+    srv.stop()
+    assert srv._threads == []
+    assert not any(t.is_alive() for t in started), \
+        [t.name for t in started if t.is_alive()]
+
+
+def test_streaming_query_stop_joins_loop_and_acceptor():
+    """CCY004 fix: StreamingQuery.stop() set the event and returned —
+    the trigger loop and the source's serve_forever acceptor outlived it."""
+    from mmlspark_tpu.serving.streaming import HTTPStreamSource, StreamingQuery
+    from tests.serving_helpers import Doubler
+    q = StreamingQuery(HTTPStreamSource(), Doubler(), reply_col="reply",
+                       trigger_interval_ms=1).start()
+    loop_t, accept_t = q._thread, q.source._accept_thread
+    assert loop_t.is_alive() and accept_t.is_alive()
+    q.stop()
+    assert not loop_t.is_alive(), "trigger loop survived stop()"
+    assert not accept_t.is_alive(), "HTTP acceptor survived stop()"
+    assert q._thread is None and q.source._accept_thread is None
+
+
+def test_powerbi_stream_stop_joins_the_pusher():
+    """CCY004 fix: stream() returned the bare stop_evt.set — callers
+    raced the final push into teardown.  The handle must join."""
+    from mmlspark_tpu.io import powerbi
+    before = set(threading.enumerate())
+    stop = powerbi.stream(lambda: None, "http://127.0.0.1:9/never",
+                          interval_s=0.01)
+    spawned = [t for t in threading.enumerate() if t not in before]
+    assert len(spawned) == 1
+    stop()
+    assert not spawned[0].is_alive(), "pusher thread survived stop()"
+
+
+def test_membership_watcher_poll_once_compare_and_update_is_atomic(
+        monkeypatch):
+    """CCY002 fix: poll_once's view diff ran unlocked, so two concurrent
+    polls observing the same shrink could BOTH book it (double preemption).
+    A barrier parks both threads after the fetch, then releases them into
+    the compare-and-update together: exactly one may win."""
+    from mmlspark_tpu.serving import distributed as dist
+    w = dist.MembershipWatcher("http://driver", on_shrink=lambda info: None)
+    views = {
+        1: {"epoch": 1, "instance": "i1",
+            "workers": {"a": {"generation": 0}, "b": {"generation": 0}}},
+        2: {"epoch": 2, "instance": "i1",
+            "workers": {"a": {"generation": 0}}},
+    }
+    monkeypatch.setattr(dist, "_http_json",
+                        lambda url, **kw: views[1])
+    assert w.poll_once() is None            # baseline view
+    barrier = threading.Barrier(2, timeout=5.0)
+
+    def racing_fetch(url, **kw):
+        barrier.wait()                      # both fetches complete first
+        return views[2]
+
+    monkeypatch.setattr(dist, "_http_json", racing_fetch)
+    results = [None, None]
+
+    def poll(i):
+        results[i] = w.poll_once()
+
+    threads = [threading.Thread(target=poll, args=(i,)) for i in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert w.shrinks == 1, "both pollers booked the same shrink"
+    wins = [r for r in results if r is not None]
+    assert len(wins) == 1 and wins[0]["lost"] == ["b"]
+
+
+# ---------------------------------------------------------------------------
+# chaos drill: the inverted two-lock fixture is caught by BOTH halves
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_inversion_drill_static_and_runtime_agree():
+    """ISSUE 18 acceptance: one deliberately inverted two-lock shape,
+    caught (a) statically as a CCY001 cycle over the fixture and (b) at
+    runtime by a strict registry BEFORE the cross-threaded acquires can
+    deadlock.  The runtime leg recreates the fixture's Booker shape: one
+    thread books (stats -> flush), the other flushes (flush -> stats)."""
+    from mmlspark_tpu.analysis import AnalysisEngine, ConcurrencyChecker
+    fixture = os.path.join(REPO, "tests", "analysis_fixtures",
+                           "concurrency", "ccy_cycle_bad.py")
+    engine = AnalysisEngine([ConcurrencyChecker()],
+                            root=os.path.join(REPO, "tests",
+                                              "analysis_fixtures"))
+    static = engine.run([fixture])
+    assert [f.rule for f in static] == ["CCY001"], "static half must see it"
+
+    reg = LockOrderRegistry(strict=True, book=False)
+    stats = OrderedLock("Booker._stats_lock", reg)
+    flush = OrderedLock("Booker._flush_lock", reg)
+    barrier = threading.Barrier(2, timeout=5.0)
+    tripped = []
+
+    def booker():            # establishes stats -> flush, then parks
+        with stats:
+            with flush:
+                pass
+        barrier.wait()
+
+    def flusher():           # tries flush -> stats AFTER booker's edge
+        barrier.wait()
+        try:
+            with flush:
+                with stats:
+                    pytest.fail("inverted acquire must trip, not succeed")
+        except LockOrderViolation as e:
+            tripped.append(str(e))
+
+    threads = [threading.Thread(target=booker),
+               threading.Thread(target=flusher)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert not any(t.is_alive() for t in threads), \
+        "drill deadlocked — the sanitizer failed to trip before the hang"
+    assert tripped and "deadlock" in tripped[0]

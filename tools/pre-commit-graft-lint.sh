@@ -1,5 +1,5 @@
 #!/bin/sh
-# graft-lint pre-commit gate — lint only what the commit touches.
+# graft-lint pre-commit gate — report only what the commit touches.
 #
 # Install (points git at the tracked hooks directory):
 #
@@ -8,28 +8,22 @@
 # or symlink this script to .git/hooks/pre-commit directly.  See
 # docs/STATIC_ANALYSIS.md ("Pre-commit hook").
 #
-# Two passes, mirroring the tier-1 gate exactly (same CLI, same baseline):
+# Single pass, mirroring the tier-1 gate exactly (same CLI, same
+# baseline): the whole package is parsed — the cross-module rules (STG
+# inheritance, TRC call BFS, the CCY lock-order graph) need every module
+# in view to resolve, so a staged-files-only SCAN would false-positive —
+# but --changed-only scopes the REPORT to files git sees as changed, so
+# a developer only fails on findings their diff can have introduced.
 #
-# 1. file-local rules (TRC/RES/LCK/HOT) over the STAGED .py files only —
-#    fast feedback scoped to the change;
-# 2. the cross-module STG pass over the whole package, but only when a
-#    package file is staged.  STG resolves param inheritance and the
-#    codegen registry across modules, so a staged-files-only scan would
-#    false-positive; the full pass is a single parse sweep (~1 s).
-#
-# Note: this lints the working tree of staged paths.  A partially staged
-# file (git add -p) is checked as it exists on disk.
+# Note: this lints the working tree of changed paths.  A partially
+# staged file (git add -p) is checked as it exists on disk.
 set -e
 
 cd "$(git rev-parse --show-toplevel)"
 
-staged=$(git diff --cached --name-only --diff-filter=ACMR -- '*.py' |
-         grep '^mmlspark_tpu/' || true)
-[ -z "$staged" ] && exit 0
+changed=$(git status --porcelain -uall -- '*.py' |
+          grep ' mmlspark_tpu/' || true)
+[ -z "$changed" ] && exit 0
 
-echo "graft-lint: file-local rules over staged files"
-# shellcheck disable=SC2086 — word splitting over the staged list is wanted
-python -m mmlspark_tpu graft-lint --rules TRC,RES,LCK,HOT $staged
-
-echo "graft-lint: stage-contract (STG) pass over the package"
-python -m mmlspark_tpu graft-lint --rules STG
+echo "graft-lint: full-package scan, findings scoped to changed files"
+python -m mmlspark_tpu graft-lint --changed-only

@@ -16,16 +16,21 @@ def main(out_dir: str = "generated/tests") -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     # fail fast on stale stage contracts: a stage with param-name drift or
     # outside the registry's SUBPACKAGES would generate wrong (or no)
-    # binding tests, so the STG sweep gates generation itself
-    from mmlspark_tpu.analysis import (StageContractChecker, load_baseline,
+    # binding tests, so the STG sweep gates generation itself.  The CCY
+    # sweep rides along: the generated tests drive stages (and their
+    # threaded serving paths) in bulk, and running that on top of a known
+    # lock-order cycle turns a latent deadlock into a hung CI job
+    from mmlspark_tpu.analysis import (ConcurrencyChecker,
+                                       StageContractChecker, load_baseline,
                                        run_analysis, split_findings)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    findings = run_analysis(checkers=[StageContractChecker()])
+    findings = run_analysis(checkers=[StageContractChecker(),
+                                      ConcurrencyChecker()])
     baseline = load_baseline(os.path.join(repo, "analysis-baseline.toml"))
     new, _, _ = split_findings(findings, baseline)
     if new:
-        print("stage-contract (STG) violations — fix or baseline before "
-              "generating binding tests:")
+        print("stage-contract (STG) / concurrency (CCY) violations — fix "
+              "or baseline before generating binding tests:")
         for f in new:
             print(f"  {f.render()}")
         return 1
