@@ -373,6 +373,17 @@ class TilePrefetcher:
             self._tokens.release()
 
     # ----------------------------------------------------------------- stats
+    def snapshot(self) -> Dict[str, object]:
+        """Live, lock-free view for monitors (``/progress``, flight
+        dumps): all fields are GIL-atomic reads, safe while the stream is
+        mid-flight.  ``waiting=True`` with ``tiles_served`` frozen is the
+        signature of a hung tile load."""
+        return {"site": self.site,
+                "tiles_served": int(self.tiles_served),
+                "wait_s": round(self.wait_s, 6),
+                "compute_s": round(self.compute_s, 6),
+                "waiting": bool(self.waiting.is_set())}
+
     def overlap_stats(self) -> Dict[str, float]:
         """Overlap summary: ``overlap_pct`` is the share of stream wall
         time spent computing rather than stalled on transfer — 100 means

@@ -40,7 +40,7 @@ import dataclasses
 import math
 import time
 from functools import partial
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -1457,7 +1457,9 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
           checkpoint_dir: Optional[str] = None,
           checkpoint_every: int = 0,
           checkpoint_keep_last: int = 3,
-          resume: str = "auto") -> TrainResult:
+          resume: str = "auto",
+          monitor_port: Optional[int] = None,
+          monitor_stall_timeout_s: Optional[float] = None) -> TrainResult:
     """Boosting loop.  Host python drives iterations; each tree is one jitted
     XLA program (reference: driver drives ``updateOneIteration`` per iter,
     ``TrainUtils.scala:67``).  ``shard_rows`` puts the binned matrix/gradients
@@ -1493,11 +1495,20 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
     rounding noise is keyed by GLOBAL row id, so the resumed booster is
     bit-identical to an uninterrupted run at either width (tested shrink
     and grow).  The change books ``mmlspark_reshard_total`` and sets
-    ``extras["resharded"]``."""
+    ``extras["resharded"]``.
+
+    Live monitoring (ISSUE 19): ``monitor_port`` (0 = ephemeral) serves
+    ``GET /progress`` / ``/metrics`` / ``/debug/{dump,profile}`` for the
+    duration of the loop, and either monitor arg arms a stall watchdog
+    (no iteration within max(4x EWMA iteration time,
+    ``monitor_stall_timeout_s``) books ``mmlspark_training_stalls_total``
+    and writes a ``trigger="train_stall"`` flight dump); see
+    docs/OBSERVABILITY.md "Training plane"."""
     import jax
     import jax.numpy as jnp
     from ..observability import get_registry
-    from ..observability.tracing import Span, current_span, export_span
+    from ..observability.tracing import (Span, ambient_phase, current_span,
+                                         export_span)
 
     # training-phase telemetry: per-iteration observations into the global
     # registry + ONE lightgbm.train span (child of the ambient fit span)
@@ -1589,9 +1600,11 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
         binned_np = bin_cache["binned"]
     else:
         _t_bin = time.perf_counter()
-        mapper = BinMapper(p.max_bin,
-                           categorical_features=p.categorical_features).fit(X)
-        binned_np = mapper.transform(X)
+        with ambient_phase("lightgbm.binning"):
+            mapper = BinMapper(
+                p.max_bin,
+                categorical_features=p.categorical_features).fit(X)
+            binned_np = mapper.transform(X)
         _observe_phase("binning", time.perf_counter() - _t_bin)
         if bin_cache is not None:
             bin_cache.clear()
@@ -2062,9 +2075,42 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
         _mgr.save(done, _booster_ckpt_arrays(trees, tree_weights, bag_mask),
                   meta, block=block)
 
+    # ---- live training monitor (ISSUE 19): opt-in heartbeat + stall
+    # watchdog + HTTP sidecar; ticks ride the callbacks seam the loop
+    # already invokes, so monitoring adds no new iteration hook
+    _watch = _wsrv = None
+    if monitor_port is not None or monitor_stall_timeout_s is not None:
+        from ..observability.trainwatch import start_training_monitor
+        _watch, _wsrv = start_training_monitor(
+            "lightgbm.train", total_steps=p.num_iterations,
+            rows_per_step=n, monitor_port=monitor_port,
+            stall_timeout_s=monitor_stall_timeout_s,
+            driver="lightgbm.train")
+        _watch.set_phase("boosting")
+
+        def _watch_cb(i, ev, _w=_watch):
+            # the eval entry (when present) carries {metric_name: value,
+            # "iteration": it} — feed the metric value to the loss tail
+            val = None
+            if isinstance(ev, dict):
+                for k, v in ev.items():
+                    if k != "iteration" and isinstance(v, (int, float)):
+                        val = float(v)
+                        break
+            _w.tick(step=i + 1, loss=val)
+
+        callbacks = list(callbacks or []) + [_watch_cb]
+
     _scope = preemption_scope() if _mgr is not None \
         else contextlib.nullcontext(PreemptionToken())
-    with _scope as _token:
+    with contextlib.ExitStack() as _stack:
+      if _wsrv is not None:
+          _stack.callback(_wsrv.stop)
+      if _watch is not None:
+          _stack.callback(_watch.close)
+      _token = _stack.enter_context(_scope)
+      if _watch is not None:
+          _watch.set_preemption_token(_token)
       while it < end_iter:
         if _token.requested:
             # preempted: final checkpoint at this iteration boundary, then
@@ -2080,8 +2126,10 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
             keys = jnp.stack([jrandom.PRNGKey(p.seed * 1000003 + it + j)
                               for j in range(CH)])
             _t_grow = time.perf_counter()
-            scores, stacked = multi_iter(scores, jnp.float32(len(tree_weights)),
-                                         keys)
+            with ambient_phase("lightgbm.histogram"):
+                scores, stacked = multi_iter(scores,
+                                             jnp.float32(len(tree_weights)),
+                                             keys)
             # CH fused iterations per dispatch: book the per-iteration share
             # CH times so histogram counts stay 1:1 with boosting iterations
             _observe_phase("histogram_split_update",
@@ -2093,11 +2141,13 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
                     tree_weights.append(1.0)
             if has_valid:
                 _t_eval = time.perf_counter()
-                scores_v = valid_chunk_update(scores_v, binned_v, stacked[2],
-                                              stacked[4], stacked[8],
-                                              stacked[0], stacked[1])
-                raw_v = np.asarray(scores_v, np.float64)
-                m = metric_fn(yv, raw_v)
+                with ambient_phase("lightgbm.eval"):
+                    scores_v = valid_chunk_update(scores_v, binned_v,
+                                                  stacked[2], stacked[4],
+                                                  stacked[8], stacked[0],
+                                                  stacked[1])
+                    raw_v = np.asarray(scores_v, np.float64)
+                    m = metric_fn(yv, raw_v)
                 _observe_phase("eval", time.perf_counter() - _t_eval)
                 evals.append({metric_name: m, "iteration": it + CH - 1})
                 improved = m > best_metric if larger_better else m < best_metric
@@ -2169,14 +2219,15 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
         _t_grow = time.perf_counter()
         if not shard_rows:
             use_pre = g_pre is not None
-            if use_pre:
-                scores, tree_out = _iter_jit[True](
-                    scores, y_dev, w_dev, binned, base_mask, feat_mask,
-                    edges, grad_scale, new_w, key, g_pre, h_pre)
-            else:
-                scores, tree_out = _iter_jit[False](
-                    scores, y_dev, w_dev, binned, base_mask, feat_mask,
-                    edges, grad_scale, new_w, key)
+            with ambient_phase("lightgbm.histogram"):
+                if use_pre:
+                    scores, tree_out = _iter_jit[True](
+                        scores, y_dev, w_dev, binned, base_mask, feat_mask,
+                        edges, grad_scale, new_w, key, g_pre, h_pre)
+                else:
+                    scores, tree_out = _iter_jit[False](
+                        scores, y_dev, w_dev, binned, base_mask, feat_mask,
+                        edges, grad_scale, new_w, key)
             # one fused program: histogram build + split find + score update
             _observe_phase("histogram_split_update",
                            time.perf_counter() - _t_grow)
@@ -2192,9 +2243,11 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
             tree_out = []
             for c in range(K):
                 _t_c = time.perf_counter()
-                (lch, rch, sf, th, tb, sg, iv, ic, lv, lc, cbs,
-                 leaf_of_row) = grower(
-                    binned, g_eff[:, c], h_eff[:, c], base_mask, feat_mask, edges)
+                with ambient_phase("lightgbm.histogram"):
+                    (lch, rch, sf, th, tb, sg, iv, ic, lv, lc, cbs,
+                     leaf_of_row) = grower(
+                        binned, g_eff[:, c], h_eff[:, c], base_mask,
+                        feat_mask, edges)
                 _observe_phase("histogram_split", time.perf_counter() - _t_c)
                 _t_u = time.perf_counter()
                 lv_s = lv * shrink
@@ -2241,8 +2294,9 @@ def train(X: np.ndarray, y: np.ndarray, params: GBDTParams,
         # ---- eval / early stopping
         if has_valid:
             _t_eval = time.perf_counter()
-            raw_v = np.asarray(scores_v, np.float64)
-            m = metric_fn(yv, raw_v)
+            with ambient_phase("lightgbm.eval"):
+                raw_v = np.asarray(scores_v, np.float64)
+                m = metric_fn(yv, raw_v)
             _observe_phase("eval", time.perf_counter() - _t_eval)
             evals.append({metric_name: m, "iteration": it})
             improved = m > best_metric if larger_better else m < best_metric
@@ -2464,7 +2518,10 @@ def train_streamed(X, y: Optional[np.ndarray] = None, params: GBDTParams = None,
                    checkpoint_dir: Optional[str] = None,
                    checkpoint_every: int = 0,
                    checkpoint_keep_last: int = 3,
-                   resume: str = "auto") -> TrainResult:
+                   resume: str = "auto",
+                   monitor_port: Optional[int] = None,
+                   monitor_stall_timeout_s: Optional[float] = None
+                   ) -> TrainResult:
     """Out-of-core boosting: the dataset lives in host RAM and streams
     through the device in fixed-shape tiles with double-buffered prefetch
     (Snap ML's host->HBM hierarchy, ``io.chunked``).  Nothing row-sized is
@@ -2525,6 +2582,14 @@ def train_streamed(X, y: Optional[np.ndarray] = None, params: GBDTParams = None,
     tiling and the resumed booster stays bit-identical to an
     uninterrupted run at either width (tested shrink and grow).
 
+    Live monitoring (ISSUE 19): ``monitor_port`` (0 = ephemeral) serves
+    ``GET /progress`` — step/ETA, rows/sec EWMA, loss tail, live tile
+    overlap %, checkpoint age — plus ``/metrics`` and
+    ``/debug/{dump,profile}`` for the duration of the loop; either monitor
+    arg arms a stall watchdog whose ``train_stall`` flight dump captures
+    the prefetch state a hung tile load leaves behind (see
+    docs/OBSERVABILITY.md "Training plane").
+
     Not (yet) streamed: multiclass, lambdarank, dart/goss/rf, categorical
     features, and ``shard_rows`` (the multi-host composition — per-tile
     accumulation under ``collectives.histogram_psum(num_tiles=)`` — is
@@ -2534,7 +2599,8 @@ def train_streamed(X, y: Optional[np.ndarray] = None, params: GBDTParams = None,
     import jax.numpy as jnp
     from ..io.chunked import ChunkedDataset, TilePrefetcher, pad_tile
     from ..observability.compute import device_put as _obs_device_put
-    from ..observability.tracing import Span, current_span, export_span
+    from ..observability.tracing import (Span, ambient_phase, current_span,
+                                         export_span)
     from ..ops import histogram as hist_ops
 
     if params is None:
@@ -2632,12 +2698,13 @@ def train_streamed(X, y: Optional[np.ndarray] = None, params: GBDTParams = None,
             lo, hi = cd.tile_slice(i)
             yield cd.X[lo:hi]
 
-    mapper = BinMapper(p.max_bin).fit_streaming(_tile_chunks())
-    B = mapper.num_bins
-    binned_h = np.empty((n, F), np.uint8)
-    for i in range(cd.num_tiles):
-        lo, hi = cd.tile_slice(i)
-        binned_h[lo:hi] = mapper.transform(cd.X[lo:hi])
+    with ambient_phase("ooc.binning"):
+        mapper = BinMapper(p.max_bin).fit_streaming(_tile_chunks())
+        B = mapper.num_bins
+        binned_h = np.empty((n, F), np.uint8)
+        for i in range(cd.num_tiles):
+            lo, hi = cd.tile_slice(i)
+            binned_h[lo:hi] = mapper.transform(cd.X[lo:hi])
     edges_np = mapper.edges
     edge_ok = np.concatenate(
         [np.isfinite(edges_np), np.zeros((F, 1), bool)], axis=1)
@@ -2765,19 +2832,44 @@ def train_streamed(X, y: Optional[np.ndarray] = None, params: GBDTParams = None,
     # the consumer's histogram dispatch on the current tile)
     OOC_SITE = "lightgbm.ooc_tile"
     stream_totals = {"wait_s": 0.0, "compute_s": 0.0, "tiles": 0.0}
+    # the live prefetcher (one active pass at a time): /progress and the
+    # train_stall flight dump read its snapshot() — a hung tile load shows
+    # up as waiting=True with tiles_served frozen
+    _live_pf: Dict[str, Optional[TilePrefetcher]] = {"pf": None}
 
     def _stream(make_tile):
         def load(i):
-            lo, hi = cd.tile_slice(i)
-            host = make_tile(i, lo, hi)
-            return (i, lo, hi, _obs_device_put(host, site=OOC_SITE))
-        return TilePrefetcher(range(cd.num_tiles), load, site=OOC_SITE)
+            # prefetch worker thread: attribute its samples to tile load,
+            # distinct from the consumer's accumulate dispatch
+            with ambient_phase("ooc.tile_load"):
+                lo, hi = cd.tile_slice(i)
+                host = make_tile(i, lo, hi)
+                return (i, lo, hi, _obs_device_put(host, site=OOC_SITE))
+        pf = TilePrefetcher(range(cd.num_tiles), load, site=OOC_SITE)
+        _live_pf["pf"] = pf
+        return pf
 
     def _finish_stream(pf):
         st = pf.overlap_stats()
         stream_totals["wait_s"] += st["wait_s"]
         stream_totals["compute_s"] += st["compute_s"]
         stream_totals["tiles"] += st["tiles"]
+
+    def _prefetch_state() -> Dict[str, Any]:
+        """Monitor-side view: cumulative overlap totals + the live pass."""
+        busy = stream_totals["wait_s"] + stream_totals["compute_s"]
+        d: Dict[str, Any] = {
+            "wait_s": round(stream_totals["wait_s"], 6),
+            "compute_s": round(stream_totals["compute_s"], 6),
+            "tiles": stream_totals["tiles"],
+            "overlap_pct": round(
+                100.0 * stream_totals["compute_s"] / busy, 2)
+            if busy > 0 else 100.0,
+        }
+        pf = _live_pf["pf"]
+        if pf is not None:
+            d["live"] = pf.snapshot()
+        return d
 
     # ---- init score (same as train())
     init_score = 0.0
@@ -2971,12 +3063,13 @@ def train_streamed(X, y: Optional[np.ndarray] = None, params: GBDTParams = None,
                                         pad_tile(y, lo, hi, T),
                                         pad_tile(w, lo, hi, T)))
         gmax = hmax = 0.0
-        for i, lo, hi, (sc_t, y_t, w_t) in pf:
-            g_t, h_t, gm, hm = grad_fn(sc_t, y_t, w_t)
-            g_host[lo:hi] = np.asarray(g_t)[: hi - lo]
-            h_host[lo:hi] = np.asarray(h_t)[: hi - lo]
-            gmax = max(gmax, float(gm))
-            hmax = max(hmax, float(hm))
+        with ambient_phase("ooc.gradients"):
+            for i, lo, hi, (sc_t, y_t, w_t) in pf:
+                g_t, h_t, gm, hm = grad_fn(sc_t, y_t, w_t)
+                g_host[lo:hi] = np.asarray(g_t)[: hi - lo]
+                h_host[lo:hi] = np.asarray(h_t)[: hi - lo]
+                gmax = max(gmax, float(gm))
+                hmax = max(hmax, float(hm))
         _finish_stream(pf)
         g_scale = max(gmax, 1e-12) / qg_cap
         h_scale = max(hmax, 1e-12) / qh_cap
@@ -3019,10 +3112,37 @@ def train_streamed(X, y: Optional[np.ndarray] = None, params: GBDTParams = None,
         acc = jnp.zeros((nodes_d, F, B, 3),
                         jnp.int32 if use_quant else jnp.float32)
         pf = _stream(make_tile)
-        for i, lo, hi, (b_t, g_t, h_t, n_t, i_t) in pf:
-            acc = accum_fn(acc, b_t, g_t, h_t, n_t, i_t, mixv, gsc, hsc)
+        with ambient_phase("ooc.histogram"):
+            for i, lo, hi, (b_t, g_t, h_t, n_t, i_t) in pf:
+                acc = accum_fn(acc, b_t, g_t, h_t, n_t, i_t, mixv, gsc,
+                               hsc)
         _finish_stream(pf)
         return acc
+
+    # live monitor (ISSUE 19): one tick per boosting iteration.  The stall
+    # watchdog covers the streamed passes too — a hung tile load freezes
+    # the tick stream and trips as ``train_stall`` with the live
+    # prefetcher snapshot showing ``waiting=True``.
+    _watch = _wsrv = None
+    if monitor_port is not None or monitor_stall_timeout_s is not None:
+        from ..observability.trainwatch import start_training_monitor
+        _watch, _wsrv = start_training_monitor(
+            "lightgbm.train_streamed", total_steps=p.num_iterations,
+            rows_per_step=n, monitor_port=monitor_port,
+            stall_timeout_s=monitor_stall_timeout_s,
+            driver="lightgbm.train_streamed")
+        _watch.set_phase("boosting")
+        _watch.set_prefetch_fn(_prefetch_state)
+
+        def _watch_cb(i, ev, _w=_watch):
+            val = None
+            if ev:
+                for k, v in ev.items():
+                    if k != "iteration" and isinstance(v, (int, float)):
+                        val = float(v)
+                        break
+            _w.tick(step=i + 1, loss=val)
+        callbacks = list(callbacks or []) + [_watch_cb]
 
     # preemption scope only when checkpointing is on: without a durable
     # snapshot to write, a SIGTERM should keep its default behaviour
@@ -3030,7 +3150,14 @@ def train_streamed(X, y: Optional[np.ndarray] = None, params: GBDTParams = None,
         else contextlib.nullcontext(PreemptionToken())
     _last_ckpt_iter = start_iter
     _trees_at_loop_start = len(tree_weights)
-    with _scope as _token:
+    with contextlib.ExitStack() as _stack:
+      if _wsrv is not None:
+          _stack.callback(_wsrv.stop)
+      if _watch is not None:
+          _stack.callback(_watch.close)
+      _token = _stack.enter_context(_scope)
+      if _watch is not None:
+          _watch.set_preemption_token(_token)
       for it in range(start_iter, p.num_iterations):
         if _token.requested:
             # preempted: one final checkpoint at this iteration boundary,
@@ -3108,12 +3235,13 @@ def train_streamed(X, y: Optional[np.ndarray] = None, params: GBDTParams = None,
         tree_weights.append(1.0)
 
         if has_valid:
-            leaf_v = np.asarray(walker(
-                binned_v, jnp.asarray(sf), jnp.asarray(tb),
-                jnp.asarray(np.asarray(lch, np.int32)),
-                jnp.asarray(np.asarray(rch, np.int32))))
-            scores_v[:, 0] += lv_s[leaf_v]
-            m = metric_fn(yv, scores_v.astype(np.float64))
+            with ambient_phase("ooc.eval"):
+                leaf_v = np.asarray(walker(
+                    binned_v, jnp.asarray(sf), jnp.asarray(tb),
+                    jnp.asarray(np.asarray(lch, np.int32)),
+                    jnp.asarray(np.asarray(rch, np.int32))))
+                scores_v[:, 0] += lv_s[leaf_v]
+                m = metric_fn(yv, scores_v.astype(np.float64))
             evals.append({metric_name: m, "iteration": it})
             improved = m > best_metric if larger_better else m < best_metric
             if improved:

@@ -95,6 +95,16 @@ def _shared_params(cls):
          "tolerance)", "string", None),
         ("checkpoint_every", "checkpoint cadence in boosting iterations "
          "(0 = off; requires checkpoint_dir)", "int", 0),
+        ("monitor_port", "serve live training telemetry over HTTP while "
+         "fit() runs: GET /progress (step, rows/sec, ETA, loss tail), "
+         "/metrics, /debug/dump, /debug/profile (0 = ephemeral port; "
+         "unset = no server; docs/OBSERVABILITY.md: training plane)",
+         "int", None),
+        ("monitor_stall_timeout_s", "arm the training stall watchdog with "
+         "a FIXED timeout in seconds instead of the EWMA-scaled default "
+         "(a trip books mmlspark_training_stalls_total and writes a "
+         "train_stall flight dump); setting this alone enables the "
+         "watchdog without the HTTP server", "double", None),
     ]
     for name, doc, dtype, default in specs:
         setattr(cls, name, Param(name, doc, dtype, default))
@@ -177,7 +187,10 @@ class _LightGBMBase(Estimator, HasFeaturesCol, HasLabelCol, HasWeightCol):
             init_booster = GBDTBooster.from_string(ms)
         num_batches = self.get("num_batches") or 0
         ckpt_kw = dict(checkpoint_dir=self.get("checkpoint_dir"),
-                       checkpoint_every=self.get("checkpoint_every"))
+                       checkpoint_every=self.get("checkpoint_every"),
+                       monitor_port=self.get("monitor_port"),
+                       monitor_stall_timeout_s=self.get(
+                           "monitor_stall_timeout_s"))
         if num_batches > 1:
             # sequential batch training with warm start between batches
             # (reference LightGBMBase.scala:46-61).  Checkpoints would
@@ -354,7 +367,10 @@ class LightGBMRegressor(_LightGBMBase, HasPredictionCol):
                                  init_booster=init_booster,
                                  shard_rows=self.get("shard_rows"),
                                  checkpoint_dir=self.get("checkpoint_dir"),
-                                 checkpoint_every=self.get("checkpoint_every"))
+                                 checkpoint_every=self.get("checkpoint_every"),
+                                 monitor_port=self.get("monitor_port"),
+                                 monitor_stall_timeout_s=self.get(
+                                     "monitor_stall_timeout_s"))
         model = LightGBMRegressionModel()
         model.set("booster", result.booster)
         model.set("features_col", self.get("features_col"))

@@ -50,6 +50,9 @@ from .profiling import (SamplingProfiler, ProfilerBusy, profile_window,
                         profiler_instruments)
 from .flightrecorder import (FlightRecorder, flightrecorder_instruments,
                              get_flight_recorder)
+from .trainwatch import (MonitorServer, TrainingRun, active_monitors,
+                         active_runs, start_training_monitor,
+                         training_instruments)
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "DEFAULT_LATENCY_BUCKETS", "get_registry", "set_registry",
@@ -66,4 +69,6 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "SLO", "SLOEngine", "parse_slo", "AutoscaleAdvisor",
            "SamplingProfiler", "ProfilerBusy", "profile_window",
            "profiler_instruments", "FlightRecorder",
-           "flightrecorder_instruments", "get_flight_recorder"]
+           "flightrecorder_instruments", "get_flight_recorder",
+           "TrainingRun", "MonitorServer", "start_training_monitor",
+           "training_instruments", "active_runs", "active_monitors"]

@@ -222,6 +222,7 @@ class FlightRecorder:
         from ..core.logging import recent_events
         from .collector import get_collector
         from .compute import compile_report
+        from .tracing import thread_phases
 
         snap: Dict[str, Any] = {
             "trigger": trigger,
@@ -235,6 +236,12 @@ class FlightRecorder:
                 k=self.slow_k)),
             ("compile", lambda: compile_report(self.registry)),
             ("metrics", self._metric_section),
+            # thread ident -> innermost ambient phase at dump time: a
+            # train_stall dump names WHICH phase every worker was stuck in
+            # (tile_load vs histogram vs train_step), not just that the
+            # loop went quiet (ISSUE 19)
+            ("phases", lambda: {str(tid): name
+                                for tid, name in thread_phases().items()}),
             ("decode_streams", self._decode_section),
             ("runners", self._runner_section),
             ("membership", self._membership_section),

@@ -232,3 +232,49 @@ def uninstrument_breaker(breaker_or_name,
         fam = reg.family(fam_name)  # never CREATE an empty family here
         if fam is not None:
             fam.remove(breaker=name)
+
+
+def training_instruments(registry: Optional[MetricsRegistry] = None) -> dict:
+    """Register (once per registry) the training-plane families that
+    :class:`~mmlspark_tpu.observability.trainwatch.TrainingRun` books —
+    the ISSUE 19 twin of ``flightrecorder_instruments``.  Counters and the
+    step-time histogram are bound per ``job`` by each run; the
+    progress/ETA/throughput gauges are callback series the run installs at
+    construction and removes at close (the eviction hygiene the breaker
+    gauges established)."""
+    reg = registry or get_registry()
+    got = getattr(reg, "_training_families", None)
+    if got is not None:
+        return got
+    fams = {
+        "steps": reg.counter(
+            "mmlspark_training_steps_total",
+            "training steps/iterations completed per job", labels=("job",)),
+        "rows": reg.counter(
+            "mmlspark_training_rows_total",
+            "training rows processed per job (steps x dataset rows for the "
+            "gbdt drivers, batch rows for the parallel trainer)",
+            labels=("job",)),
+        "stalls": reg.counter(
+            "mmlspark_training_stalls_total",
+            "training stall-watchdog trips (no tick within "
+            "max(k x EWMA step time, floor)); each trip also writes a "
+            "trigger=train_stall flight dump", labels=("job",)),
+        "step_seconds": reg.histogram(
+            "mmlspark_training_step_seconds",
+            "tick-to-tick training step time (host wall clock)",
+            labels=("job",)),
+        "progress": reg.gauge(
+            "mmlspark_training_progress_ratio",
+            "completed fraction of the declared total steps (NaN when the "
+            "driver declared no total)", labels=("job",)),
+        "eta": reg.gauge(
+            "mmlspark_training_eta_seconds",
+            "EWMA-projected seconds to completion (+Inf until the EWMA "
+            "and a total are known)", labels=("job",)),
+        "rate": reg.gauge(
+            "mmlspark_training_rows_per_second",
+            "EWMA training throughput in rows/second", labels=("job",)),
+    }
+    reg._training_families = fams
+    return fams

@@ -261,7 +261,11 @@ class Trainer:
                      checkpoint_dir: Optional[str] = None,
                      checkpoint_every: int = 0,
                      checkpoint_keep_last: int = 3,
-                     resume: str = "auto"):
+                     resume: str = "auto",
+                     callbacks: Optional[list] = None,
+                     total_steps: Optional[int] = None,
+                     monitor_port: Optional[int] = None,
+                     monitor_stall_timeout_s: Optional[float] = None):
         """Out-of-core training loop: iterate host batches through a
         double-buffered prefetcher — batch ``k+1`` is ``device_put`` (row
         sharded over the mesh's data axis, through the instrumented
@@ -289,6 +293,18 @@ class Trainer:
         requests one final checkpoint at the next step boundary and
         returns cleanly with ``stats["preempted"]`` set — a preempted
         worker resumes instead of restarting.
+
+        Live monitoring (ISSUE 19): ``callbacks`` are invoked after every
+        step as ``cb(step_index, None)`` — the evals slot is always
+        ``None`` here because fetching a per-step loss would force the
+        float() sync this loop exists to avoid.  ``monitor_port`` (0 =
+        ephemeral) starts a :class:`~mmlspark_tpu.observability.trainwatch.
+        MonitorServer` named after ``site`` serving ``/progress`` +
+        ``/metrics``; the stall watchdog heartbeats per step, with rows
+        inferred from the batch's leading leaf.  ``total_steps`` (the
+        batch count, when the caller knows it) enables the progress ratio
+        and ETA; ``monitor_stall_timeout_s`` pins the stall timeout
+        instead of the EWMA-scaled default.
 
         Returns ``(state, losses, stats)`` — ``stats`` is the prefetcher's
         overlap summary plus ``steps`` / ``resumed_from_step`` /
@@ -346,13 +362,43 @@ class Trainer:
         losses = []
         steps_done = skip
         preempted = False
+        # live monitor (ISSUE 19): heartbeat per train step — a wedged
+        # device program or a hung batch source stops the ticks and trips
+        # the stall watchdog into a train_stall flight dump
+        from ..observability.tracing import ambient_phase
+        watch = wsrv = None
+        if monitor_port is not None or monitor_stall_timeout_s is not None:
+            from ..observability.trainwatch import start_training_monitor
+            watch, wsrv = start_training_monitor(
+                site, total_steps=total_steps, monitor_port=monitor_port,
+                stall_timeout_s=monitor_stall_timeout_s,
+                driver="parallel.trainer")
+            watch.set_phase("parallel.train_step")
+            watch.set_prefetch_fn(prefetcher.snapshot)
         scope = preemption_scope() if ckpt is not None \
             else contextlib.nullcontext(PreemptionToken())
-        with scope as token:
+        with contextlib.ExitStack() as stack:
+            if wsrv is not None:
+                stack.callback(wsrv.stop)
+            if watch is not None:
+                stack.callback(watch.close)
+            token = stack.enter_context(scope)
+            if watch is not None:
+                watch.set_preemption_token(token)
             for batch in prefetcher:
-                state, loss = self.train_step(state, batch)
+                with ambient_phase("parallel.train_step"):
+                    state, loss = self.train_step(state, batch)
                 losses.append(loss)
                 steps_done += 1
+                if callbacks:
+                    for cb in callbacks:
+                        cb(steps_done - 1, None)
+                if watch is not None:
+                    try:
+                        rows = int(jax.tree.leaves(batch)[0].shape[0])
+                    except Exception:  # noqa: BLE001 — shapeless pytree
+                        rows = 0
+                    watch.tick(step=steps_done, rows=rows)
                 if ckpt is not None and token.requested:
                     # preemption: final snapshot at this step boundary,
                     # then a clean return the caller can resume from

@@ -338,8 +338,13 @@ class TopologyService:
             def do_GET(self):
                 path, _, query = self.path.partition("?")
                 if path == "/routing":
+                    # trainers (ISSUE 19) register so /fleet/metrics
+                    # federates their telemetry, but they serve /progress,
+                    # not scores — keep them out of the routing table so
+                    # RoutingClient never hashes score traffic onto one
                     with svc._lock:
-                        table = dict(svc._workers)
+                        table = {sid: w for sid, w in svc._workers.items()
+                                 if w.get("role") != "trainer"}
                     self._json(200, table)
                 elif path.startswith("/flag/"):
                     with svc._lock:

@@ -29,7 +29,7 @@ from ..utils.resilience import FakeClock  # re-export for chaos suites
 
 __all__ = ["ChaosInjector", "LatencyInjector", "ConnectionErrorInjector",
            "StatusStormInjector", "WorkerKiller", "FakeClock",
-           "FlakyLoadInjector", "PreemptionSimulator",
+           "FlakyLoadInjector", "HungLoadInjector", "PreemptionSimulator",
            "ElasticTopologyDrill", "HungWorkerInjector"]
 
 Transport = Callable[[HTTPRequestData, float], HTTPResponseData]
@@ -145,6 +145,42 @@ class FlakyLoadInjector(ChaosInjector):
                 raise self.exc_factory(self.injected)
             return load_fn(item)
         return flaky
+
+
+class HungLoadInjector:
+    """The failure the retry CANNOT see: a tile load that never returns
+    (NFS server gone away mid-read, wedged device relay holding the
+    transfer lock).  No exception is raised, so ``FlakyLoadInjector``'s
+    retry path never engages — the prefetch worker just blocks, the
+    consumer's tick stream freezes, and only the ISSUE 19 stall watchdog
+    notices.  Deterministic by construction: hangs at the ``hang_at``-th
+    load call (0-based), not on a coin.
+
+    ``hanging`` is set when the worker is actually blocked (tests wait on
+    it instead of sleeping); ``release()`` unblocks the load so the
+    stream — and the test — can finish cleanly."""
+
+    def __init__(self, hang_at: int = 0):
+        self.hang_at = int(hang_at)
+        self.calls = 0
+        self.hanging = threading.Event()   # worker is blocked NOW
+        self._gate = threading.Event()     # release() opens it
+        self._lock = threading.Lock()
+
+    def release(self) -> None:
+        self._gate.set()
+
+    def wrap(self, load_fn: Callable) -> Callable:
+        def hung(item):
+            with self._lock:
+                k = self.calls
+                self.calls += 1
+            if k == self.hang_at and not self._gate.is_set():
+                self.hanging.set()
+                self._gate.wait()
+                self.hanging.clear()
+            return load_fn(item)
+        return hung
 
 
 class PreemptionSimulator:

@@ -847,3 +847,63 @@ def test_every_stage_routes_verbs_through_log_verb():
         "stages overriding the instrumented public verb (implement _fit/"
         f"_transform instead, or add to LOG_VERB_EXEMPT with a reason): "
         f"{offenders}")
+
+
+def test_trainwatch_surface_books_metrics():
+    """ISSUE 19 coverage: the training plane is the only live view into a
+    multi-hour job, so its accounting must be un-droppable.  Source-level:
+    all three drivers expose ``monitor_port`` and route through
+    ``start_training_monitor``; the tick path books steps/rows/step-time;
+    the stall path books the stalls counter and dumps with
+    ``trigger="train_stall"``; the monitor serves the four read endpoints.
+    Live: constructing a run on a fresh registry registers every
+    training-plane family."""
+    from mmlspark_tpu.lightgbm import core as gbdt_core
+    from mmlspark_tpu.observability import trainwatch
+    from mmlspark_tpu.observability.metrics import MetricsRegistry
+    from mmlspark_tpu.parallel import trainer as trainer_mod
+    from mmlspark_tpu.utils.resilience import FakeClock
+
+    # all three drivers carry the seam and wire it through one helper
+    for fn in (gbdt_core.train, gbdt_core.train_streamed,
+               trainer_mod.Trainer.train_stream):
+        src = inspect.getsource(fn)
+        assert "monitor_port" in src, f"{fn.__qualname__} lost monitor_port"
+        assert "start_training_monitor" in src, \
+            f"{fn.__qualname__} no longer wires the training monitor"
+        assert "callbacks" in src, f"{fn.__qualname__} lost the callbacks seam"
+
+    tick_src = inspect.getsource(trainwatch.TrainingRun.tick)
+    for needle in ("_c_steps", "_c_rows", "_h_step", "arm("):
+        assert needle in tick_src, f"TrainingRun.tick() lost {needle}"
+    stall_src = inspect.getsource(trainwatch.TrainingRun._on_stall)
+    assert "_c_stalls" in stall_src and 'trigger="train_stall"' in stall_src
+    handler_src = inspect.getsource(trainwatch.MonitorServer._make_handler)
+    for endpoint in ("/progress", "/metrics", "/debug/dump",
+                     "/debug/profile", "/stats", "/health"):
+        assert endpoint in handler_src, f"MonitorServer lost {endpoint}"
+    # trainers federate but never take score traffic: the /routing handler
+    # filters the role the monitor registers under
+    from mmlspark_tpu.serving import distributed as dist_mod
+    svc_src = inspect.getsource(dist_mod.TopologyService._make_handler)
+    assert '"trainer"' in svc_src, \
+        "GET /routing no longer filters trainer rows"
+    assert '"role": "trainer"' in inspect.getsource(
+        trainwatch.MonitorServer._registration)
+
+    # live: one run registers the full family set
+    reg = MetricsRegistry()
+    run = trainwatch.TrainingRun("cov", total_steps=2, registry=reg,
+                                 clock=FakeClock(), flight_dump=False)
+    try:
+        for family in ("mmlspark_training_steps_total",
+                       "mmlspark_training_rows_total",
+                       "mmlspark_training_stalls_total",
+                       "mmlspark_training_step_seconds",
+                       "mmlspark_training_progress_ratio",
+                       "mmlspark_training_eta_seconds",
+                       "mmlspark_training_rows_per_second"):
+            assert reg.family(family) is not None, \
+                f"TrainingRun no longer registers {family}"
+    finally:
+        run.close()

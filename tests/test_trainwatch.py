@@ -1,0 +1,446 @@
+"""Training observability plane (ISSUE 19).
+
+Three layers, matching the module's three pieces:
+
+- :class:`TrainingRun` math on a FakeClock — EWMA step time, rows/sec,
+  ETA, the loss tail bound, and the chunked-driver step-delta accounting;
+- the stall watchdog drill — a deterministically HUNG tile load
+  (``HungLoadInjector``, the failure the prefetch retry cannot see) trips
+  the watchdog exactly once per stall, books
+  ``mmlspark_training_stalls_total`` and leaves a ``train_stall`` flight
+  dump whose ``source.training.<job>`` section names the stuck prefetcher;
+- end-to-end — a real ``train_streamed`` run serving ``/progress`` and
+  ``/metrics`` over a real socket mid-flight, and trainer federation
+  through ``TopologyService`` (in ``/fleet/metrics``, out of
+  ``GET /routing``).
+"""
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.observability.metrics import MetricsRegistry
+from mmlspark_tpu.observability.trainwatch import (
+    MonitorServer, TrainingRun, active_monitors, active_runs,
+    start_training_monitor)
+from mmlspark_tpu.utils.resilience import FakeClock
+
+
+def _get_json(url, timeout=5):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get_text(url, timeout=5, accept=None):
+    req = urllib.request.Request(url)
+    if accept:
+        req.add_header("Accept", accept)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.read().decode(), r.headers.get("Content-Type", "")
+
+
+# ---------------------------------------------------------------------------
+# TrainingRun math (FakeClock)
+# ---------------------------------------------------------------------------
+
+def test_ewma_step_time_rate_and_eta():
+    clk = FakeClock()
+    reg = MetricsRegistry()
+    run = TrainingRun("j", total_steps=10, rows_per_step=100, registry=reg,
+                      clock=clk, flight_dump=False)
+    run.tick(step=1)
+    clk.advance(1.0)
+    run.tick(step=2, loss=0.5)
+    clk.advance(1.0)
+    run.tick(step=3, loss=0.4)
+    p = run.progress()
+    # two 1.0s intervals: EWMA is exactly 1.0 whatever the alpha
+    assert p["ewma_step_seconds"] == pytest.approx(1.0)
+    assert p["rows_per_second"] == pytest.approx(100.0)
+    assert p["eta_seconds"] == pytest.approx(7.0)   # (10 - 3) x 1.0
+    assert p["loss_tail"] == [0.5, 0.4]
+    assert p["step"] == 3 and p["rows"] == 300
+    # the callback gauges sample the same numbers at scrape time
+    fams = reg._training_families
+    assert fams["progress"].labels(job="j").value == pytest.approx(0.3)
+    assert fams["eta"].labels(job="j").value == pytest.approx(7.0)
+    assert fams["rate"].labels(job="j").value == pytest.approx(100.0)
+    run.close()
+
+
+def test_unknowns_before_ticks_and_without_total():
+    clk = FakeClock()
+    reg = MetricsRegistry()
+    run = TrainingRun("j", registry=reg, clock=clk, flight_dump=False)
+    p = run.progress()
+    # one-tick-old run: no EWMA, no ETA, no rate — nulls on /progress
+    assert p["ewma_step_seconds"] is None
+    assert p["eta_seconds"] is None
+    assert p["rows_per_second"] is None
+    fams = reg._training_families
+    # ...but the Prometheus conventions hold: NaN progress (no total),
+    # +Inf ETA (armed but unknowable)
+    assert np.isnan(fams["progress"].labels(job="j").value)
+    assert np.isinf(fams["eta"].labels(job="j").value)
+    run.close()
+
+
+def test_chunked_step_delta_books_all_iterations():
+    """The chunked lightgbm path calls ``cb(it + CH - 1)`` once per chunk:
+    the step DELTA must book every iteration in the chunk, and the
+    per-step time must be dt/d_step, not dt."""
+    clk = FakeClock()
+    reg = MetricsRegistry()
+    run = TrainingRun("j", rows_per_step=10, registry=reg, clock=clk,
+                      flight_dump=False)
+    run.tick(step=4)            # chunk of 4
+    clk.advance(8.0)
+    run.tick(step=8)            # second chunk: 8s / 4 steps = 2s per step
+    fams = reg._training_families
+    assert fams["steps"].labels(job="j").value == 8.0
+    assert fams["rows"].labels(job="j").value == 80.0
+    assert run.progress()["ewma_step_seconds"] == pytest.approx(2.0)
+    run.close()
+
+
+def test_loss_tail_is_bounded():
+    run = TrainingRun("j", registry=MetricsRegistry(), clock=FakeClock(),
+                      loss_window=4, flight_dump=False)
+    for i in range(10):
+        run.tick(loss=float(i))
+    assert run.progress()["loss_tail"] == [6.0, 7.0, 8.0, 9.0]
+    run.close()
+
+
+def test_close_removes_gauges_keeps_counters_and_roster():
+    reg = MetricsRegistry()
+    run = TrainingRun("j", total_steps=4, registry=reg, clock=FakeClock(),
+                      flight_dump=False)
+    run.tick(step=1)
+    assert active_runs(reg) == [run]
+    run.close()
+    assert active_runs(reg) == []
+    fams = reg._training_families
+    # gauge series evicted (their callbacks pin the run), counters stay
+    assert dict(fams["progress"]._snapshot()) == {}
+    assert fams["steps"].labels(job="j").value == 1.0
+    # idempotent, and ticks after close are dropped
+    run.close()
+    run.tick(step=2)
+    assert fams["steps"].labels(job="j").value == 1.0
+
+
+# ---------------------------------------------------------------------------
+# stall watchdog (FakeClock, direct check())
+# ---------------------------------------------------------------------------
+
+def test_stall_latches_once_and_rearms_on_recovery(tmp_path):
+    from mmlspark_tpu.observability.flightrecorder import get_flight_recorder
+    clk = FakeClock()
+    reg = MetricsRegistry()
+    get_flight_recorder(reg, dump_dir=str(tmp_path))
+    run = TrainingRun("j", total_steps=100, registry=reg, clock=clk,
+                      stall_timeout_s=5.0)
+    run.tick(step=1)
+    clk.advance(1.0)
+    run.tick(step=2)            # EWMA = 1.0s; timeout stays at the 5s floor
+    clk.advance(30.0)           # no tick for 30s
+    assert run.check() is True
+    # still stalled on later polls, but the trip latch holds: one stall,
+    # one counter inc, one dump
+    assert run.check() is True
+    fams = reg._training_families
+    assert fams["stalls"].labels(job="j").value == 1.0
+    assert len(list(tmp_path.glob("flightdump_*_train_stall.json"))) == 1
+    assert run.progress()["stalls"] == 1
+    # recovery tick re-arms; the 30s gap folds into the EWMA (a slow step
+    # IS a slow step), so the next stall needs the rescaled timeout
+    run.tick(step=3)
+    assert run.check() is False
+    clk.advance(1000.0)
+    assert run.check() is True      # trips again: latch reset by the tick
+    assert fams["stalls"].labels(job="j").value == 2.0
+    run.close()
+
+
+def test_stall_dump_names_the_hung_prefetcher(tmp_path):
+    """The chaos drill: a tile load that never returns (no exception — the
+    retry path can't see it) freezes the ticks; the watchdog trip leaves a
+    ``train_stall`` flight dump whose training source shows the prefetcher
+    blocked (``waiting=True``) with ``tiles_served`` frozen."""
+    from mmlspark_tpu.io.chunked import TilePrefetcher
+    from mmlspark_tpu.observability.flightrecorder import get_flight_recorder
+    from mmlspark_tpu.testing.chaos import HungLoadInjector
+    clk = FakeClock()
+    reg = MetricsRegistry()
+    get_flight_recorder(reg, dump_dir=str(tmp_path))   # BEFORE the run
+    run = TrainingRun("hungjob", total_steps=8, registry=reg, clock=clk,
+                      stall_timeout_s=5.0)
+    inj = HungLoadInjector(hang_at=2)
+    pf = TilePrefetcher(range(8), inj.wrap(lambda i: i * 10), site="hung",
+                        registry=reg)
+    run.set_prefetch_fn(pf.snapshot)
+    got = []
+    it = iter(pf)
+    got.append(next(it))        # tile 0 (load 2 is hung readahead-side)
+    got.append(next(it))        # tile 1
+    run.tick(step=1)
+    run.tick(step=2)
+    assert inj.hanging.wait(5.0), "injector never blocked the worker"
+    # consumer now blocked in real life; here the clock just advances
+    clk.advance(60.0)
+    assert run.check() is True
+    dumps = sorted(tmp_path.glob("flightdump_*_train_stall.json"))
+    assert len(dumps) == 1
+    dump = json.loads(dumps[0].read_text())
+    src = dump["source.training.hungjob"]
+    assert src["step"] == 2 and src["stalls"] == 1
+    assert src["prefetch"]["waiting"] is False  # consumer not in take()
+    assert src["prefetch"]["tiles_served"] == 2
+    assert src["prefetch"]["site"] == "hung"
+    assert reg._training_families["stalls"].labels(job="hungjob").value == 1.0
+    # release the hang: the stream finishes and the next ticks flow
+    inj.release()
+    got.extend(it)
+    assert got == [i * 10 for i in range(8)]
+    run.tick(step=3)
+    assert run.check() is False
+    run.close()
+
+
+def test_preempt_on_stall_requests_graceful_shutdown():
+    from mmlspark_tpu.utils.resilience import preemption_scope
+    clk = FakeClock()
+    run = TrainingRun("j", registry=MetricsRegistry(), clock=clk,
+                      stall_timeout_s=5.0, preempt_on_stall=True,
+                      flight_dump=False)
+    with preemption_scope() as token:
+        run.set_preemption_token(token)
+        assert run.progress()["preemption_requested"] is False
+        clk.advance(60.0)
+        assert run.check() is True
+        assert token.requested
+        assert run.progress()["preemption_requested"] is True
+    run.close()
+
+
+# ---------------------------------------------------------------------------
+# MonitorServer over a real socket
+# ---------------------------------------------------------------------------
+
+def test_monitor_endpoints_and_openmetrics_negotiation():
+    clk = FakeClock()
+    reg = MetricsRegistry()
+    run = TrainingRun("srv", total_steps=4, rows_per_step=10, registry=reg,
+                      clock=clk, flight_dump=False)
+    run.tick(step=1)
+    clk.advance(2.0)
+    run.tick(step=2, loss=0.25)
+    srv = MonitorServer(run, port=0).start()
+    try:
+        assert active_monitors(reg) == [srv]
+        p = _get_json(srv.address + "/progress")
+        assert p["job"] == "srv" and p["step"] == 2
+        assert p["eta_seconds"] == pytest.approx(4.0)
+        assert p["loss_tail"] == [0.25]
+        body, ctype = _get_text(srv.address + "/metrics")
+        assert "text/plain; version=0.0.4" in ctype
+        assert 'mmlspark_training_steps_total{job="srv"} 2' in body
+        om, om_ctype = _get_text(srv.address + "/metrics",
+                                 accept="application/openmetrics-text")
+        assert "application/openmetrics-text" in om_ctype
+        assert om.endswith("# EOF\n")
+        st = _get_json(srv.address + "/stats")
+        assert st["role"] == "trainer" and st["step"] == 2
+        hb, _ = _get_text(srv.address + "/health")
+        assert hb == "ok"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get_json(srv.address + "/nope")
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+        run.close()
+    assert active_monitors(reg) == []
+
+
+def test_monitor_debug_dump_over_http(tmp_path):
+    from mmlspark_tpu.observability.flightrecorder import get_flight_recorder
+    reg = MetricsRegistry()
+    get_flight_recorder(reg, dump_dir=str(tmp_path))
+    run = TrainingRun("dmp", registry=reg, clock=FakeClock())
+    run.tick(step=1)
+    srv = MonitorServer(run, port=0).start()
+    try:
+        snap = _get_json(srv.address + "/debug/dump", timeout=10)
+        assert "source.training.dmp" in snap
+        assert snap["source.training.dmp"]["step"] == 1
+        assert any(tmp_path.glob("flightdump_*_http.json"))
+    finally:
+        srv.stop()
+        run.close()
+
+
+# ---------------------------------------------------------------------------
+# E2E: live train_streamed serving /progress mid-run
+# ---------------------------------------------------------------------------
+
+def test_train_streamed_serves_progress_live():
+    from mmlspark_tpu.lightgbm.core import GBDTParams, train_streamed
+    from mmlspark_tpu.observability.metrics import get_registry
+    reg = get_registry()
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((400, 8)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    p = GBDTParams(objective="binary", num_iterations=10, num_leaves=8)
+    hits = {}
+
+    def probe(i, ev):
+        if i == 4 and "progress" not in hits:
+            mons = [m for m in active_monitors(reg)
+                    if m.run.job == "lightgbm.train_streamed"]
+            assert mons, "no live monitor mid-run"
+            addr = mons[0].address
+            hits["progress"] = _get_json(addr + "/progress")
+            hits["metrics"] = _get_text(addr + "/metrics")[0]
+
+    res = train_streamed(X, y, p, valid=(X[:100], y[:100]), tile_rows=128,
+                         callbacks=[probe], monitor_port=0)
+    prog = hits["progress"]
+    assert prog["driver"] == "lightgbm.train_streamed"
+    # probe runs BEFORE the appended monitor callback, so iteration 4's
+    # own tick has not landed yet
+    assert prog["step"] >= 4 and prog["total_steps"] == 10
+    assert prog["phase"] == "boosting"
+    assert prog["rows_per_second"] and prog["rows_per_second"] > 0
+    assert prog["eta_seconds"] is not None
+    assert prog["loss_tail"], "valid= metric should feed the loss tail"
+    # prefetch overlap state rides along, cumulative + live pass
+    assert prog["prefetch"]["tiles"] > 0
+    assert "overlap_pct" in prog["prefetch"]
+    assert prog["watchdog"]["trips"] == 0
+    assert "mmlspark_training_steps_total" in hits["metrics"]
+    # driver cleaned up after itself: no leaked run, monitor, or socket
+    assert not [r for r in active_runs(reg)
+                if r.job == "lightgbm.train_streamed"]
+    assert not [m for m in active_monitors(reg)
+                if m.run.job == "lightgbm.train_streamed"]
+    assert res.booster.num_trees > 0
+
+
+def test_trainer_stream_callbacks_seam():
+    """Satellite 1: ``Trainer.train_stream`` exposes the same callbacks
+    seam as the gbdt drivers — ``cb(step_index, None)`` after every step,
+    with evals always None (no per-step loss sync)."""
+    pytest.importorskip("flax")
+    import jax
+    import optax
+    from flax import linen as nn
+    from mmlspark_tpu.parallel.trainer import (Trainer,
+                                               softmax_cross_entropy)
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4)(x)
+
+    def batches():
+        r = np.random.default_rng(3)
+        for _ in range(5):
+            x = r.normal(size=(8, 6)).astype(np.float32)
+            yield {"x": x, "y": (x[:, 0] > 0).astype(np.int32)}
+
+    tr = Trainer(Tiny(), optax.sgd(1e-2), softmax_cross_entropy)
+    state = tr.init_state(jax.random.PRNGKey(0), next(iter(batches())))
+    seen = []
+    _, losses, stats = tr.train_stream(
+        state, batches(), callbacks=[lambda i, ev: seen.append((i, ev))])
+    assert seen == [(i, None) for i in range(5)]
+    assert stats["steps"] == 5.0 and len(losses) == 5
+
+
+def test_trainer_stream_monitor_books_rows_from_batches():
+    pytest.importorskip("flax")
+    import jax
+    import optax
+    from flax import linen as nn
+    from mmlspark_tpu.observability.metrics import get_registry
+    from mmlspark_tpu.parallel.trainer import (Trainer,
+                                               softmax_cross_entropy)
+    reg = get_registry()
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4)(x)
+
+    def batches():
+        r = np.random.default_rng(4)
+        for _ in range(4):
+            x = r.normal(size=(16, 6)).astype(np.float32)
+            yield {"x": x, "y": (x[:, 0] > 0).astype(np.int32)}
+
+    tr = Trainer(Tiny(), optax.sgd(1e-2), softmax_cross_entropy)
+    state = tr.init_state(jax.random.PRNGKey(0), next(iter(batches())))
+    before = reg.family("mmlspark_training_rows_total")
+    base = before.labels(job="parallel.trainer.stream").value \
+        if before is not None else 0.0
+    tr.train_stream(state, batches(), total_steps=4,
+                    monitor_stall_timeout_s=300.0)
+    rows = reg.family("mmlspark_training_rows_total") \
+        .labels(job="parallel.trainer.stream").value
+    assert rows - base == 64.0      # 4 batches x 16 rows
+    assert not [r for r in active_runs(reg)
+                if r.job == "parallel.trainer.stream"]
+
+
+# ---------------------------------------------------------------------------
+# fleet federation
+# ---------------------------------------------------------------------------
+
+def test_trainer_federates_but_never_routes():
+    from mmlspark_tpu.serving.distributed import TopologyService
+    reg = MetricsRegistry()
+    svc = TopologyService(registry=reg, probe_interval_s=None).start()
+    topo = f"http://{svc.host}:{svc.port}"
+    run = TrainingRun("fleet.job", total_steps=10, rows_per_step=50,
+                      registry=reg, clock=FakeClock(), flight_dump=False)
+    run.tick(step=2)
+    srv = MonitorServer(run, port=0, topology_address=topo).start()
+    try:
+        assert srv.registered
+        # in the workers table (the federator's workers_fn)...
+        assert "train-fleet.job" in svc.routing_table()
+        # ...but GET /routing (score traffic) filters role=trainer out
+        assert "train-fleet.job" not in _get_json(topo + "/routing")
+        body, _ = _get_text(topo + "/fleet/metrics?refresh=1", timeout=10)
+        assert 'mmlspark_training_steps_total{job="fleet.job"} 2' in body
+        # aggregate_stats carries the trainer's stats stanza
+        agg = svc.aggregate_stats()
+        assert agg["workers"]["train-fleet.job"]["role"] == "trainer"
+    finally:
+        srv.stop()
+        run.close()
+        svc.stop()
+    assert "train-fleet.job" not in svc.routing_table()
+
+
+def test_start_training_monitor_one_call_wiring():
+    reg = MetricsRegistry()
+    clk = FakeClock()
+    run, srv = start_training_monitor(
+        "wired", total_steps=5, rows_per_step=10, registry=reg,
+        monitor_port=0, clock=clk)
+    try:
+        assert active_runs(reg) == [run] and active_monitors(reg) == [srv]
+        run.tick(step=1)
+        assert _get_json(srv.address + "/progress")["step"] == 1
+    finally:
+        srv.stop()
+        run.close()
+    # no server when only the watchdog is wanted
+    run2, srv2 = start_training_monitor("wd-only", registry=reg,
+                                        stall_timeout_s=60.0, clock=clk)
+    assert srv2 is None
+    run2.close()
