@@ -197,8 +197,10 @@ class PagePool:
     """
 
     #: booking ops — each books pages moved, not call count ("denied"
-    #: books pages REFUSED: the admission-control outcome, ISSUE 13)
-    OPS = ("allocate", "extend", "free", "denied")
+    #: books pages REFUSED: the admission-control outcome, ISSUE 13).
+    #: "pin" books refcount increments on shared pages (prefix hits),
+    #: "cow" books private copies minted by copy-on-write splits (ISSUE 20)
+    OPS = ("allocate", "extend", "free", "denied", "pin", "cow")
 
     def __init__(self, module=None, num_pages: int = 0, page_size: int = 64,
                  *, name: str = "pool", registry=None,
@@ -215,6 +217,15 @@ class PagePool:
         self._name = name
         #: free physical pages; page 0 (trash) is never in this list
         self._free = list(range(self.num_pages - 1, 0, -1))
+        #: per-page refcounts (ISSUE 20): a page is on exactly one side —
+        #: in ``_free`` with no entry here, or held with refcount >= 1.
+        #: ``free()`` decrements and only returns the page at zero, so a
+        #: prefix-shared page survives any one holder's release
+        self._ref: Dict[int, int] = {}
+        #: the prefix index retaining pages in this pool, if any (set by
+        #: ``ModelRunner.prefix_cache``); ``resized()`` flushes it so a
+        #: successor pool can never be handed a dangling page id
+        self.prefix_index = None
         self._cond = make_condition("PagePool._cond")
         self._cache = None          # built lazily, rebuilt if dropped
         self._cache_nbytes = 0
@@ -305,10 +316,15 @@ class PagePool:
         self._g_hw.set(float(self.high_water), runner=self._name,
                        page_size=ps)
 
-    def allocate(self, n: int, op: str = "allocate"):
-        """Hand out ``n`` pages (prefill sizing: ``ceil(true_len / page_
-        size)`` per sequence).  Raises when the budget is exhausted —
-        admission control, not silent overcommit."""
+    def allocate(self, n: int, op: str = "allocate", shared=None):
+        """Hand out ``n`` fresh pages (prefill sizing: ``ceil(true_len /
+        page_size)`` per sequence).  ``shared`` (ISSUE 20) names already-
+        resident pages to PIN instead of copy — each gains a refcount and
+        rides ahead of the fresh pages in the returned list, so a prefix
+        hit allocates only its suffix.  Atomic: a refused fresh allocation
+        unpins ``shared`` before raising.  Raises when the budget is
+        exhausted — admission control, not silent overcommit."""
+        shared = [int(p) for p in shared] if shared else []
         with self._cond:
             self._integrate_locked()
             if n > len(self._free):
@@ -320,17 +336,53 @@ class PagePool:
                     f"{len(self._free)} free of {self.capacity} "
                     f"(page_size={self.page_size}) — free finished "
                     "sequences, shrink the batch, or size the pool larger")
+            if shared:
+                self._pin_locked(shared)
             pages = [self._free.pop() for _ in range(n)]
+            for p in pages:
+                self._ref[p] = 1
             self._book(op, n)
-            return pages
+            return shared + pages
 
     def extend(self, n: int = 1):
         """Allocate at a decode page-boundary crossing (same free list,
         booked as ``op="extend"`` so growth is attributable)."""
         return self.allocate(n, op="extend")
 
+    def _pin_locked(self, pages) -> None:
+        for p in pages:
+            r = self._ref.get(p)
+            if r is None:
+                raise ValueError(f"pin of page {p} which is not allocated")
+            self._ref[p] = r + 1
+        self._book("pin", len(pages))
+
+    def pin(self, pages) -> None:
+        """Add a reference to already-resident pages (prefix-cache hit):
+        the pinned pages are shared, and ``free()`` from any one holder
+        only drops that holder's reference."""
+        pages = [int(p) for p in pages]
+        with self._cond:
+            self._integrate_locked()
+            self._pin_locked(pages)
+
+    def refcount(self, page: int) -> int:
+        """Current reference count of ``page`` (0 when free)."""
+        with self._cond:
+            return self._ref.get(int(page), 0)
+
+    def shortfall(self, n: int) -> int:
+        """Free-list deficit for an ``n``-page allocation (0 when it would
+        succeed) — no booking, no side effects.  Callers use it to evict
+        refcount-0 prefix retentions BEFORE an allocate, keeping the
+        index-lock -> pool-lock order deadlock-free."""
+        with self._cond:
+            return max(0, int(n) - len(self._free))
+
     def free(self, pages) -> None:
-        """Return pages to the pool (eos/completion).  Freed pages are not
+        """Drop one reference per page (eos/completion); a page returns to
+        the free list only at refcount zero, so freeing a prefix-shared
+        page never yanks it from the other holders.  Freed pages are not
         zeroed: stale k/v in a reused page sits past the new owner's
         frontier until overwritten, so it is never admissible."""
         pages = [int(p) for p in pages]
@@ -339,7 +391,15 @@ class PagePool:
                              "(page 0 is the reserved trash page)")
         with self._cond:
             self._integrate_locked()
-            self._free.extend(pages)
+            for p in pages:
+                r = self._ref.get(p)
+                if r is None:
+                    raise ValueError(f"double free of page {p}")
+                if r > 1:
+                    self._ref[p] = r - 1
+                else:
+                    del self._ref[p]
+                    self._free.append(p)
             self._book("free", len(pages))
 
     # ------------------------------------------------------- device slabs
@@ -378,7 +438,19 @@ class PagePool:
     def resized(self, num_pages: int) -> "PagePool":
         """A fresh pool with the same module/page size/metric identity but
         ``num_pages`` pages.  Refuses while sequences hold pages or a
-        decode holds the slabs — resizing would orphan them."""
+        decode holds the slabs — resizing would orphan them.
+
+        A prefix index retaining pages here is FLUSHED first (booked
+        ``evicted{reason="pool_replaced"}``) and rebound to the successor:
+        its entries name physical page ids of THIS pool's slabs, and an
+        index surviving a resize un-flushed would hand those ids out
+        against the replacement's slabs — freed-page aliasing (ISSUE 20
+        regression)."""
+        idx = self.prefix_index
+        if idx is not None:
+            # outside the pool lock: flush frees pages back through
+            # free(), which takes it (index-lock -> pool-lock order)
+            idx.flush(reason="pool_replaced")
         with self._cond:
             if self._borrowed or self.pages_in_use():
                 raise RuntimeError(
@@ -389,6 +461,10 @@ class PagePool:
                         name=self._name, registry=self._registry,
                         clock=self._clock)
         pool.auto_sized = self.auto_sized
+        if idx is not None:
+            idx.rebind(pool)
+            pool.prefix_index = idx
+            self.prefix_index = None
         return pool
 
     def return_cache(self, cache) -> None:
@@ -548,6 +624,13 @@ class ModelRunner:
         _att = attribution_instruments(reg)
         self._c_tok_outcome = _att["tokens"]
         self._c_device_s = _att["device"]
+        # prefix-cache surface (ISSUE 20): hit/miss/eviction/CoW counters,
+        # saved-prefill tokens, and the hit-rate / retained-pages gauges —
+        # registered at construction so the telemetry-coverage sweep gates
+        # on them even for runners that never enable the cache;
+        # PrefixIndex binds the children
+        from .prefix_cache import prefix_instruments
+        prefix_instruments(reg)
         #: (device key, page size) -> shared PagePool for paged decode
         self._pools: Dict[Tuple, PagePool] = {}
         #: resolved geometry of the most recent decode (DecodeResult.extras)
@@ -747,6 +830,78 @@ class ModelRunner:
                     pass                      # busy: keep the current pool
             return pool
 
+    def prefix_cache(self, page_size: int = 64, *,
+                     budget_pages: int = 64, pool: Optional[PagePool] = None):
+        """Get-or-create the :class:`~.prefix_cache.PrefixIndex` attached
+        to ``pool`` (default: the runner's shared pool for ``page_size``,
+        created minimal if absent — a later decode grows it).  The index
+        rides the pool (``pool.prefix_index``), so ``resized()`` /
+        auto-grow flush-and-rebind it in one place.  ``budget_pages``
+        applies only at creation; call this before the first cached decode
+        to size the retention budget."""
+        from .prefix_cache import PrefixIndex
+        if pool is None:
+            pool = self._auto_pool(int(page_size), 2)
+        if pool.prefix_index is None:
+            pool.prefix_index = PrefixIndex(
+                pool, budget_pages=budget_pages, name=self.name,
+                registry=self.registry)
+        return pool.prefix_index
+
+    @staticmethod
+    def _alloc_with_reclaim(pool: PagePool, index, n: int,
+                            op: str = "allocate", shared=None):
+        """``pool.allocate`` with one prefix-eviction retry: under pool
+        pressure the index's refcount-0 retentions are reclaimable memory,
+        evicted LRU (``reason="pressure"``) BEFORE the allocation is
+        denied.  Caller-level so the lock order stays index -> pool."""
+        if index is not None and n > 0:
+            short = pool.shortfall(n)
+            if short:
+                index.evict_pages(short, reason="pressure")
+        return pool.allocate(n, op=op, shared=shared)
+
+    def _cow_executable(self):
+        """Device-side page copy for copy-on-write splits: clone one
+        physical page's k/v rows (every layer) from ``src`` into ``dst``.
+        src/dst are traced scalars and the slabs are donated, so the copy
+        is in-place-update-shaped and mints no per-page compile keys (one
+        executable per pool geometry)."""
+        key = ("cow_copy", self._device_key())
+        fn = self._executables.get(key)
+        if fn is not None:
+            return fn
+        with self._lock:
+            fn = self._executables.get(key)
+            if fn is None:
+                def _cow(cache, src, dst):
+                    out = []
+                    for k, v in cache:
+                        out.append((k.at[dst].set(k[src]),
+                                    v.at[dst].set(v[src])))
+                    return tuple(out)
+
+                fn = self._executables[key] = self._instrumented(
+                    _cow, suffix=".cow_copy", donate_argnums=(0,))
+        return fn
+
+    def _cow_split_page(self, pool: PagePool, index, cache, donor: int):
+        """Split a shared page before a divergent write lands on it: mint
+        a private copy (device page clone), drop the caller's reference on
+        the donor, book the split.  Returns ``(cache, new_page)`` — the
+        caller updates its page-table row and pages list.  Raises
+        :class:`PagePoolExhausted` (after a pressure-eviction retry) when
+        no page is mintable — the caller sheds the row like any other
+        mid-flight denial."""
+        import jax.numpy as jnp
+        new_page = self._alloc_with_reclaim(pool, index, 1, op="cow")[0]
+        cache = self._cow_executable()(cache, jnp.int32(donor),
+                                       jnp.int32(new_page))
+        pool.free([donor])
+        if index is not None:
+            index.book_cow()
+        return cache, new_page
+
     def _decode_executables(self, batch_b: int, prompt_b: int,
                             cache_len: Optional[int] = None, *,
                             page_size: Optional[int] = None,
@@ -869,6 +1024,7 @@ class ModelRunner:
                kv_layout: str = "dense",
                page_size: int = 64,
                pool: Optional[PagePool] = None,
+               prefix_cache: bool = False,
                watchdog=None) -> DecodeResult:
         """KV-cached batched autoregressive generation.
 
@@ -904,7 +1060,17 @@ class ModelRunner:
         ``sample_fn``'s output for frozen rows is discarded, so tokens are
         unaffected).  ``collect_logits=True`` keeps frozen rows' pages
         live instead, so the recorded distributions match the dense
-        layout within the committed tolerance at every step."""
+        layout within the committed tolerance at every step.
+
+        ``prefix_cache=True`` (paged + greedy only, ISSUE 20) consults the
+        runner's :class:`~.prefix_cache.PrefixIndex` at admission: each
+        row's cached prefix pages are PINNED instead of re-prefilled, only
+        the suffix is allocated, and the prefill runs position-offset over
+        the uncached suffix on the SAME executable signature (positions
+        are traced data — zero new compile keys per hit length).  Rows
+        that complete ok are retained into the index for the next
+        request's hit.  Greedy tokens stay bit-identical to a cold
+        decode — docs/runner.md "Prefix caching" states the argument."""
         if self.module is None or not hasattr(self.module, "init_cache"):
             raise TypeError(
                 "decode() needs a module with init_cache (a KV-cache-capable "
@@ -932,6 +1098,15 @@ class ModelRunner:
             raise ValueError("bucket smaller than the batch/prompt it serves")
         # greedy/eos fast path: sample + freeze on device (donated buffers)
         fused = sample_fn is None and not collect_logits
+        if prefix_cache and not paged:
+            raise ValueError("prefix_cache=True needs kv_layout='paged' — "
+                             "the cache shares resident PagePool pages")
+        if prefix_cache and (sample_fn is not None or collect_logits):
+            raise ValueError(
+                "prefix_cache=True supports the greedy fused path only: "
+                "cached positions' logits are never recomputed, so a "
+                "sample_fn / collect_logits caller would observe a "
+                "different distribution surface than a cold decode")
         toks = np.zeros((B_b, P_b), np.int32)
         toks[:B, :P] = prompts
         lens = np.concatenate([lengths, np.ones(B_b - B, np.int32)])
@@ -940,6 +1115,11 @@ class ModelRunner:
 
         table = None
         seq_pages: list = []
+        index = None
+        #: per-row cached prompt positions (prefix hit) — 0 without a hit;
+        #: prefill positions offset past these, the step loop keeps TRUE
+        #: lengths (cached k/v is read through the shared pages)
+        shared_n = np.zeros(B_b, np.int32)
         if paged:
             if not hasattr(self.module, "init_paged_cache"):
                 raise TypeError(
@@ -965,6 +1145,8 @@ class ModelRunner:
                     f"{max_len} (positional table bound)")
             if pool is None:
                 pool = self._auto_pool(page_size, B_b * table_w + 1)
+            if prefix_cache:
+                index = self.prefix_cache(page_size, pool=pool)
             prefill, step = self._decode_executables(
                 B_b, P_b, page_size=page_size, table_w=table_w,
                 fused=fused, eos_id=eos_id)
@@ -972,12 +1154,31 @@ class ModelRunner:
             seq_pages = [[] for _ in range(B_b)]
             try:
                 # allocate by TRUE length — pad rows (and unallocated table
-                # entries) stay on the trash page and never hold pool pages
+                # entries) stay on the trash page and never hold pool pages.
+                # With the prefix cache, cached prefix pages are pinned by
+                # lookup() and only the suffix is freshly allocated; the
+                # row's prompt tokens shift left to the uncached suffix
                 for b in range(B):
+                    if index is not None:
+                        cpages, covered = index.lookup(
+                            prompts[b, :int(lengths[b])])
+                    else:
+                        cpages, covered = [], 0
                     n_pages = -(-int(lengths[b]) // page_size)
-                    pgs = pool.allocate(n_pages)
-                    seq_pages[b] = list(pgs)
-                    table[b, :n_pages] = pgs
+                    try:
+                        pgs = self._alloc_with_reclaim(
+                            pool, index, n_pages - len(cpages))
+                    except Exception:
+                        if cpages:
+                            pool.free(cpages)   # drop the lookup pins
+                        raise
+                    seq_pages[b] = list(cpages) + list(pgs)
+                    table[b, :n_pages] = seq_pages[b]
+                    if covered:
+                        shared_n[b] = covered
+                        suffix = int(lengths[b]) - covered
+                        toks[b, :] = 0
+                        toks[b, :suffix] = prompts[b, covered:int(lengths[b])]
                 cache = pool.borrow_cache()
             except Exception:
                 # a failed allocation or slab build must not leak the pages
@@ -987,6 +1188,30 @@ class ModelRunner:
                 if leftover:
                     pool.free(leftover)
                 raise
+            if index is not None:
+                # copy-on-write guard over the prefill write range: a
+                # suffix (or pad-tail) write landing on a refcount>1 page
+                # would corrupt the other holders' admissible slots — mint
+                # a private copy first (mid-page tail sharing is the one
+                # admission shape that produces this; see prefix_cache.py)
+                try:
+                    for b in range(B):
+                        lo = int(shared_n[b]) // page_size
+                        hi = (int(lengths[b]) - 1) // page_size
+                        for pi in range(lo, hi + 1):
+                            pg = seq_pages[b][pi]
+                            if pool.refcount(pg) > 1:
+                                cache, newp = self._cow_split_page(
+                                    pool, index, cache, pg)
+                                seq_pages[b][pi] = newp
+                                table[b, pi] = newp
+                except Exception:
+                    for pgs in seq_pages:
+                        if pgs:
+                            pool.free(pgs)
+                    seq_pages = [[] for _ in range(B_b)]
+                    pool.return_cache(None)
+                    raise
             pages_prefill = sum(len(p) for p in seq_pages)
             peak_pages = pool.pages_in_use()
         else:
@@ -1004,8 +1229,14 @@ class ModelRunner:
             cache = self.module.init_cache(B_b, S)
             cache_nbytes = sum(int(l.nbytes)
                                for l in jax.tree_util.tree_leaves(cache))
-        positions = np.broadcast_to(np.arange(P_b, dtype=np.int32),
-                                    (B_b, P_b))
+        # prefill positions offset past each row's cached prefix (all-zero
+        # offsets without a hit — identical to the cold layout); the gather
+        # lengths are SUFFIX lengths so the last-real-token logits come
+        # from the final uncached position.  Positions/lengths are traced
+        # data, so hit lengths mint no compile keys by construction.
+        positions = (shared_n[:, None]
+                     + np.arange(P_b, dtype=np.int32)[None, :])
+        plens = (lens - shared_n).astype(np.int32)
         sample = sample_fn or (lambda lg: np.argmax(lg, axis=-1))
         out_tokens = np.zeros((B_b, max_new_tokens), np.int32)
         out_logits = [] if collect_logits else None
@@ -1050,7 +1281,7 @@ class ModelRunner:
         try:
             last, cache = prefill(
                 variables, jnp.asarray(toks), jnp.asarray(positions),
-                jnp.asarray(lens), table_dev, cache)
+                jnp.asarray(plens), table_dev, cache)
             self._c_batches["decode"].inc()
             if fused:
                 tok_d, fin_d = self._sample_executable(B_b, eos_id)(
@@ -1122,9 +1353,22 @@ class ModelRunner:
                                 (finished[b] and not collect_logits):
                             continue
                         pi = int(pos[b]) // page_size
-                        if pi >= len(seq_pages[b]):
+                        needs_page = pi >= len(seq_pages[b])
+                        needs_cow = (not needs_page and index is not None
+                                     and pool.refcount(seq_pages[b][pi]) > 1)
+                        if needs_page or needs_cow:
                             try:
-                                new_page = pool.extend()[0]
+                                if needs_cow:
+                                    # the paged step detected a write
+                                    # landing on a refcount>1 page: route
+                                    # it to a freshly allocated private
+                                    # copy — table row updated, donor
+                                    # refcount decremented (ISSUE 20 CoW)
+                                    cache, new_page = self._cow_split_page(
+                                        pool, index, cache, seq_pages[b][pi])
+                                else:
+                                    new_page = self._alloc_with_reclaim(
+                                        pool, index, 1, op="extend")[0]
                             except PagePoolExhausted:
                                 # mid-decode exhaustion of a budgeted pool
                                 # is admission control: freeze the row,
@@ -1144,7 +1388,10 @@ class ModelRunner:
                                 table[b, :] = 0
                                 table_dirty = True
                                 continue
-                            seq_pages[b].append(new_page)
+                            if needs_cow:
+                                seq_pages[b][pi] = new_page
+                            else:
+                                seq_pages[b].append(new_page)
                             table[b, pi] = new_page
                             table_dirty = True
                     peak_pages = max(peak_pages, pool.pages_in_use())
@@ -1186,9 +1433,25 @@ class ModelRunner:
             if watchdog is not None:
                 watchdog.disarm()
             if paged:
-                leftover = [p for pgs in seq_pages for p in pgs]
-                if leftover:
-                    pool.free(leftover)
+                # retention (ISSUE 20): an ok row's pages hold valid k/v
+                # for its prompt + every fed-back token (the final sampled
+                # token is never written) — hand them to the prefix index
+                # as the next request's hit instead of the free list.
+                # Denied/eos-freed rows and failed loops free as before.
+                for b in range(B_b):
+                    pgs = seq_pages[b]
+                    if not pgs:
+                        continue
+                    if (index is not None and ok and b < B
+                            and b not in denied_at):
+                        n_gen = int(t) + 1
+                        ids = np.concatenate(
+                            [prompts[b, :int(lengths[b])],
+                             out_tokens[b, :max(n_gen - 1, 0)]])
+                        index.release(ids, pgs)
+                    else:
+                        pool.free(pgs)
+                    seq_pages[b] = []
                 # after a mid-step failure the donated slab state is
                 # unknown — drop it so the next borrower rebuilds zeros
                 pool.return_cache(cache if ok else None)
@@ -1255,6 +1518,11 @@ class ModelRunner:
                     100.0 * peak_pages / max(pool.capacity, 1), 2),
                 cache_bytes_per_seq=pool.page_nbytes() * peak_pages
                 / max(B, 1))
+            if index is not None:
+                extras["prefix"] = {
+                    "cached_tokens": int(shared_n[:B].sum()),
+                    "hit_rows": int((shared_n[:B] > 0).sum()),
+                    **index.stats()}
         else:
             extras.update(cache_len=S,
                           cache_bytes_per_seq=cache_nbytes / max(B, 1))
@@ -1299,7 +1567,8 @@ class ModelRunner:
                       eos_id: Optional[int] = None, page_size: int = 64,
                       pool: Optional[PagePool] = None,
                       clock: Optional[Callable[[], float]] = None,
-                      stall_timeout_s: Optional[float] = None
+                      stall_timeout_s: Optional[float] = None,
+                      prefix_cache: bool = False
                       ) -> "ContinuousDecoder":
         """A persistent in-flight decode loop over the paged pool (ISSUE 13
         tentpole): a fixed ``slots``-wide batch whose per-slot state (page-
@@ -1317,6 +1586,15 @@ class ModelRunner:
         :meth:`decode`.  Greedy/eos fast path only
         (``sample_fn``/``collect_logits`` stay one-shot).
 
+        With ``prefix_cache=True`` (ISSUE 20) admission consults the
+        pool's :class:`~.prefix_cache.PrefixIndex`: a join allocates only
+        the uncached suffix pages and prefills only the uncached positions
+        (positions offset past the shared prefix — traced data, so joins
+        STILL cannot mint a compile key), and a finished request's pages
+        are retained in the index instead of freed, funding the next
+        arrival's hit.  Greedy tokens stay bit-identical to cold-cache
+        :meth:`decode` across hit/partial-hit/miss/CoW traffic.
+
         Drive it with :meth:`ContinuousDecoder.submit` + either
         :meth:`ContinuousDecoder.start` (background engine thread — what
         serving uses) or manual :meth:`ContinuousDecoder.step` calls
@@ -1326,7 +1604,8 @@ class ModelRunner:
                                  max_new_tokens=max_new_tokens,
                                  eos_id=eos_id, page_size=page_size,
                                  pool=pool, clock=clock,
-                                 stall_timeout_s=stall_timeout_s)
+                                 stall_timeout_s=stall_timeout_s,
+                                 prefix_cache=prefix_cache)
 
 
 class StreamHandle:
@@ -1343,11 +1622,13 @@ class StreamHandle:
 
     __slots__ = ("prompt", "length", "max_new_tokens", "deadline_s",
                  "on_done", "slot", "tokens", "status", "done",
-                 "t_submit_s", "t_first_s", "pages", "trace_id", "cost")
+                 "t_submit_s", "t_first_s", "pages", "trace_id", "cost",
+                 "prompt_hash", "covered")
 
     def __init__(self, prompt: np.ndarray, length: int, max_new_tokens: int,
                  deadline_s: Optional[float], on_done: Optional[Callable],
-                 trace_id: Optional[str] = None):
+                 trace_id: Optional[str] = None,
+                 prompt_hash: Optional[str] = None):
         self.prompt = prompt
         self.length = int(length)
         self.max_new_tokens = int(max_new_tokens)
@@ -1369,6 +1650,12 @@ class StreamHandle:
         # per-request cost ledger (ISSUE 17) — attached at submit; engine
         # edges mutate it, the terminal outcome classifies its tokens
         self.cost = None
+        # prefix-cache seam (ISSUE 20): the admission-time prompt hash the
+        # serving layer passed through (observability only — the index
+        # matches on token content), and how many leading prompt positions
+        # the index covered (0 = cold; the join prefills only the suffix)
+        self.prompt_hash = prompt_hash
+        self.covered = 0
 
     @property
     def ttft_s(self) -> Optional[float]:
@@ -1434,7 +1721,8 @@ class ContinuousDecoder:
                  eos_id: Optional[int] = None, page_size: int = 64,
                  pool: Optional[PagePool] = None,
                  clock: Optional[Callable[[], float]] = None,
-                 stall_timeout_s: Optional[float] = None):
+                 stall_timeout_s: Optional[float] = None,
+                 prefix_cache: bool = False):
         module = runner.module
         if module is None or not hasattr(module, "init_paged_cache"):
             raise TypeError(
@@ -1466,6 +1754,13 @@ class ContinuousDecoder:
         self._explicit_pool = pool is not None
         self.pool = pool if pool is not None else runner._auto_pool(
             self.page_size, self.slots * self.table_w + 1)
+        # cross-request prefix cache (ISSUE 20): the index rides the POOL
+        # (resized()/auto-grow flush-and-rebind it there), the decoder
+        # only holds the reference — _adopt_current_pool_locked re-reads
+        # it whenever the idle stream re-binds to a replaced pool
+        self._prefix_enabled = bool(prefix_cache)
+        self.index = runner.prefix_cache(
+            self.page_size, pool=self.pool) if prefix_cache else None
         # the one-shot executables AT THE STREAM GEOMETRY — shared cache
         # entries, so a warmed one-shot decode warms the stream and vice
         # versa, and joins can never mint a new compile key.  The step
@@ -1596,16 +1891,32 @@ class ContinuousDecoder:
             (self.runner._device_key(), self.page_size))
         if current is not None and current is not self.pool:
             self.pool = current
+            if self._prefix_enabled:
+                # the index rode the old pool through resized()'s
+                # flush-and-rebind (or needs creating on a fresh pool) —
+                # either way the pool's attached index is authoritative
+                self.index = self.runner.prefix_cache(
+                    self.page_size, pool=current)
 
     def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
                deadline_s: Optional[float] = None,
                on_done: Optional[Callable] = None,
-               trace_id: Optional[str] = None) -> StreamHandle:
+               trace_id: Optional[str] = None,
+               prompt_hash: Optional[str] = None) -> StreamHandle:
         """Admit one request: reserve a free slot and allocate its prompt
         pages NOW (the admission decision), splice into the batch at the
         next step boundary.  Raises :class:`SlotsExhausted` /
         :class:`PagePoolExhausted` when the engine is full — admission
-        control, the serving layer's 503 signal."""
+        control, the serving layer's 503 signal.
+
+        With the stream's prefix cache enabled, admission consults the
+        index first: the longest page-aligned cached prefix is pinned
+        (shared, refcounted) and only the SUFFIX pages are freshly
+        allocated — the join then prefills only the uncached positions.
+        ``prompt_hash`` is the serving seam's request identity (ISSUE 20)
+        — recorded on the handle for ``/debug/requests``; the index
+        itself matches on token content, so hash collisions cannot
+        corrupt decode."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         length = int(prompt.size)
         if not 1 <= length <= self.prompt_bucket:
@@ -1635,15 +1946,30 @@ class ContinuousDecoder:
                     "retry after a sequence finishes, or run more slots")
             # pages allocated inside the slot reservation so the two
             # admission resources can never disagree (denied is booked by
-            # the pool before the raise)
-            pages = self.pool.allocate(n_pages)
+            # the pool before the raise).  Prefix lookup first: cached
+            # prefix pages are PINNED (shared), only the suffix is fresh —
+            # lock order decoder._cond -> index._lock -> pool._cond.
+            covered = 0
+            cpages: List[int] = []
+            if self.index is not None:
+                cpages, covered = self.index.lookup(prompt)
+            try:
+                pages = list(cpages) + list(self.runner._alloc_with_reclaim(
+                    self.pool, self.index, n_pages - len(cpages)))
+            except Exception:
+                if cpages:
+                    self.pool.free(cpages)
+                raise
             slot = self._free.pop()
             handle = StreamHandle(prompt, length, budget, deadline_s,
-                                  on_done, trace_id=trace_id)
+                                  on_done, trace_id=trace_id,
+                                  prompt_hash=prompt_hash)
             handle.slot = slot
-            handle.pages = list(pages)
+            handle.pages = pages
+            handle.covered = covered
             handle.t_submit_s = self.clock()
             handle.cost = self._RequestCost(prefill_tokens=length)
+            handle.cost.prefill_cached = covered
             handle.cost.page_edge(handle.t_submit_s, len(handle.pages))
             self._arrivals.append(handle)
             self._book_occupancy()
@@ -1693,6 +2019,12 @@ class ContinuousDecoder:
                 jnp.zeros(S, jnp.int32),
                 jnp.zeros((S, self.table_w), jnp.int32),
                 jnp.ones(S, bool), self._cache)
+            if self.index is not None:
+                # CoW page-copy at this pool geometry: warmed by a trash
+                # self-copy (page 0 -> page 0, no real page touched) so
+                # the first real split under hit traffic never compiles
+                self._cache = self.runner._cow_executable()(
+                    self._cache, jnp.int32(0), jnp.int32(0))
         except Exception:
             with self._cond:  # same lock as close()/_abort readers (CCY002)
                 self._poisoned = True  # donated slab state unknown (see step)
@@ -1762,13 +2094,36 @@ class ContinuousDecoder:
         runner = self.runner
         self._borrow()
         P_b, W = self.prompt_bucket, self.table_w
+        ps = self.page_size
         positions = np.broadcast_to(np.arange(P_b, dtype=np.int32),
                                     (1, P_b))
         pos_dev = jnp.asarray(positions)
         for h in joiners:
             s = h.slot
+            off = int(h.covered)
+            if off and self.index is not None:
+                # admission CoW guard (ISSUE 20): the suffix prefill
+                # scatters positions [off, length) — a refcount>1 page in
+                # that range (the partially-covered tail page of a
+                # mid-page hit) must be split to a private copy BEFORE
+                # the write lands on state other requests share
+                try:
+                    for pi in range(off // ps, (h.length - 1) // ps + 1):
+                        if pi < len(h.pages) and \
+                                self.pool.refcount(h.pages[pi]) > 1:
+                            self._cache, newp = runner._cow_split_page(
+                                self.pool, self.index, self._cache,
+                                h.pages[pi])
+                            h.pages[pi] = newp
+                except PagePoolExhausted:
+                    # admission-time denial: the arrival never joined —
+                    # its pages fund the survivors, the client sees the
+                    # same retryable verdict as a mid-flight denial
+                    self._cancel_arrival(h, "denied", leavers)
+                    continue
+            suffix = h.length - off
             toks = np.zeros((1, P_b), np.int32)
-            toks[0, :h.length] = h.prompt
+            toks[0, :suffix] = h.prompt[off:]
             jtable = np.zeros((1, W), np.int32)
             n = len(h.pages)
             jtable[0, :n] = h.pages
@@ -1778,9 +2133,13 @@ class ContinuousDecoder:
             self._handles[s] = h
             if self.watchdog is not None:
                 self.watchdog.arm("runner.decode.join")
+            # positions offset past the cached prefix: traced DATA at the
+            # same (1, prompt_bucket) signature, so a hit join reuses the
+            # cold join's executable — no new compile key per hit length
             last, self._cache = self._prefill1(
-                runner.variables, jnp.asarray(toks), pos_dev,
-                jnp.asarray([h.length], np.int32), jnp.asarray(jtable),
+                runner.variables, jnp.asarray(toks),
+                jnp.asarray(positions + off) if off else pos_dev,
+                jnp.asarray([suffix], np.int32), jnp.asarray(jtable),
                 self._cache)
             tok_d, fin_d = self._sample1(last, jnp.zeros(1, bool))
             tok0 = int(np.asarray(tok_d)[0])
@@ -1833,18 +2192,33 @@ class ContinuousDecoder:
             p = int(self._lens[s] + self._emitted[s] - 1)
             pos[s] = p
             pi = p // self.page_size
-            if pi >= len(h.pages):
+            needs_page = pi >= len(h.pages)
+            # step-site CoW guard (ISSUE 20): this step writes position p;
+            # if the page holding p is shared (refcount>1 — the request's
+            # generation diverging from a retained/shared prefix mid-page)
+            # the write must land on a private copy
+            needs_cow = (not needs_page and self.index is not None
+                         and self.pool.refcount(h.pages[pi]) > 1)
+            if needs_page or needs_cow:
                 try:
-                    new_page = self.pool.extend()[0]
+                    if needs_cow:
+                        self._cache, new_page = self.runner._cow_split_page(
+                            self.pool, self.index, self._cache, h.pages[pi])
+                    else:
+                        new_page = self.runner._alloc_with_reclaim(
+                            self.pool, self.index, 1, op="extend")[0]
                 except PagePoolExhausted:
                     # mid-flight denial: the slot leaves with what it has
                     # (op="denied" already booked by the pool), its pages
                     # fund the survivors
                     self._release(s, "denied", leavers)
                     continue
-                h.pages.append(new_page)
-                if h.cost is not None:
-                    h.cost.page_edge(now, 1)
+                if needs_cow:
+                    h.pages[pi] = new_page
+                else:
+                    h.pages.append(new_page)
+                    if h.cost is not None:
+                        h.cost.page_edge(now, 1)
                 self._table[s, pi] = new_page
                 self._table_dirty = True
         if not self._live:
@@ -1928,7 +2302,18 @@ class ContinuousDecoder:
                 self._c_tok_outcome.inc(h.cost.decode_tokens,
                                         outcome=self._outcome_map[outcome])
         if h.pages:
-            self.pool.free(h.pages)
+            if outcome == "ok" and self.index is not None and h.tokens:
+                # prefix retention (ISSUE 20): a cleanly finished request
+                # donates its pages to the index keyed by every position
+                # actually WRITTEN — prompt + generated[:-1] (the final
+                # sampled token's k/v was never scattered) — turning this
+                # prefill into the next arrival's hit.  The index takes
+                # ownership of the references; budget-surplus pages free.
+                ids = np.concatenate(
+                    [h.prompt, np.asarray(h.tokens[:-1], np.int32)])
+                self.index.release(ids, h.pages)
+            else:
+                self.pool.free(h.pages)
             h.pages = []
         self._table[s, :] = 0
         self._table_dirty = True
@@ -1986,6 +2371,8 @@ class ContinuousDecoder:
             "capacity": self.pool.capacity,
             "pages_in_use": self.pool.pages_in_use(),
             "occupancy_pct": round(self.pool.occupancy_pct(), 2)}
+        if self.index is not None:
+            state["prefix_cache"] = self.index.stats()
         return state
 
     # -------------------------------------------------------------- lifecycle
@@ -2281,7 +2668,8 @@ class _RunnerScorer(Transformer):
         return body
 
     def _continuous_submit(self, payload, resolve, queue_age_s=0.0,
-                           deadline_budget_s=None, trace_id=None) -> None:
+                           deadline_budget_s=None, trace_id=None,
+                           prompt_hash=None) -> None:
         """The serving seam (ISSUE 13): admit ONE request into the
         in-flight batch.  ``resolve(reply=, status=, verdict=,
         retry_after_s=, ttft_s=)`` fires on the engine thread at the
@@ -2297,7 +2685,9 @@ class _RunnerScorer(Transformer):
         submit-to-first-token.  ``trace_id`` (ISSUE 15) threads the
         request's trace through to the engine so the TTFT histogram's
         exemplar names it — the resolve path runs on the engine thread,
-        where no ambient span exists to supply one."""
+        where no ambient span exists to supply one.  ``prompt_hash``
+        (ISSUE 20) is the admission seam's stable prompt identity,
+        recorded on the stream handle for ``/debug/requests``."""
         decoder = self._ensure_decoder()
         prompt = np.asarray(payload, np.int32).reshape(-1)
         deadline_s = None if deadline_budget_s is None \
@@ -2341,7 +2731,7 @@ class _RunnerScorer(Transformer):
                         status=500, verdict="error", **kw)
 
         decoder.submit(prompt, deadline_s=deadline_s, on_done=on_done,
-                       trace_id=trace_id)
+                       trace_id=trace_id, prompt_hash=prompt_hash)
 
     # ------------------------------------------------------------- batch path
     def _decode_batch(self, col, n: int, out: np.ndarray, age) -> None:

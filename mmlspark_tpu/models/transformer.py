@@ -67,11 +67,22 @@ def _paged_cache_update(cache_kv, k_new, v_new, positions, page_table):
     logical page j (absolute positions [j*page_size, (j+1)*page_size)) to
     its physical pool page.  Unallocated table entries are 0, the reserved
     trash page, so pad rows and pad-tail prompt positions write garbage
-    into a page no real sequence ever reads."""
+    into a page no real sequence ever reads.
+
+    Offset-prefill contract (ISSUE 20): ``positions`` need not start at 0
+    — a prefix-cache hit prefills only the uncached suffix with positions
+    offset past the shared prefix, against a table already naming the
+    cached pages.  Positions whose logical page falls PAST the table's
+    width are routed to the trash page explicitly: a raw gather would
+    clamp them to column W-1, and under prefix sharing that column's page
+    can be live shared state owned by other sequences."""
     ck, cv = cache_kv
     page_size = ck.shape[1]
+    W = page_table.shape[1]
     bidx = jnp.arange(page_table.shape[0])[:, None]    # (B, 1)
-    phys = page_table[bidx, positions // page_size]    # (B, L) physical page
+    logical = positions // page_size                   # (B, L) logical page
+    phys = jnp.where(logical < W,                      # (B, L) physical page
+                     page_table[bidx, jnp.minimum(logical, W - 1)], 0)
     slot = positions % page_size                       # (B, L) slot in page
     ck = ck.at[phys, slot].set(k_new.astype(ck.dtype))
     cv = cv.at[phys, slot].set(v_new.astype(cv.dtype))

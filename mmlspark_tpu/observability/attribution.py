@@ -108,12 +108,18 @@ class RequestCost:
     locking: every writer runs on the decode loop's thread (or the
     submitting thread before the handle is visible to it)."""
 
-    __slots__ = ("queue_s", "prefill_tokens", "decode_tokens", "device_s",
-                 "page_seconds", "pages_held", "pages_peak", "_page_t")
+    __slots__ = ("queue_s", "prefill_tokens", "prefill_cached",
+                 "decode_tokens", "device_s", "page_seconds", "pages_held",
+                 "pages_peak", "_page_t")
 
     def __init__(self, queue_s: float = 0.0, prefill_tokens: int = 0):
         self.queue_s = float(queue_s)
         self.prefill_tokens = int(prefill_tokens)
+        # prefix-cache lane (ISSUE 20): of prefill_tokens, how many were
+        # served from resident shared pages — device work SKIPPED, not
+        # spent, so goodput accounting books them as saved rather than
+        # silently dropping them from the conservation story
+        self.prefill_cached = 0
         self.decode_tokens = 0
         self.device_s = 0.0
         self.page_seconds = 0.0
@@ -142,6 +148,7 @@ class RequestCost:
         return {
             "queue_s": round(self.queue_s, 6),
             "prefill_tokens": int(self.prefill_tokens),
+            "prefill_cached": int(self.prefill_cached),
             "decode_tokens": int(self.decode_tokens),
             "device_s": round(self.device_s, 6),
             "page_seconds": round(self.page_seconds, 6),
@@ -235,6 +242,7 @@ class CapacityModel:
     CLASS_TOKENS_FAMILY = "mmlspark_request_class_decode_tokens_total"
     CLASS_DEVICE_FAMILY = "mmlspark_request_class_device_seconds_total"
     REQUESTS_FAMILY = "mmlspark_serving_requests_total"
+    PREFIX_TOKENS_FAMILY = "mmlspark_prefix_hit_tokens_total"
 
     def __init__(self, clock: Callable[[], float] = time.monotonic,
                  window_s: float = 300.0):
@@ -322,6 +330,11 @@ class CapacityModel:
             "goodput_pct": round(goodput, 4) if goodput is not None else None,
             "tokens_by_outcome": by_outcome,
             "token_samples": total,
+            # prefix-cache savings (ISSUE 20): prefill tokens served from
+            # resident pages fleet-wide — device work the cache SKIPPED,
+            # reported beside goodput so capacity math sees the win
+            "prefill_cached_tokens": view.counter_sum(
+                self.PREFIX_TOKENS_FAMILY, {}),
             "classes": classes,
             "window_s": self.window_s,
             "evaluated_at": view.scraped_at,

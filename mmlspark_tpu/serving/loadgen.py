@@ -38,6 +38,16 @@ capacity model is judged against.  ``check_gates`` accepts
 ``min_goodput_pct``, a lower bound on the ``goodput_pct`` the caller
 folds into ``stats`` (from ``GET /fleet/capacity``); it fails on zero
 ``goodput_samples`` — never vacuous, the PR 11/13 gate discipline.
+
+Template-sharing traffic (ISSUE 20): a workload carrying
+``"prompt_pool": {"prefixes": [...], "suffixes": [...]}`` builds each
+request's body per-request — shared prefix (cycled from ``prefixes``) +
+per-request suffix (cycled from ``suffixes``) — instead of a static
+``body``, the traffic shape whose prefill the cross-request prefix cache
+exists to skip.  ``check_gates`` accepts ``min_prefix_hit_pct``, a lower
+bound on the ``prefix_hit_rate_pct`` the caller folds into ``stats``
+(with ``prefix_lookups`` as its no-vacuous-pass sample count, e.g. from
+the engine's ``debug_state()["prefix_cache"]``).
 """
 from __future__ import annotations
 
@@ -117,11 +127,21 @@ def check_gates(gates: Dict[str, float],
             actual = stats.get("goodput_pct", 0.0)
             ok = stats.get("goodput_samples", 0.0) > 0 and actual >= limit
             book(name, actual, limit, ok)
+        elif name == "min_prefix_hit_pct":
+            # lower bound on the prefix-cache hit rate (ISSUE 20).  The
+            # caller folds the engine's index stats into stats as
+            # prefix_hit_rate_pct/prefix_lookups (e.g. from the decoder's
+            # debug_state()["prefix_cache"]: hits+misses = lookups); zero
+            # lookups FAIL — a run that never consulted the index must
+            # not pass a hit-rate gate on a 0.0 placeholder
+            actual = stats.get("prefix_hit_rate_pct", 0.0)
+            ok = stats.get("prefix_lookups", 0.0) > 0 and actual >= limit
+            book(name, actual, limit, ok)
         else:
             raise ValueError(f"unknown gate {name!r}; expected one of "
                              "p99_ms/p50_ms/ttft_p99_ms/ttft_p50_ms/"
                              "max_error_rate/max_failed/min_rps/"
-                             "min_goodput_pct")
+                             "min_goodput_pct/min_prefix_hit_pct")
     return {"passed": not failures, "failures": failures, "checks": checks}
 
 
@@ -133,7 +153,11 @@ def mixed_load(host: str, port: int,
 
     Each workload is ``{"name", "path", "body", "headers", "n_clients",
     "per_client"}`` (``n_clients`` default 4, ``per_client`` default 100)
-    plus an optional ``"gates"`` spec (see :func:`check_gates`), an
+    plus an optional ``"prompt_pool"`` spec replacing the static ``body``
+    with per-request bodies — ``{"prefixes": [token lists...],
+    "suffixes": [token lists...]}``, each request JSON-encoding one
+    cycled prefix + one cycled suffix (ISSUE 20's template-sharing
+    shape), an optional ``"gates"`` spec (see :func:`check_gates`), an
     optional ``"ttft_key"`` naming the reply-body field carrying in-band
     first-token latency (adds ``ttft_p50_ms``/``ttft_p99_ms``/
     ``ttft_count`` to the class's stats; see the module docstring), and an
@@ -158,6 +182,13 @@ def mixed_load(host: str, port: int,
     if len(set(names)) != len(names):
         raise ValueError(f"duplicate workload names: {sorted(names)} — "
                          "per-class attribution would silently merge them")
+    for w in workloads:
+        spec = w.get("prompt_pool")
+        if spec is not None and not spec.get("prefixes"):
+            # validated HERE, not in the worker threads, where a raise
+            # would be swallowed into the class's error count
+            raise ValueError(f"workload {w['name']!r}: prompt_pool needs a "
+                             "non-empty 'prefixes' list")
     lats: Dict[str, List[float]] = {w["name"]: [] for w in workloads}
     errors: Dict[str, List[str]] = {w["name"]: [] for w in workloads}
     non_2xx: Dict[str, int] = {w["name"]: 0 for w in workloads}
@@ -169,9 +200,29 @@ def mixed_load(host: str, port: int,
 
     def fire(w: Dict[str, Any]):
         name = w["name"]
-        body, headers = w["body"], w.get("headers") or {}
+        headers = w.get("headers") or {}
         ttft_key = w.get("ttft_key")
         tokens_key = w.get("tokens_key")
+        pool_spec = w.get("prompt_pool")
+        if pool_spec is None:
+            body = w["body"]
+
+            def next_body() -> str:
+                return body
+        else:
+            # template-sharing traffic (ISSUE 20): shared prefix × per-
+            # request suffix, both cycled deterministically so repeated
+            # runs replay the same prompt stream — every repeat of a
+            # prefix is a prefix-cache hit opportunity
+            prefixes = [list(p) for p in pool_spec["prefixes"]]
+            suffixes = [list(s) for s in
+                        (pool_spec.get("suffixes") or [[]])]
+            seq = iter(range(10 ** 9))
+
+            def next_body() -> str:
+                i = next(seq)
+                return json.dumps(prefixes[i % len(prefixes)]
+                                  + suffixes[i % len(suffixes)])
         mine: List[float] = []
         mine_ttft: List[float] = []
         mine_bad = 0
@@ -179,7 +230,7 @@ def mixed_load(host: str, port: int,
         try:
             conn = http.client.HTTPConnection(host, port, timeout=30)
             for _ in range(warm):
-                conn.request("POST", w["path"], body, headers)
+                conn.request("POST", w["path"], next_body(), headers)
                 conn.getresponse().read()
         except Exception as e:  # noqa: BLE001 - a dead warm-up is an error
             with lock:
@@ -196,7 +247,7 @@ def mixed_load(host: str, port: int,
         try:
             for _ in range(int(w.get("per_client", 100))):
                 t0 = time.perf_counter()
-                conn.request("POST", w["path"], body, headers)
+                conn.request("POST", w["path"], next_body(), headers)
                 resp = conn.getresponse()
                 data = resp.read()
                 mine.append(time.perf_counter() - t0)

@@ -68,6 +68,10 @@ class _Entry:
     # back with the server-side request span's id
     echo_traceparent: bool = False
     span_id: str = ""  # serving.request span id, filled by the scorer
+    # stable prompt identity (ISSUE 20): set at continuous admission when
+    # the front accepts prompt_hash=; lands on the request record so
+    # /debug/requests correlates hits with their prefill_cached lane
+    prompt_hash: Optional[str] = None
 
 
 class ServingStats:
@@ -170,14 +174,20 @@ class PipelineServer:
         # protocol is duck-typed, and an existing front must not start
         # throwing TypeError because the server learned a new kwarg
         self._submit_takes_trace = False
+        # `prompt_hash=` (ISSUE 20: the prefix-cache admission seam — a
+        # stable identity for the request's prompt, recorded on the
+        # stream handle and the request record) rides the same duck-typed
+        # introspection as trace_id
+        self._submit_takes_hash = False
         if self._continuous_submit is not None:
             try:
                 import inspect as _inspect
                 params = _inspect.signature(
                     self._continuous_submit).parameters
-                self._submit_takes_trace = "trace_id" in params or any(
-                    p.kind is _inspect.Parameter.VAR_KEYWORD
-                    for p in params.values())
+                var_kw = any(p.kind is _inspect.Parameter.VAR_KEYWORD
+                             for p in params.values())
+                self._submit_takes_trace = "trace_id" in params or var_kw
+                self._submit_takes_hash = "prompt_hash" in params or var_kw
             except (TypeError, ValueError):
                 pass
         self.input_col, self.reply_col = input_col, reply_col
@@ -938,6 +948,8 @@ class PipelineServer:
             "queue_s": round(queue_s, 6), "score_s": round(score_s, 6)}
         if ttft_s is not None:
             rec["ttft_s"] = round(ttft_s, 6)
+        if e.prompt_hash is not None:
+            rec["prompt_hash"] = e.prompt_hash
         if cost is not None:
             rec["cost"] = cost.as_dict()
             if e.status == 200 and cost.decode_tokens > 0:
@@ -992,6 +1004,9 @@ class PipelineServer:
 
         try:
             kw = {"trace_id": e.trace_id} if self._submit_takes_trace else {}
+            if self._submit_takes_hash:
+                e.prompt_hash = _prompt_hash(e.payload)
+                kw["prompt_hash"] = e.prompt_hash
             self._continuous_submit(
                 e.payload, resolve=resolve,
                 queue_age_s=max(0.0, t_submit - e.t_enq),
@@ -1170,6 +1185,20 @@ def _default_encode(cell):
     if isinstance(cell, (np.floating, np.integer)):
         return cell.item()
     return cell
+
+
+def _prompt_hash(payload) -> str:
+    """Stable, content-derived identity for a prompt payload (ISSUE 20):
+    equal prompts hash equal across requests and processes, so the record
+    ring and the prefix-cache hit stats correlate.  Identity only — the
+    index matches on token content, so a collision can never corrupt
+    decode."""
+    import hashlib
+    try:
+        canon = json.dumps(payload, sort_keys=True, default=str)
+    except (TypeError, ValueError):
+        canon = repr(payload)
+    return hashlib.sha1(canon.encode()).hexdigest()[:16]
 
 
 class DistributedPipelineServer:

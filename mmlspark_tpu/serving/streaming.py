@@ -27,7 +27,20 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from ..core import DataFrame, Transformer
-from .server import ServingStats, _default_encode
+from .server import ServingStats, _default_encode, _prompt_hash
+
+
+def _takes_prompt_hash(submit) -> bool:
+    """Whether a continuous-submit front declares ``prompt_hash=``
+    (ISSUE 20) — same duck-typed introspection as the PipelineServer
+    seam, so older fronts never see a kwarg they did not ask for."""
+    import inspect
+    try:
+        params = inspect.signature(submit).parameters
+    except (TypeError, ValueError):
+        return False
+    return "prompt_hash" in params or any(
+        p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values())
 
 # request ids key the pending-reply map: process uniqueness suffices, and
 # uuid4's per-call entropy syscall sat on the request hot path (same
@@ -222,6 +235,8 @@ class StreamingQuery:
         # replies from the model's own engine as IT finishes — the trigger
         # loop goes back to draining instead of blocking on the batch
         submit = getattr(self.model, "continuous_submit", None)
+        takes_hash = _takes_prompt_hash(submit) if submit is not None \
+            else False
         while not self._stop.is_set():
             batch = self.source.get_batch(self.max_rows)
             if batch is None:
@@ -232,7 +247,8 @@ class StreamingQuery:
             if submit is not None:
                 vals = cols[self.source.value_col]
                 for u, v in zip(ids, vals):
-                    self._submit_one(submit, str(u), v)
+                    self._submit_one(submit, str(u), v,
+                                     takes_hash=takes_hash)
                 continue
             try:
                 out = self.model.transform(batch).collect()
@@ -246,9 +262,12 @@ class StreamingQuery:
                         en.status, en.reply = 500, {"error": str(e)}
                         en.done.set()
 
-    def _submit_one(self, submit, uid: str, payload) -> None:
+    def _submit_one(self, submit, uid: str, payload,
+                    takes_hash: bool = False) -> None:
         """Admit one row into the model's in-flight engine; shed-typed
-        admission failures reply 503 so the client backs off."""
+        admission failures reply 503 so the client backs off.  When the
+        front declares ``prompt_hash=`` the row's stable prompt identity
+        rides along (ISSUE 20 — the prefix-cache admission seam)."""
         def resolve(reply=None, status=200, verdict=None,
                     retry_after_s=None, ttft_s=None):
             with self.source._lock:
@@ -261,7 +280,8 @@ class StreamingQuery:
                 entry.done.set()
 
         try:
-            submit(payload, resolve=resolve)
+            kw = {"prompt_hash": _prompt_hash(payload)} if takes_hash else {}
+            submit(payload, resolve=resolve, **kw)
         except Exception as e:  # noqa: BLE001 — per-row admission verdict
             self.last_error = str(e)
             status = 503 if getattr(e, "shed", False) else 500

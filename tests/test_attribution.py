@@ -68,8 +68,10 @@ def test_request_cost_page_integral_is_exact():
     d = cost.as_dict()
     assert d["queue_s"] == 0.25 and d["prefill_tokens"] == 4
     assert d["page_seconds"] == pytest.approx(12.0)
-    assert set(d) == {"queue_s", "prefill_tokens", "decode_tokens",
-                      "device_s", "page_seconds", "pages_peak"}
+    assert set(d) == {"queue_s", "prefill_tokens", "prefill_cached",
+                      "decode_tokens", "device_s", "page_seconds",
+                      "pages_peak"}
+    assert d["prefill_cached"] == 0      # no prefix hit booked here
 
 
 def test_window_delta_base_pick_and_clamp():

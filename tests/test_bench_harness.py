@@ -426,3 +426,74 @@ def test_runner_below_gate_ratio_leaves_a_note():
         assert "0.9x" in bench.RESULT["extras"]["phase_notes"]["runner"]
     finally:
         bench.RESULT["extras"].clear()
+
+
+def test_runner_prefix_marker_folds_with_gate_parity_and_compile_checks():
+    """ISSUE 20: the prefix-cache cached-vs-cold TTFT A/B folds its p99
+    pair + ratio + hit rate, the parity and compile counter checks note
+    failures attributably, a zero hit rate notes the broken trace, the
+    on-chip 1.3x gate notes a miss, and a CPU-proxy run records parity +
+    hit rate instead of gating.  The marker is additive — an older child
+    without it still folds the other runner markers."""
+    proc = _child(
+        "print('RUNNER_PREFIX 20.0 12.0 1.667 75.0 1 0 0')\n")
+    got = bench._collect_multi(proc, ("RUNNER_PREFIX",), idle=10, hard=20)
+    bench.RESULT["extras"].clear()
+    try:
+        assert bench._record_runner(got)
+        ex = bench.RESULT["extras"]
+        assert ex["decode_prefix_cold_ttft_p99_ms"] == 20.0
+        assert ex["decode_prefix_ttft_p99_ms"] == 12.0
+        assert ex["decode_prefix_vs_nocache"] == 1.667
+        assert ex["decode_prefix_hit_rate_pct"] == 75.0
+        assert ex["decode_prefix_parity"] == "ok"
+        assert ex["decode_prefix_hit_compiles"] == 0
+        assert "runner" not in ex.get("phase_notes", {})
+    finally:
+        bench.RESULT["extras"].clear()
+    # below the on-chip gate -> attributable note
+    try:
+        assert bench._record_runner(
+            {"RUNNER_PREFIX": [20.0, 18.0, 1.111, 75.0, 1, 0, 0]})
+        assert "1.3x" in bench.RESULT["extras"]["phase_notes"]["runner"]
+    finally:
+        bench.RESULT["extras"].clear()
+    # parity mismatch leaves its note (and the extra says MISMATCH)
+    try:
+        assert bench._record_runner(
+            {"RUNNER_PREFIX": [20.0, 12.0, 1.667, 75.0, 0, 0, 0]})
+        ex = bench.RESULT["extras"]
+        assert ex["decode_prefix_parity"] == "MISMATCH"
+        assert "DIVERGED" in ex["phase_notes"]["runner"]
+    finally:
+        bench.RESULT["extras"].clear()
+    # a hit-minted compile leaves its note
+    try:
+        assert bench._record_runner(
+            {"RUNNER_PREFIX": [20.0, 12.0, 1.667, 75.0, 1, 3, 0]})
+        ex = bench.RESULT["extras"]
+        assert ex["decode_prefix_hit_compiles"] == 3
+        assert "compile" in ex["phase_notes"]["runner"]
+    finally:
+        bench.RESULT["extras"].clear()
+    # a zero hit rate means the template-sharing trace never hit
+    try:
+        assert bench._record_runner(
+            {"RUNNER_PREFIX": [20.0, 14.0, 1.43, 0.0, 1, 0, 0]})
+        assert "ZERO hit rate" in bench.RESULT["extras"]["phase_notes"]["runner"]
+    finally:
+        bench.RESULT["extras"].clear()
+    # CPU proxy flag -> cover note, the 1.3x gate does NOT apply
+    try:
+        assert bench._record_runner(
+            {"RUNNER_PREFIX": [20.0, 25.0, 0.8, 75.0, 1, 0, 1]})
+        note = bench.RESULT["extras"]["phase_notes"]["runner"]
+        assert "proxy" in note and "1.3x" in note
+    finally:
+        bench.RESULT["extras"].clear()
+    # marker-optional back-compat: RUNNER_AB alone still folds
+    try:
+        assert bench._record_runner({"RUNNER_AB": [1000.0, 980.0, 0.98]})
+        assert "decode_prefix_vs_nocache" not in bench.RESULT["extras"]
+    finally:
+        bench.RESULT["extras"].clear()

@@ -1,0 +1,503 @@
+"""Cross-request prefix caching (ISSUE 20) — exactness + lifecycle laws.
+
+The acceptance contracts this file pins:
+
+- greedy decode tokens are BIT-IDENTICAL to a cold-cache decode across
+  hit / partial-hit / miss / copy-on-write-mid-page traffic, on both the
+  one-shot ``decode()`` front and the continuous engine;
+- the refcount law: a page is NEVER on the free list while any holder
+  (live request or index retention) references it, ``free()`` returns a
+  page only at refcount zero, and a double free is a hard error;
+- the hit path adds ZERO new compile keys: suffix prefill reuses the
+  cold executables (positions are data, not shape), counter-checked;
+- eviction under pool pressure reclaims only refcount-0 retentions —
+  a live request's pages survive the index dropping its reference;
+- ``PagePool.resized()`` FLUSHES the attached index (booked
+  ``evicted{reason="pool_replaced"}``) before building the successor —
+  the regression where stale physical page ids outlive the slabs they
+  named (satellite bugfix);
+- the serving seam: ``check_gates`` grows ``min_prefix_hit_pct`` (zero
+  lookups can never pass vacuously), ``mixed_load`` grows the
+  template-sharing ``prompt_pool`` body generator, and a PipelineServer
+  hit books the ``prefill_cached`` cost lane + ``prompt_hash`` into the
+  ``/debug/requests`` record with the second (cached) request's TTFT
+  below the first's.
+"""
+import json
+
+import numpy as np
+import pytest
+
+from tests.test_continuous_batching import post_json, _runner
+
+
+def _fresh(name):
+    from mmlspark_tpu.observability import MetricsRegistry
+    reg = MetricsRegistry()
+    return reg, _runner(name, layers=1, registry=reg)
+
+
+#: parity tests share one runner so decode executables compile once
+_SHARED = {}
+
+
+def _shared():
+    if "runner" not in _SHARED:
+        _SHARED["reg"], _SHARED["runner"] = _fresh("px.shared")
+    return _SHARED["reg"], _SHARED["runner"]
+
+
+def _pool(runner, reg, pages, ps=4):
+    from mmlspark_tpu.models import PagePool
+    return PagePool(runner.module, num_pages=pages, page_size=ps,
+                    name=runner.name, registry=reg)
+
+
+def _assert_no_free_while_referenced(pool):
+    overlap = set(pool._free) & set(pool._ref)
+    assert not overlap, \
+        f"pages {sorted(overlap)} on the free list while referenced"
+
+
+def _drain(dec, pending):
+    """Drive a non-started decoder to quiescence (prompt, budget) pairs."""
+    from mmlspark_tpu.models import SlotsExhausted
+    handles = []
+    pending = list(pending)
+    while pending or dec._arrivals or dec._live:
+        while pending:
+            try:
+                p, b = pending[0]
+                handles.append(dec.submit(p, max_new_tokens=b))
+                pending.pop(0)
+            except SlotsExhausted:
+                break
+        dec.step()
+    return handles
+
+
+# ---------------------------------------------------------------------------
+# pool refcount primitives
+# ---------------------------------------------------------------------------
+
+def test_refcount_pin_share_free_and_double_free_guard():
+    """allocate(shared=) pins ahead of the fresh pages, free() drops one
+    reference and recycles only at zero, pinning an unallocated page and
+    double-freeing are hard errors, and the free-list/refcount invariant
+    holds at every edge."""
+    from mmlspark_tpu.models import PagePool
+    pool = PagePool(None, num_pages=6, page_size=4, name="px.ref")
+    a, b = pool.allocate(2)
+    got = pool.allocate(1, shared=[a])
+    assert got[0] == a and len(got) == 2 and got[1] not in (a, b)
+    c = got[1]
+    assert pool.refcount(a) == 2 and pool.refcount(b) == 1
+    _assert_no_free_while_referenced(pool)
+    pool.free([a])                       # one holder leaves: still resident
+    assert pool.refcount(a) == 1 and a not in pool._free
+    _assert_no_free_while_referenced(pool)
+    pool.free([a])                       # last holder: recycled
+    assert pool.refcount(a) == 0 and a in pool._free
+    with pytest.raises(ValueError, match="double free"):
+        pool.free([a])
+    with pytest.raises(ValueError, match="not allocated"):
+        pool.pin([a])
+    pool.pin([b])
+    assert pool.refcount(b) == 2
+    pool.free([b]); pool.free([b]); pool.free([c])
+    assert pool.pages_in_use() == 0
+    with pytest.raises(ValueError, match="trash page"):
+        pool.free([0])
+    # a refused allocation unpins shared atomically
+    held = pool.allocate(5)              # drain the free list (cap 5)
+    with pytest.raises(Exception):
+        pool.allocate(1, shared=[held[0]])
+    assert pool.refcount(held[0]) == 1, "refused allocate leaked a pin"
+
+
+# ---------------------------------------------------------------------------
+# one-shot decode() exactness
+# ---------------------------------------------------------------------------
+
+def test_one_shot_bit_parity_hit_partial_miss_and_cow():
+    """The exactness drill on decode(): cold references first, then the
+    cached pool replays a miss, a full hit (mid-page -> admission CoW
+    split), a partial hit, and a divergent prompt — every token stream
+    bit-identical, every lookup booked, and the refcount ledger closed
+    (pages_in_use == retained pages once all requests left)."""
+    reg, runner = _shared()
+    ps, budget = 4, 5
+    rng = np.random.default_rng(7)
+    base = rng.integers(1, 40, size=12).astype(np.int32)
+    partial = base.copy(); partial[8:] = [41, 42, 43, 44]   # shares 2 pages
+    cold = {}
+    for key, p in (("base", base), ("partial", partial)):
+        cold[key] = list(runner.decode(
+            p[None], max_new_tokens=budget, kv_layout="paged",
+            page_size=ps, pool=_pool(runner, reg, 24, ps)).tokens[0])
+
+    pool = _pool(runner, reg, 24, ps)
+    idx = runner.prefix_cache(ps, pool=pool)
+    hits = reg.family("mmlspark_prefix_hits_total").labels(runner=runner.name)
+    htok = reg.family("mmlspark_prefix_hit_tokens_total").labels(
+        runner=runner.name)
+    cow = reg.family("mmlspark_prefix_cow_splits_total").labels(
+        runner=runner.name)
+
+    def cached(p):
+        return list(runner.decode(p[None], max_new_tokens=budget,
+                                  kv_layout="paged", page_size=ps,
+                                  pool=pool, prefix_cache=True).tokens[0])
+
+    assert cached(base) == cold["base"]             # miss: seeds retention
+    assert hits.value == 0 and idx.retained_pages() > 0
+    h0 = htok.value
+    assert cached(base) == cold["base"]             # full hit, covered L-1
+    assert hits.value == 1 and htok.value - h0 == 11
+    # covered 11 of 12 with ps=4 ends MID-PAGE: the suffix write on the
+    # shared third page must have gone through a copy-on-write split
+    assert cow.value > 0
+    assert cached(partial) == cold["partial"]       # partial: 2-page hit
+    assert hits.value == 2
+    # all requests left — only index retentions hold pages
+    assert pool.pages_in_use() == idx.retained_pages() > 0
+    _assert_no_free_while_referenced(pool)
+
+
+def test_one_shot_pressure_evicts_retention_not_live_pages():
+    """A decode that cannot fit next to the retained prefix pages evicts
+    refcount-0 retentions (booked ``reason="pressure"``) and proceeds —
+    bit-identically.  The retention can fill the whole pool and the
+    cache still never deadlocks admission."""
+    reg, runner = _shared()
+    ps, budget = 4, 4
+    rng = np.random.default_rng(11)
+    p1 = rng.integers(1, 40, size=12).astype(np.int32)
+    p2 = rng.integers(1, 40, size=12).astype(np.int32)
+    cold2 = list(runner.decode(
+        p2[None], max_new_tokens=budget, kv_layout="paged", page_size=ps,
+        pool=_pool(runner, reg, 24, ps)).tokens[0])
+    pool = _pool(runner, reg, 5, ps)                # exactly one request
+    idx = runner.prefix_cache(ps, pool=pool)
+    ev = reg.family("mmlspark_prefix_evictions_total").labels(
+        runner=runner.name, reason="pressure")
+    e0 = ev.value
+    runner.decode(p1[None], max_new_tokens=budget, kv_layout="paged",
+                  page_size=ps, pool=pool, prefix_cache=True)
+    assert idx.retained_pages() == 4                # retention fills it
+    got = list(runner.decode(p2[None], max_new_tokens=budget,
+                             kv_layout="paged", page_size=ps, pool=pool,
+                             prefix_cache=True).tokens[0])
+    assert got == cold2
+    assert ev.value - e0 >= 4, "pressure eviction was not booked"
+    _assert_no_free_while_referenced(pool)
+
+
+# ---------------------------------------------------------------------------
+# continuous engine exactness + cost lane
+# ---------------------------------------------------------------------------
+
+def test_continuous_bit_parity_covered_and_prefill_cached_lane():
+    """The continuous engine consults the index at submit: covered pages
+    are pinned + skipped by the join prefill (positions offset into the
+    SAME executable), the tokens match one-shot cold decode bit-exactly,
+    ``handle.covered`` rides the cost ledger's ``prefill_cached`` lane,
+    and ``debug_state()`` exposes the index stats stanza."""
+    reg, runner = _shared()
+    ps, budget = 4, 6
+    rng = np.random.default_rng(3)
+    base = rng.integers(1, 40, size=12).astype(np.int32)
+    partial = base.copy(); partial[8:] = [41, 42, 43, 44]
+    cold = {}
+    for key, p in (("base", base), ("partial", partial)):
+        cold[key] = list(runner.decode(
+            p[None], max_new_tokens=budget, kv_layout="paged",
+            page_size=ps, pool=_pool(runner, reg, 24, ps)).tokens[0])
+
+    pool = _pool(runner, reg, 32, ps)
+    dec = runner.decode_stream(slots=2, prompt_bucket=16,
+                               max_new_tokens=budget, page_size=ps,
+                               pool=pool, prefix_cache=True)
+    try:
+        h1 = _drain(dec, [(base, budget)])[0]       # miss: seeds retention
+        assert h1.status == "ok" and h1.covered == 0
+        h2, h3 = _drain(dec, [(base, budget), (partial, budget)])
+        assert h2.status == "ok" and h2.tokens == cold["base"]
+        assert h3.status == "ok" and h3.tokens == cold["partial"]
+        assert h2.covered == 11 and h3.covered == 8
+        assert h2.cost.as_dict()["prefill_cached"] == 11
+        assert h3.cost.as_dict()["prefill_cached"] == 8
+        st = dec.debug_state()["prefix_cache"]
+        assert st["hits"] == 2 and st["misses"] == 1
+        assert st["retained_pages"] == pool.pages_in_use()
+        _assert_no_free_while_referenced(pool)
+    finally:
+        dec.close()
+
+
+def test_continuous_hits_add_zero_new_compile_keys():
+    """Counter-checked acceptance: once one mixed round (miss + hit +
+    CoW + extension) has run, further HIT traffic at the same geometry
+    compiles nothing — offset positions are data, not shape."""
+    reg, runner = _shared()
+    ps, budget = 4, 6
+    rng = np.random.default_rng(5)
+    base = rng.integers(1, 40, size=12).astype(np.int32)
+    pool = _pool(runner, reg, 32, ps)
+    dec = runner.decode_stream(slots=2, prompt_bucket=16,
+                               max_new_tokens=budget, page_size=ps,
+                               pool=pool, prefix_cache=True)
+    try:
+        dec.warmup()
+        _drain(dec, [(base, budget), (base, budget)])   # miss then hit
+        n0 = sum(getattr(w, "compiles", 0) for w in runner._wrappers)
+        hs = _drain(dec, [(base, budget), (base, budget)])
+        assert all(h.status == "ok" for h in hs)
+        assert any(h.covered > 0 for h in hs)
+        n1 = sum(getattr(w, "compiles", 0) for w in runner._wrappers)
+        assert n1 == n0, f"hit traffic minted {n1 - n0} compile key(s)"
+    finally:
+        dec.close()
+
+
+def test_index_eviction_never_yanks_a_live_requests_pages():
+    """A live request sharing retained pages survives the index evicting
+    its reference mid-flight: the pages stay resident (refcount drops to
+    the request's own), decode finishes bit-identically, and the freed
+    retention is booked."""
+    reg, runner = _shared()
+    ps, budget = 4, 6
+    rng = np.random.default_rng(9)
+    base = rng.integers(1, 40, size=12).astype(np.int32)
+    cold = list(runner.decode(
+        base[None], max_new_tokens=budget, kv_layout="paged", page_size=ps,
+        pool=_pool(runner, reg, 24, ps)).tokens[0])
+    pool = _pool(runner, reg, 32, ps)
+    dec = runner.decode_stream(slots=2, prompt_bucket=16,
+                               max_new_tokens=budget, page_size=ps,
+                               pool=pool, prefix_cache=True)
+    try:
+        _drain(dec, [(base, budget)])               # retained
+        idx = dec.index
+        h = dec.submit(base, max_new_tokens=budget)  # hit: pins 3 pages
+        dec.step()                                   # joined, decoding
+        assert h.covered == 11
+        # the third page was CoW-split at the join (suffix lands mid-page)
+        # — the first two full pages are the ones still shared
+        shared_pages = list(h.pages[:2])
+        assert all(pool.refcount(p) >= 2 for p in shared_pages)
+        # the index drops EVERY retention while the request is live
+        idx.evict_pages(idx.retained_pages(), reason="pressure")
+        assert idx.retained_pages() == 0
+        assert all(pool.refcount(p) == 1 for p in shared_pages), \
+            "eviction took the live request's reference"
+        assert all(p not in pool._free for p in shared_pages)
+        _assert_no_free_while_referenced(pool)
+        while dec._live or dec._arrivals:
+            dec.step()
+        assert h.status == "ok" and h.tokens == cold
+    finally:
+        dec.close()
+
+
+def test_early_finisher_frees_while_sharing_keeps_pages_resident():
+    """The eos/budget-leave edge: a short request finishes and releases
+    (retention takes over its reference) while a longer request still
+    decodes from the SAME shared pages — nothing lands on the free list,
+    and the survivor's tokens stay bit-identical."""
+    reg, runner = _shared()
+    ps = 4
+    rng = np.random.default_rng(13)
+    base = rng.integers(1, 40, size=12).astype(np.int32)
+    cold_long = list(runner.decode(
+        base[None], max_new_tokens=6, kv_layout="paged", page_size=ps,
+        pool=_pool(runner, reg, 24, ps)).tokens[0])
+    pool = _pool(runner, reg, 32, ps)
+    dec = runner.decode_stream(slots=2, prompt_bucket=16,
+                               max_new_tokens=6, page_size=ps,
+                               pool=pool, prefix_cache=True)
+    try:
+        _drain(dec, [(base, 6)])                     # seed retention
+        h_long = dec.submit(base, max_new_tokens=6)  # hit: shares pages
+        h_short = dec.submit(base, max_new_tokens=2)  # hit: shares pages
+        while h_short.status in ("queued", "live"):
+            dec.step()
+        assert h_short.status == "ok"
+        assert h_long.status in ("queued", "live"), \
+            "budgets should stagger the leaves"
+        # the short leaver's shared pages are still referenced by the
+        # index retention AND the long request — resident, not recycled
+        assert all(pool.refcount(p) >= 1 for p in h_long.pages[:3])
+        _assert_no_free_while_referenced(pool)
+        while dec._live or dec._arrivals:
+            dec.step()
+        assert h_long.status == "ok" and h_long.tokens == cold_long
+        assert h_short.tokens == cold_long[:2]
+    finally:
+        dec.close()
+
+
+# ---------------------------------------------------------------------------
+# pool replacement flush (satellite bugfix)
+# ---------------------------------------------------------------------------
+
+def test_resized_pool_flushes_index_and_rebinds():
+    """The regression: index entries name PHYSICAL page ids of one pool's
+    slabs.  ``resized()`` must flush (booked ``pool_replaced``) and
+    rebind — a lookup against the successor is a clean miss, never a
+    dangling id handed out against fresh memory."""
+    reg, runner = _shared()
+    ps, budget = 4, 4
+    rng = np.random.default_rng(17)
+    base = rng.integers(1, 40, size=12).astype(np.int32)
+    pool = _pool(runner, reg, 16, ps)
+    idx = runner.prefix_cache(ps, pool=pool)
+    runner.decode(base[None], max_new_tokens=budget, kv_layout="paged",
+                  page_size=ps, pool=pool, prefix_cache=True)
+    retained = idx.retained_pages()
+    assert retained > 0
+    ev = reg.family("mmlspark_prefix_evictions_total").labels(
+        runner=runner.name, reason="pool_replaced")
+    e0 = ev.value
+    new_pool = pool.resized(24)
+    assert ev.value - e0 == retained, "flush did not book pool_replaced"
+    assert idx.retained_pages() == 0
+    assert pool.prefix_index is None and new_pool.prefix_index is idx
+    assert runner.prefix_cache(ps, pool=new_pool) is idx
+    pages, covered = idx.lookup(base)
+    assert pages == [] and covered == 0, "stale entry survived the resize"
+    assert pool.pages_in_use() == 0                  # old slabs fully freed
+    # the successor serves the same traffic from scratch, correctly
+    got = list(runner.decode(base[None], max_new_tokens=budget,
+                             kv_layout="paged", page_size=ps,
+                             pool=new_pool, prefix_cache=True).tokens[0])
+    cold = list(runner.decode(base[None], max_new_tokens=budget,
+                              kv_layout="paged", page_size=ps,
+                              pool=_pool(runner, reg, 24, ps)).tokens[0])
+    assert got == cold
+
+
+def test_resized_refuses_while_live_pages_held_beyond_retention():
+    """Only refcount-0 retentions may ride a resize: live holders still
+    block it (the flush frees retention, the busy check still fires)."""
+    reg, runner = _shared()
+    pool = _pool(runner, reg, 8, 4)
+    runner.prefix_cache(4, pool=pool)
+    held = pool.allocate(2)
+    with pytest.raises(RuntimeError, match="busy"):
+        pool.resized(16)
+    pool.free(held)
+
+
+# ---------------------------------------------------------------------------
+# serving seam: gates, template traffic, server records
+# ---------------------------------------------------------------------------
+
+def test_check_gates_min_prefix_hit_pct():
+    from mmlspark_tpu.serving.loadgen import check_gates
+    ok = check_gates({"min_prefix_hit_pct": 50.0},
+                     {"prefix_hit_rate_pct": 75.0, "prefix_lookups": 8})
+    assert ok["passed"]
+    bad = check_gates({"min_prefix_hit_pct": 50.0},
+                      {"prefix_hit_rate_pct": 25.0, "prefix_lookups": 8})
+    assert not bad["passed"]
+    # ZERO lookups can never pass — a disabled cache or a bench arm that
+    # never consulted the index must fail loudly, not vacuously
+    vac = check_gates({"min_prefix_hit_pct": 0.0},
+                      {"prefix_hit_rate_pct": 0.0, "prefix_lookups": 0})
+    assert not vac["passed"]
+    with pytest.raises(ValueError, match="min_prefix_hit_pct"):
+        check_gates({"min_prefix_hits": 1.0}, {})
+
+
+def test_mixed_load_prompt_pool_validates_spec():
+    from mmlspark_tpu.serving.loadgen import mixed_load
+    with pytest.raises(ValueError, match="prompt_pool"):
+        mixed_load("127.0.0.1", 1, [{"name": "w", "path": "/x", "body": "{}",
+                                     "prompt_pool": {"prefixes": []},
+                                     "n_clients": 1, "per_client": 1}])
+
+
+def test_mixed_load_template_traffic_hits_and_conserves(monkeypatch):
+    """THE serving acceptance drill: template-sharing mixed_load traffic
+    through a prefix-enabled continuous server produces a non-zero hit
+    rate (gated via ``min_prefix_hit_pct`` on the engine's own stats),
+    books the ``prefill_cached`` lane, and token conservation still
+    closes against the engine's step/join counts."""
+    from mmlspark_tpu.observability.attribution import OUTCOMES
+    from mmlspark_tpu.serving import PipelineServer
+    from mmlspark_tpu.serving.loadgen import check_gates, mixed_load
+
+    reg, runner = _fresh("px.load")
+    scorer = runner.scorer(mode="decode", continuous=True, report_ttft=True,
+                           slots=4, prompt_bucket=8, max_new_tokens=4,
+                           page_size=4, prefix_cache=True,
+                           encode=lambda t: [int(x) for x in t])
+    srv = PipelineServer(scorer, port=0, mode="continuous",
+                         registry=reg).start()
+    try:
+        res = mixed_load(
+            "127.0.0.1", srv.port,
+            [{"name": "tpl", "path": srv.api_path, "body": "[]",
+              "headers": {"Content-Type": "application/json"},
+              "prompt_pool": {"prefixes": [[5, 7, 11, 2, 9, 3]],
+                              "suffixes": [[1], [2], [3], [4]]},
+              "tokens_key": "tokens", "n_clients": 2, "per_client": 4}],
+            warm=1)
+        assert res["tpl"]["completed"] == 8 and res["tpl"]["errors"] == 0
+        dec = scorer._decoder
+        st = dec.debug_state()["prefix_cache"]
+        lookups = st["hits"] + st["misses"]
+        assert st["hits"] > 0, "template traffic never hit the cache"
+        gate = check_gates({"min_prefix_hit_pct": 1.0},
+                           {"prefix_hit_rate_pct": st["hit_rate_pct"],
+                            "prefix_lookups": lookups})
+        assert gate["passed"], gate
+        # conservation is still a law with joins prefilling only suffixes
+        fam = reg.family("mmlspark_decode_tokens_outcome_total")
+        total = sum(fam.labels(outcome=o).value for o in OUTCOMES)
+        assert total == dec.steps * dec.slots + dec.joined
+        # the cost ledger booked skipped prefill somewhere in the run
+        cached = reg.family("mmlspark_prefix_hit_tokens_total").labels(
+            runner=runner.name).value
+        assert cached > 0
+    finally:
+        srv.stop()
+
+
+def test_server_e2e_second_request_hits_books_prefill_cached():
+    """Server E2E: the second identical request joins from cache — its
+    TTFT drops below the first's, its ``/debug/requests`` record carries
+    the ``prefill_cached`` lane and the admission ``prompt_hash``, and
+    both requests share that hash."""
+    from mmlspark_tpu.serving import PipelineServer
+
+    reg, runner = _fresh("px.srv")
+    scorer = runner.scorer(mode="decode", continuous=True, report_ttft=True,
+                           slots=2, prompt_bucket=8, max_new_tokens=3,
+                           page_size=4, prefix_cache=True,
+                           encode=lambda t: [int(x) for x in t])
+    srv = PipelineServer(scorer, port=0, mode="continuous",
+                         registry=reg).start()
+    try:
+        payload = [5, 7, 11, 2, 9, 3, 8]
+        status, r1 = post_json(srv.port, srv.api_path, payload)
+        assert status == 200
+        status, r2 = post_json(srv.port, srv.api_path, payload)
+        assert status == 200
+        assert r2["tokens"] == r1["tokens"], "cached decode diverged"
+        assert r2["ttft_ms"] < r1["ttft_ms"], \
+            "cached-join TTFT did not drop below the cold request's"
+        status, raw = post_json(srv.port, "/debug/requests", None,
+                                method_get=True)
+        recs = json.loads(raw)["records"]        # newest first
+        # retention interleaves generated tokens after the 7-token prompt,
+        # so only the first FULL page (4 tokens) is page-aligned matchable
+        assert recs[0]["cost"]["prefill_cached"] == 4
+        assert recs[1]["cost"]["prefill_cached"] == 0
+        assert recs[0]["prompt_hash"] == recs[1]["prompt_hash"]
+        hits = reg.family("mmlspark_prefix_hits_total").labels(
+            runner=runner.name)
+        assert hits.value == 1
+    finally:
+        srv.stop()

@@ -347,8 +347,13 @@ def test_page_pool_surface_books_metrics():
     free_src = inspect.getsource(runner_mod.PagePool.free)
     assert '_book("free"' in free_src, "free() lost its booking"
     decode_src = inspect.getsource(runner_mod.ModelRunner.decode)
-    for needle in ("pool.allocate", "pool.extend", "pool.free"):
+    # allocate/extend route through the reclaim seam since ISSUE 20 (same
+    # pool verbs underneath — _alloc_with_reclaim ends in pool.allocate,
+    # and the extend op keeps its own booking)
+    for needle in ("_alloc_with_reclaim", 'op="extend"', "pool.free"):
         assert needle in decode_src, f"decode() lost {needle}"
+    reclaim_src = inspect.getsource(runner_mod.ModelRunner._alloc_with_reclaim)
+    assert "pool.allocate" in reclaim_src and "evict_pages" in reclaim_src
     # donation contract: the prefill and both step variants declare
     # donate_argnums (a refactor that drops one silently reverts to
     # per-token full-cache allocation on TPU)
@@ -907,3 +912,62 @@ def test_trainwatch_surface_books_metrics():
                 f"TrainingRun no longer registers {family}"
     finally:
         run.close()
+
+
+def test_prefix_cache_surface_books_metrics():
+    """ISSUE 20 coverage: the prefix cache's hit rate is the number the
+    whole tentpole is judged by, and its eviction/CoW counters are the
+    safety valves' only witnesses — the accounting must be un-droppable.
+    Source-level: lookup books the hit/miss split + hit tokens, both
+    eviction paths book the reason-labelled counter, the pool's CoW split
+    helper books through ``book_cow``, ``PagePool.resized()`` flushes the
+    attached index as ``pool_replaced`` BEFORE building the successor,
+    both admission fronts route scarcity through ``_alloc_with_reclaim``,
+    and the cost ledger carries the ``prefill_cached`` lane the capacity
+    report reads.  Live: ModelRunner construction registers all seven
+    families even for runners that never enable the cache."""
+    from mmlspark_tpu.models import prefix_cache as px_mod
+    from mmlspark_tpu.models import runner as runner_mod
+    from mmlspark_tpu.observability import MetricsRegistry
+    from mmlspark_tpu.observability import attribution as attr_mod
+
+    lookup_src = inspect.getsource(px_mod.PrefixIndex.lookup)
+    for needle in ("_c_hits", "_c_misses", "_c_hit_tokens"):
+        assert needle in lookup_src, f"lookup() lost {needle}"
+    for fn in (px_mod.PrefixIndex._evict_node_locked,
+               px_mod.PrefixIndex._evict_root_tail_locked):
+        assert "_c_evict" in inspect.getsource(fn), \
+            f"{fn.__name__} lost the eviction counter"
+    assert "_c_cow" in inspect.getsource(px_mod.PrefixIndex.book_cow)
+    assert "book_cow" in inspect.getsource(
+        runner_mod.ModelRunner._cow_split_page), \
+        "_cow_split_page no longer books the CoW split"
+    resized_src = inspect.getsource(runner_mod.PagePool.resized)
+    assert 'flush(reason="pool_replaced")' in resized_src, \
+        "resized() no longer flushes the attached prefix index"
+    assert "rebind" in resized_src
+    # both admission fronts reclaim retention under pressure instead of
+    # shedding while refcount-0 pages sit retained
+    for fn in (runner_mod.ModelRunner.decode,
+               runner_mod.ContinuousDecoder.submit,
+               runner_mod.ContinuousDecoder._advance):
+        assert "_alloc_with_reclaim" in inspect.getsource(fn), \
+            f"{fn.__qualname__} lost the reclaim-then-allocate path"
+    # the skipped-prefill lane rides the request record + capacity report
+    assert "prefill_cached" in attr_mod.RequestCost.__slots__
+    assert "prefill_cached" in inspect.getsource(attr_mod.RequestCost.as_dict)
+    assert "PREFIX_TOKENS_FAMILY" in inspect.getsource(
+        attr_mod.CapacityModel.report)
+
+    reg = MetricsRegistry()
+    runner_mod.ModelRunner(apply_fn=lambda v, x: x, variables={},
+                           name="sweep20", registry=reg)
+    for family in ("mmlspark_prefix_hits_total",
+                   "mmlspark_prefix_misses_total",
+                   "mmlspark_prefix_evictions_total",
+                   "mmlspark_prefix_cow_splits_total",
+                   "mmlspark_prefix_hit_tokens_total",
+                   "mmlspark_prefix_hit_rate_pct",
+                   "mmlspark_prefix_retained_pages"):
+        assert reg.family(family) is not None, \
+            f"ModelRunner no longer registers {family}"
